@@ -1,0 +1,49 @@
+"""Global configuration for cimba-tpu.
+
+The reference (cimba) does platform detection and TLS-model selection in
+``src/cmi_config.h``.  The TPU-native analog is dtype discipline and JAX
+global configuration:
+
+* Simulated **time is float64**.  A clock near 1e6 with unit-scale increments
+  needs ~1e-10 relative resolution for stable event ordering; float32's
+  epsilon at 1e6 is 0.0625 which would corrupt waiting-time statistics.
+  float64 is software-emulated on TPU but only the clock/event-time arrays
+  pay that cost.
+* **Sample values, amounts and statistics accumulate in float64** as well so
+  that per-replication summaries are reproducible against the scalar oracle.
+* **Indices, handles, program counters are int32** (TPU-native width).
+* **RNG internals are uint32** (threefry2x32 counters/keys), which is the
+  natively fast integer width on TPU.
+
+Importing :mod:`cimba_tpu` enables ``jax_enable_x64``.  All framework arrays
+carry explicit dtypes, so user code that wants pure-32-bit models can still
+build them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Simulated-time dtype (see module docstring).
+TIME_DTYPE = jnp.float64
+#: Continuous sample / statistics dtype.
+REAL_DTYPE = jnp.float64
+#: Index / handle / counter dtype.
+INDEX_DTYPE = jnp.int32
+#: Signal codes are int32 (the reference uses int64 signals; int32 covers the
+#: protocol and all practical user signals; see core/signals.py).
+SIGNAL_DTYPE = jnp.int32
+#: RNG word dtype.
+BITS_DTYPE = jnp.uint32
+
+#: Sentinel "time" for empty event slots: +inf sorts after every real event.
+TIME_NEVER = float("inf")
+
+
+def setup() -> None:
+    """Enable the JAX global flags cimba-tpu requires (idempotent)."""
+    jax.config.update("jax_enable_x64", True)
+
+
+setup()

@@ -1,0 +1,24 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Real multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on 8 virtual CPU devices (the driver separately dry-run-compiles
+the multi-chip path — see __graft_entry__.py).  Env vars must be set before
+jax initializes its backends, hence before any cimba_tpu import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import cimba_tpu  # noqa: E402, F401  (enables x64)
+
+
+def pytest_report_header(config):
+    return f"jax {jax.__version__} devices={jax.device_count()} backend={jax.default_backend()}"

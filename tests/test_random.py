@@ -1,0 +1,342 @@
+"""RNG tests: known-answer vectors, moment checks, determinism.
+
+Mirrors the reference's test strategy (`test/test_random.c`): large-sample
+moments vs closed-form expectations — plus counter-stream properties the
+reference never needed (batching invariance under vmap).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import cimba_tpu.random as cr
+from cimba_tpu.random.bits import threefry2x32
+
+
+# --- bit level --------------------------------------------------------------
+
+
+def test_threefry_known_answer_vectors():
+    # Random123 verified test vectors (Salmon et al., SC'11 distribution).
+    cases = [
+        ((0, 0), (0, 0), (0x6B200159, 0x99BA4EFE)),
+        (
+            (0xFFFFFFFF, 0xFFFFFFFF),
+            (0xFFFFFFFF, 0xFFFFFFFF),
+            (0x1CB996FC, 0xBB002BE7),
+        ),
+        (
+            (0x13198A2E, 0x03707344),
+            (0x243F6A88, 0x85A308D3),
+            (0xC4923A9C, 0x483DF7A0),
+        ),
+    ]
+    for (k0, k1), (c0, c1), (e0, e1) in cases:
+        b0, b1 = threefry2x32(k0, k1, c0, c1)
+        assert int(b0) == e0 and int(b1) == e1
+
+
+def test_stream_independence_and_determinism():
+    st_a = cr.initialize(123, 0)
+    st_b = cr.initialize(123, 1)
+    st_a2 = cr.initialize(123, 0)
+    _, xa = cr.uniform01(st_a)
+    _, xb = cr.uniform01(st_b)
+    _, xa2 = cr.uniform01(st_a2)
+    assert float(xa) == float(xa2)
+    assert float(xa) != float(xb)
+
+
+def test_counter_advances_and_sequence_changes():
+    st = cr.initialize(7, 0)
+    st, x1 = cr.uniform01(st)
+    st, x2 = cr.uniform01(st)
+    assert int(st.n_draws) == 2
+    assert float(x1) != float(x2)
+
+
+def test_golden_stream_values():
+    """Golden-file analog (`test/reference/` in the reference): the uniform
+    stream is bit-identical on every backend (only exactly-computed ops are
+    used), so these constants hold on CPU and TPU alike."""
+    st = cr.initialize(2026, 0)
+    expected = [
+        "0x1.0dad78d600000p-1",
+        "0x1.b0dc663000000p-4",
+        "0x1.f7249a7c00000p-1",
+        "0x1.b45482f200000p-1",
+    ]
+    for e in expected:
+        st, u = cr.uniform01(st)
+        assert float(u).hex() == e
+
+
+def test_vmap_batching_invariance():
+    """Replication r's draws must not depend on batch layout."""
+    reps = jnp.arange(16)
+    states = jax.vmap(lambda r: cr.initialize(99, r))(reps)
+    _, batched = jax.vmap(cr.uniform01)(states)
+    singles = [float(cr.uniform01(cr.initialize(99, int(r)))[1]) for r in reps]
+    np.testing.assert_array_equal(np.asarray(batched), np.asarray(singles))
+
+
+# --- moment checks ----------------------------------------------------------
+
+N = 200_000
+
+
+def draw(fn, n=N, seed=2026):
+    """n iid samples: one per independent replication stream, vmapped."""
+    states = jax.vmap(lambda r: cr.initialize(seed, r))(jnp.arange(n))
+    _, xs = jax.jit(jax.vmap(fn))(states)
+    return np.asarray(xs, dtype=np.float64)
+
+
+def check_moments(xs, mean, var, rtol=0.05, atol=0.02):
+    scale = max(abs(mean), np.sqrt(var), 1e-9)
+    assert abs(xs.mean() - mean) < rtol * scale + atol
+    assert abs(xs.var() - var) < 3.0 * rtol * max(var, atol)
+
+
+def test_uniform01_moments():
+    xs = draw(cr.uniform01)
+    check_moments(xs, 0.5, 1.0 / 12.0)
+    assert xs.min() >= 0.0 and xs.max() < 1.0
+
+
+def test_uniform_range():
+    xs = draw(lambda st: cr.uniform(st, -2.0, 3.0))
+    check_moments(xs, 0.5, 25.0 / 12.0)
+
+
+def test_triangular_moments():
+    lo, mode, hi = 1.0, 3.0, 7.0
+    xs = draw(lambda st: cr.triangular(st, lo, mode, hi))
+    mean = (lo + mode + hi) / 3.0
+    var = (lo**2 + mode**2 + hi**2 - lo * mode - lo * hi - mode * hi) / 18.0
+    check_moments(xs, mean, var)
+    assert xs.min() >= lo and xs.max() <= hi
+
+
+def test_exponential_moments():
+    xs = draw(lambda st: cr.exponential(st, 2.5))
+    check_moments(xs, 2.5, 6.25)
+    # skewness of exponential = 2
+    skew = ((xs - xs.mean()) ** 3).mean() / xs.std() ** 3
+    assert abs(skew - 2.0) < 0.2
+
+
+def test_normal_moments():
+    xs = draw(lambda st: cr.normal(st, -1.5, 2.0))
+    check_moments(xs, -1.5, 4.0)
+    skew = ((xs - xs.mean()) ** 3).mean() / xs.std() ** 3
+    kurt = ((xs - xs.mean()) ** 4).mean() / xs.var() ** 2
+    assert abs(skew) < 0.05
+    assert abs(kurt - 3.0) < 0.15
+
+
+def test_lognormal_moments():
+    m, s = 0.5, 0.4
+    xs = draw(lambda st: cr.lognormal(st, m, s))
+    mean = np.exp(m + s * s / 2)
+    var = (np.exp(s * s) - 1) * np.exp(2 * m + s * s)
+    check_moments(xs, mean, var)
+
+
+def test_logistic_moments():
+    xs = draw(lambda st: cr.logistic(st, 2.0, 0.5))
+    check_moments(xs, 2.0, (np.pi**2 / 3) * 0.25)
+
+
+def test_cauchy_median():
+    xs = draw(lambda st: cr.cauchy(st, 3.0, 1.0))
+    assert abs(np.median(xs) - 3.0) < 0.05
+
+
+def test_erlang_moments():
+    xs = draw(lambda st: cr.erlang(st, 4, 0.5), n=100_000)
+    check_moments(xs, 2.0, 1.0)
+
+
+def test_hypoexponential_moments():
+    means = jnp.asarray([1.0, 2.0, 0.5])
+    xs = draw(lambda st: cr.hypoexponential(st, means), n=100_000)
+    check_moments(xs, 3.5, 1.0 + 4.0 + 0.25)
+
+
+def test_hyperexponential_moments():
+    probs = jnp.asarray([0.3, 0.7])
+    means = jnp.asarray([1.0, 4.0])
+    xs = draw(lambda st: cr.hyperexponential(st, probs, means), n=100_000)
+    mean = 0.3 * 1.0 + 0.7 * 4.0
+    second = 2 * (0.3 * 1.0**2 + 0.7 * 4.0**2)
+    check_moments(xs, mean, second - mean**2)
+
+
+@pytest.mark.parametrize("shape", [0.5, 1.0, 2.5, 9.0])
+def test_gamma_moments(shape):
+    xs = draw(lambda st: cr.gamma(st, shape, 1.5), n=100_000)
+    check_moments(xs, shape * 1.5, shape * 1.5**2)
+
+
+def test_beta_moments():
+    a, b = 2.0, 5.0
+    xs = draw(lambda st: cr.std_beta(st, a, b), n=100_000)
+    mean = a / (a + b)
+    var = a * b / ((a + b) ** 2 * (a + b + 1))
+    check_moments(xs, mean, var)
+
+
+def test_pert_moments():
+    lo, mode, hi = 0.0, 3.0, 12.0
+    xs = draw(lambda st: cr.pert(st, lo, mode, hi), n=100_000)
+    mean = (lo + 4 * mode + hi) / 6.0
+    var = (mean - lo) * (hi - mean) / 7.0  # beta with lam=4: /(lam+3)
+    check_moments(xs, mean, var, rtol=0.08)
+    assert xs.min() >= lo and xs.max() <= hi
+
+
+def test_weibull_moments():
+    import math
+
+    k, lam = 1.5, 2.0
+    xs = draw(lambda st: cr.weibull(st, k, lam))
+    mean = lam * math.gamma(1 + 1 / k)
+    var = lam**2 * (math.gamma(1 + 2 / k) - math.gamma(1 + 1 / k) ** 2)
+    check_moments(xs, mean, var)
+
+
+def test_pareto_moments():
+    shape, mode = 3.0, 2.0
+    xs = draw(lambda st: cr.pareto(st, shape, mode))
+    mean = shape * mode / (shape - 1)
+    var = mode**2 * shape / ((shape - 1) ** 2 * (shape - 2))
+    check_moments(xs, mean, var, rtol=0.1)
+    assert xs.min() >= mode
+
+
+def test_chisquared_moments():
+    xs = draw(lambda st: cr.chisquared(st, 5.0), n=100_000)
+    check_moments(xs, 5.0, 10.0)
+
+
+def test_f_dist_mean():
+    b = 10.0
+    xs = draw(lambda st: cr.f_dist(st, 4.0, b), n=100_000)
+    assert abs(xs.mean() - b / (b - 2)) < 0.1
+
+
+def test_t_dist_moments():
+    v = 8.0
+    xs = draw(lambda st: cr.std_t_dist(st, v), n=100_000)
+    check_moments(xs, 0.0, v / (v - 2), rtol=0.1)
+
+
+def test_rayleigh_moments():
+    s = 2.0
+    xs = draw(lambda st: cr.rayleigh(st, s))
+    check_moments(xs, s * np.sqrt(np.pi / 2), (2 - np.pi / 2) * s**2)
+
+
+def test_flip_and_bernoulli():
+    xs = draw(cr.flip)
+    assert abs(xs.mean() - 0.5) < 0.01
+    ys = draw(lambda st: cr.bernoulli(st, 0.3))
+    assert abs(ys.mean() - 0.3) < 0.01
+
+
+def test_geometric_moments():
+    p = 0.25
+    xs = draw(lambda st: cr.geometric(st, p))
+    check_moments(xs, 1 / p, (1 - p) / p**2)
+    assert xs.min() >= 1
+
+
+def test_binomial_moments():
+    n, p = 20, 0.3
+    xs = draw(lambda st: cr.binomial(st, n, p), n=50_000)
+    check_moments(xs, n * p, n * p * (1 - p))
+
+
+def test_negative_binomial_and_pascal():
+    m, p = 3, 0.4
+    xs = draw(lambda st: cr.negative_binomial(st, m, p), n=50_000)
+    check_moments(xs, m * (1 - p) / p, m * (1 - p) / p**2)
+    ys = draw(lambda st: cr.pascal(st, m, p), n=50_000)
+    check_moments(ys, m / p, m * (1 - p) / p**2)
+
+
+@pytest.mark.parametrize("rate", [0.5, 4.0, 40.0])
+def test_poisson_moments(rate):
+    xs = draw(lambda st: cr.poisson(st, rate), n=50_000)
+    check_moments(xs, rate, rate, rtol=0.08)
+
+
+def test_poisson_eager_small_rate_terminates():
+    """Regression: PTRS constants are invalid below rate~10; eagerly (no jit
+    dead-code elimination) the unselected branch must still terminate."""
+    st = cr.initialize(3, 0)
+    _, k = cr.poisson(st, 0.5)
+    assert int(k) >= 0
+
+
+def test_poisson_vmapped_mixed_rates():
+    """Under vmap, lax.cond runs both branches masked — per-lane rates on
+    both sides of the algorithm switch must work in one batch."""
+    rates = jnp.asarray([0.5, 3.0, 15.0, 80.0])
+    states = jax.vmap(lambda r: cr.initialize(11, r))(jnp.arange(4))
+    _, ks = jax.jit(jax.vmap(cr.poisson))(states, rates)
+    assert (np.asarray(ks) >= 0).all()
+
+
+def test_std_normal_tail_support():
+    """53-bit uniform: extreme draws must be able to exceed 6.33 sigma (the
+    32-bit granularity cap)."""
+    st = cr.initialize(0, 0)
+    # erfinv(2u-1) at the largest representable u: drive directly via the
+    # sampler on a stream engineered near the extreme is impractical; instead
+    # check the quantile map itself through the public sampler by massive
+    # sampling of the near-tail: P(|z| > 4.5) ~ 6.8e-6, so 2M draws see ~13.
+    states = jax.vmap(lambda r: cr.initialize(17, r))(jnp.arange(2_000_000))
+    _, zs = jax.jit(jax.vmap(cr.std_normal))(states)
+    assert float(jnp.abs(zs).max()) > 4.4
+
+
+def test_discrete_uniform_and_dice():
+    xs = draw(lambda st: cr.discrete_uniform(st, 10))
+    assert xs.min() == 0 and xs.max() == 9
+    check_moments(xs, 4.5, 99 / 12)
+    ys = draw(lambda st: cr.dice(st, 1, 6))
+    assert ys.min() == 1 and ys.max() == 6
+    check_moments(ys, 3.5, 35 / 12)
+
+
+def test_discrete_nonuniform_frequencies():
+    probs = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+    xs = draw(lambda st: cr.discrete_nonuniform(st, probs))
+    freqs = np.bincount(xs.astype(int), minlength=4) / len(xs)
+    np.testing.assert_allclose(freqs, [0.1, 0.2, 0.3, 0.4], atol=0.01)
+
+
+def test_loaded_dice_support():
+    probs = jnp.asarray([0.5, 0.25, 0.25])
+    xs = draw(lambda st: cr.loaded_dice(st, 10, 12, probs))
+    assert xs.min() == 10 and xs.max() == 12
+
+
+def test_alias_table_frequencies():
+    weights = [1.0, 2.0, 3.0, 4.0, 0.0, 6.0]
+    table = cr.alias_create(weights)
+    xs = draw(lambda st: cr.alias_sample(st, table))
+    freqs = np.bincount(xs.astype(int), minlength=6) / len(xs)
+    np.testing.assert_allclose(freqs, np.asarray(weights) / 16.0, atol=0.01)
+
+
+def test_alias_rejects_bad_weights():
+    with pytest.raises(ValueError):
+        cr.alias_create([])
+    with pytest.raises(ValueError):
+        cr.alias_create([-1.0, 2.0])
+    with pytest.raises(ValueError):
+        cr.alias_create([0.0, 0.0])
