@@ -1,0 +1,81 @@
+"""North-star benchmark: M/M/1 events/second (reference: benchmark/MM1_multi).
+
+Reference ground truth (BASELINE.md): 100 trials x 1e6 objects in 0.56 s on
+a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
+object).  ``vs_baseline`` is the ratio of this machine's events/s to that
+aggregate; the north star is >= 10.
+
+Replications are vmapped lanes on one chip (and would shard over a mesh on
+a pod — see __graft_entry__.dryrun_multichip).  The workload per replication
+is smaller than the reference's 1e6 objects so total wall time stays
+CI-friendly, but the *rate* is the metric and is workload-size independent
+once the loop is warm.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mm1
+
+R = int(os.environ.get("CIMBA_BENCH_R", 4096))
+N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", 2000))
+BASELINE_EVENTS_PER_SEC = 375e6  # 64-core reference aggregate
+
+
+def main():
+    spec, _ = mm1.build()
+    run = cl.make_run(spec)
+
+    def experiment(n_objects):
+        def one(rep):
+            sim = cl.init_sim(
+                spec, 2026, rep, (1.0 / 0.9, 1.0, n_objects)
+            )
+            return run(sim)
+
+        sims = jax.vmap(one)(jnp.arange(R))
+        return (
+            jnp.sum(sims.n_events),
+            jnp.sum((sims.err != 0).astype(jnp.int32)),
+            sims.clock,
+        )
+
+    fn = jax.jit(experiment)
+    # warmup/compile with the same shapes (n_objects is traced data)
+    jax.block_until_ready(fn(jnp.int32(1)))
+
+    t0 = time.perf_counter()
+    events, failed, clocks = jax.block_until_ready(fn(jnp.int32(N_OBJECTS)))
+    wall = time.perf_counter() - t0
+
+    events = int(events)
+    rate = events / wall
+    print(
+        json.dumps(
+            {
+                "metric": "mm1_events_per_sec",
+                "value": rate,
+                "unit": "events/s",
+                "vs_baseline": rate / BASELINE_EVENTS_PER_SEC,
+                "detail": {
+                    "replications": R,
+                    "objects_per_replication": N_OBJECTS,
+                    "total_events": events,
+                    "wall_s": wall,
+                    "failed_replications": int(failed),
+                    "backend": jax.default_backend(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

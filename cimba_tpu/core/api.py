@@ -1,0 +1,101 @@
+"""Block-author helpers: the model-facing surface inside blocks.
+
+These are the TPU equivalents of the calls a reference process body makes
+between yields — ``cmb_time()``, ``cmb_random_*``, reading/writing its own
+state — expressed functionally over the :class:`~cimba_tpu.core.loop.Sim`
+pytree.  Commands (the yield points) live in :mod:`cimba_tpu.core.process`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+from cimba_tpu.core.loop import ERR_USER, Sim
+
+_I = INDEX_DTYPE
+_R = REAL_DTYPE
+
+
+def clock(sim: Sim):
+    """Current simulation time (parity: ``cmb_time``)."""
+    return sim.clock
+
+
+def draw(sim: Sim, dist, *params):
+    """Draw from a distribution, threading the replication's RNG stream:
+    ``sim, x = api.draw(sim, random.exponential, mean)``."""
+    rng, x = dist(sim.rng, *params)
+    return sim._replace(rng=rng), x
+
+
+def got(sim: Sim, p):
+    """Result register: the item produced by this process's last GET."""
+    return sim.procs.got[p]
+
+
+def local_f(sim: Sim, p, k: int):
+    return sim.procs.locals_f[p, k]
+
+
+def set_local_f(sim: Sim, p, k: int, v) -> Sim:
+    return sim._replace(
+        procs=sim.procs._replace(
+            locals_f=sim.procs.locals_f.at[p, k].set(jnp.asarray(v, _R))
+        )
+    )
+
+
+def local_i(sim: Sim, p, k: int):
+    return sim.procs.locals_i[p, k]
+
+
+def set_local_i(sim: Sim, p, k: int, v) -> Sim:
+    return sim._replace(
+        procs=sim.procs._replace(
+            locals_i=sim.procs.locals_i.at[p, k].set(jnp.asarray(v, _I))
+        )
+    )
+
+
+def add_local_i(sim: Sim, p, k: int, dv=1) -> Sim:
+    return sim._replace(
+        procs=sim.procs._replace(
+            locals_i=sim.procs.locals_i.at[p, k].add(jnp.asarray(dv, _I))
+        )
+    )
+
+
+def user(sim: Sim):
+    return sim.user
+
+
+def set_user(sim: Sim, new_user) -> Sim:
+    return sim._replace(user=new_user)
+
+
+def stop(sim: Sim, pred=True) -> Sim:
+    """End the replication after the current event (the analog of the
+    reference's user-scheduled end event)."""
+    return sim._replace(done=sim.done | jnp.asarray(pred))
+
+
+def fail(sim: Sim, pred=True) -> Sim:
+    """Mark the replication failed (parity: cmb_logger_error recovery —
+    the replication is abandoned and counted, §3.5)."""
+    return sim._replace(
+        err=jnp.where(
+            (sim.err == 0) & jnp.asarray(pred), jnp.asarray(ERR_USER, _I), sim.err
+        )
+    )
+
+
+def queue_length(sim: Sim, q):
+    """Current number of items in an object queue (parity:
+    ``cmb_objectqueue_length``)."""
+    return sim.queues.size[q.id if hasattr(q, "id") else q]
+
+
+def resource_holder(sim: Sim, r):
+    """Holding pid of a resource, -1 if free."""
+    return sim.resources.holder[r.id if hasattr(r, "id") else r]

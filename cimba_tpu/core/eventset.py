@@ -1,0 +1,270 @@
+"""The future-event set: fixed-capacity, branch-free, batched by vmap.
+
+Reference parity: the event queue is the reference's performance heart — a
+binary heap fused with a hash map (`src/cmi_hashheap.c`, 937 lines of
+open-addressing, tombstones and Fibonacci hashing) giving O(log n) pops and
+O(1) handle-based cancel/reschedule (`src/cmb_event.c:190-335`).
+
+TPU redesign: none of that survives contact with the VPU.  A heap's
+sift-up/down is a chain of data-dependent scalar gathers — poison under
+vmap.  Instead the event set is a **flat slot table**: CAP parallel arrays,
+`time == +inf` marks a free slot, and "pop min" is a lexicographic argmin
+over (time, -priority, seq) computed with three masked reductions — O(CAP)
+work but a handful of fully-vectorized VPU ops, which for the CAP <= a few
+hundred of process-interaction models beats the heap's serial pointer
+chasing by a wide margin.  Handles are (slot | generation<<16), making
+cancel/reschedule O(1) scatters and ABA-safe, replacing the hash map
+entirely.  The hashheap's amortized-doubling growth
+(`src/cmi_hashheap.c:384-426`) becomes a static capacity with an overflow
+flag — the replication is failure-masked, the experiment continues
+(SURVEY.md §7 hard part (b)).
+
+Event ordering contract (parity with `src/cmb_event.c:75-100`): earlier
+time first, then HIGHER priority, then FIFO by sequence number.
+
+All functions are scalar-style (one replication); the framework vmaps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE, TIME_DTYPE
+
+_T = TIME_DTYPE
+_I = INDEX_DTYPE
+
+#: slot value meaning "no event here"
+NEVER = jnp.inf
+#: handle returned when scheduling fails (capacity exhausted)
+NULL_HANDLE = jnp.int32(-1)
+
+_GEN_SHIFT = 16
+_SLOT_MASK = (1 << _GEN_SHIFT) - 1
+
+
+class EventSet(NamedTuple):
+    """One replication's future events (CAP slots, struct-of-arrays)."""
+
+    time: jnp.ndarray   # [CAP] f64, +inf = free
+    prio: jnp.ndarray   # [CAP] i32, higher fires first at equal time
+    seq: jnp.ndarray    # [CAP] i32, schedule order, FIFO tiebreak
+    kind: jnp.ndarray   # [CAP] i32, dispatch index (framework/user handler)
+    subj: jnp.ndarray   # [CAP] i32, subject (process id, resource id, ...)
+    arg: jnp.ndarray    # [CAP] i32, payload (signal code, ...)
+    gen: jnp.ndarray    # [CAP] i32, slot generation (ABA-safe handles)
+    next_seq: jnp.ndarray  # i32, next sequence number
+    overflow: jnp.ndarray  # bool, a schedule was dropped
+
+
+class Event(NamedTuple):
+    """A popped event."""
+
+    time: jnp.ndarray
+    prio: jnp.ndarray
+    kind: jnp.ndarray
+    subj: jnp.ndarray
+    arg: jnp.ndarray
+    found: jnp.ndarray  # bool: False if the set was empty
+
+
+def create(capacity: int) -> EventSet:
+    if capacity > _SLOT_MASK + 1:
+        raise ValueError(f"event capacity {capacity} exceeds {_SLOT_MASK + 1}")
+    return EventSet(
+        time=jnp.full((capacity,), NEVER, _T),
+        prio=jnp.zeros((capacity,), _I),
+        seq=jnp.zeros((capacity,), _I),
+        kind=jnp.zeros((capacity,), _I),
+        subj=jnp.zeros((capacity,), _I),
+        arg=jnp.zeros((capacity,), _I),
+        gen=jnp.zeros((capacity,), _I),
+        next_seq=jnp.zeros((), _I),
+        overflow=jnp.asarray(False),
+    )
+
+
+def _handle(slot, gen):
+    return (gen << _GEN_SHIFT) | slot
+
+
+def schedule(es: EventSet, t, prio, kind, subj, arg):
+    """Insert an event; returns (es, handle).
+
+    A non-finite time or a full table sets the overflow/error flag and
+    returns NULL_HANDLE — the caller (event loop) masks the replication
+    as failed rather than corrupting state.
+    """
+    t = jnp.asarray(t, _T)
+    free = jnp.isinf(es.time)
+    slot = jnp.argmax(free).astype(_I)  # first free slot
+    ok = free[slot] & jnp.isfinite(t)
+
+    def put(a, v):
+        return a.at[slot].set(jnp.where(ok, v, a[slot]))
+
+    es2 = EventSet(
+        time=put(es.time, t),
+        prio=put(es.prio, jnp.asarray(prio, _I)),
+        seq=put(es.seq, es.next_seq),
+        kind=put(es.kind, jnp.asarray(kind, _I)),
+        subj=put(es.subj, jnp.asarray(subj, _I)),
+        arg=put(es.arg, jnp.asarray(arg, _I)),
+        gen=es.gen,
+        next_seq=es.next_seq + jnp.where(ok, 1, 0).astype(_I),
+        overflow=es.overflow | ~ok,
+    )
+    handle = jnp.where(ok, _handle(slot, es.gen[slot]), NULL_HANDLE)
+    return es2, handle.astype(_I)
+
+
+def _slot_of(handle):
+    return handle & _SLOT_MASK
+
+
+def _gen_of(handle):
+    return handle >> _GEN_SHIFT
+
+
+def _valid(es: EventSet, handle):
+    slot = _slot_of(handle)
+    return (
+        (handle >= 0)
+        & jnp.isfinite(es.time[slot])
+        & (es.gen[slot] == _gen_of(handle))
+    )
+
+
+def cancel(es: EventSet, handle):
+    """Remove by handle; returns (es, existed).  O(1) scatter — the
+    capability the reference needed the whole hash map for."""
+    slot = _slot_of(jnp.maximum(handle, 0))
+    ok = _valid(es, handle)
+    return (
+        es._replace(
+            time=es.time.at[slot].set(jnp.where(ok, NEVER, es.time[slot])),
+            gen=es.gen.at[slot].add(jnp.where(ok, 1, 0).astype(_I)),
+        ),
+        ok,
+    )
+
+
+def reschedule(es: EventSet, handle, new_t):
+    """Move an event in time, keeping FIFO seq (parity:
+    ``cmb_event_reschedule``).  Returns (es, existed)."""
+    slot = _slot_of(jnp.maximum(handle, 0))
+    ok = _valid(es, handle) & jnp.isfinite(jnp.asarray(new_t, _T))
+    return (
+        es._replace(
+            time=es.time.at[slot].set(
+                jnp.where(ok, jnp.asarray(new_t, _T), es.time[slot])
+            )
+        ),
+        ok,
+    )
+
+
+def reprioritize(es: EventSet, handle, new_prio):
+    """Parity: ``cmb_event_reprioritize``.  Returns (es, existed)."""
+    slot = _slot_of(jnp.maximum(handle, 0))
+    ok = _valid(es, handle)
+    return (
+        es._replace(
+            prio=es.prio.at[slot].set(
+                jnp.where(ok, jnp.asarray(new_prio, _I), es.prio[slot])
+            )
+        ),
+        ok,
+    )
+
+
+def _argnext(es: EventSet):
+    """Index of the next event: min time, then max prio, then min seq —
+    three masked reductions, no data-dependent control flow."""
+    t_min = jnp.min(es.time)
+    m1 = es.time == t_min
+    p_max = jnp.max(jnp.where(m1, es.prio, jnp.iinfo(jnp.int32).min))
+    m2 = m1 & (es.prio == p_max)
+    s_min = jnp.min(jnp.where(m2, es.seq, jnp.iinfo(jnp.int32).max))
+    m3 = m2 & (es.seq == s_min)
+    return jnp.argmax(m3).astype(_I), jnp.isfinite(t_min)
+
+
+def peek(es: EventSet) -> Event:
+    slot, found = _argnext(es)
+    return Event(
+        time=es.time[slot],
+        prio=es.prio[slot],
+        kind=es.kind[slot],
+        subj=es.subj[slot],
+        arg=es.arg[slot],
+        found=found,
+    )
+
+
+def pop(es: EventSet):
+    """Remove and return the next event; (es, Event)."""
+    slot, found = _argnext(es)
+    ev = Event(
+        time=es.time[slot],
+        prio=es.prio[slot],
+        kind=es.kind[slot],
+        subj=es.subj[slot],
+        arg=es.arg[slot],
+        found=found,
+    )
+    es2 = es._replace(
+        time=es.time.at[slot].set(jnp.where(found, NEVER, es.time[slot])),
+        gen=es.gen.at[slot].add(jnp.where(found, 1, 0).astype(_I)),
+    )
+    return es2, ev
+
+
+def is_empty(es: EventSet):
+    return ~jnp.any(jnp.isfinite(es.time))
+
+
+def length(es: EventSet):
+    return jnp.sum(jnp.isfinite(es.time).astype(_I))
+
+
+# --- pattern operations (parity: cmb_event_pattern_* wildcards,
+#     `src/cmb_event.c:459-493`) — vectorized full scans -------------------
+
+WILDCARD = jnp.int32(-1)
+
+
+def _match(es: EventSet, kind, subj):
+    live = jnp.isfinite(es.time)
+    k = jnp.asarray(kind, _I)
+    s = jnp.asarray(subj, _I)
+    mk = (k == WILDCARD) | (es.kind == k)
+    ms = (s == WILDCARD) | (es.subj == s)
+    return live & mk & ms
+
+
+def pattern_count(es: EventSet, kind=WILDCARD, subj=WILDCARD):
+    return jnp.sum(_match(es, kind, subj).astype(_I))
+
+
+def pattern_cancel(es: EventSet, kind=WILDCARD, subj=WILDCARD):
+    """Cancel all matching events; returns (es, n_cancelled)."""
+    m = _match(es, kind, subj)
+    return (
+        es._replace(
+            time=jnp.where(m, NEVER, es.time),
+            gen=es.gen + m.astype(_I),
+        ),
+        jnp.sum(m.astype(_I)),
+    )
+
+
+def pattern_find(es: EventSet, kind=WILDCARD, subj=WILDCARD):
+    """Handle of the soonest matching event, else NULL_HANDLE."""
+    m = _match(es, kind, subj)
+    t = jnp.where(m, es.time, NEVER)
+    slot = jnp.argmin(t).astype(_I)
+    found = jnp.isfinite(t[slot])
+    return jnp.where(found, _handle(slot, es.gen[slot]), NULL_HANDLE).astype(_I)

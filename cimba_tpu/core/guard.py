@@ -1,0 +1,124 @@
+"""Resource guards: the universal wait queue for blocked processes.
+
+Reference parity: ``cmb_resourceguard`` (`src/cmb_resourceguard.c:71-251`)
+— a hashheap of (process, demand-predicate, context) entries ordered by
+priority, then entry time, then sequence; every L5 component (resource,
+pool, buffer, queues, condition) funnels its blocking through one of these.
+
+TPU redesign: a guard is a fixed-capacity slot table per replication, like
+the event set: entries are (pid, prio, seq), "pop best" is a two-key masked
+argmin (priority DESC, seq ASC).  The demand *predicate* does not live here:
+in the reference it's a C function pointer evaluated at signal time; here
+the woken process re-attempts its pending command at wake time (same
+fairness loop the reference's acquire/get/put sites implement around
+``cmb_resourceguard_wait``), so the predicate is the command's own
+can-proceed check — one mechanism instead of two.
+
+Guards for a whole model are stored as one struct-of-arrays ``[NG, GCAP]``
+so blocks can index them by integer id under jit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu.config import INDEX_DTYPE
+
+_I = INDEX_DTYPE
+
+NO_PID = jnp.int32(-1)
+
+
+class Guards(NamedTuple):
+    """All guards of one replication: [NG, GCAP] slot tables."""
+
+    pid: jnp.ndarray    # [NG, GCAP] i32, -1 = free slot
+    prio: jnp.ndarray   # [NG, GCAP] i32
+    seq: jnp.ndarray    # [NG, GCAP] i32 entry order
+    next_seq: jnp.ndarray  # [NG] i32
+    overflow: jnp.ndarray  # bool
+
+
+def create(n_guards: int, capacity: int) -> Guards:
+    return Guards(
+        pid=jnp.full((n_guards, capacity), NO_PID, _I),
+        prio=jnp.zeros((n_guards, capacity), _I),
+        seq=jnp.zeros((n_guards, capacity), _I),
+        next_seq=jnp.zeros((n_guards,), _I),
+        overflow=jnp.asarray(False),
+    )
+
+
+def enqueue(g: Guards, gid, pid, prio):
+    """Add a waiting process; returns (g, ok)."""
+    row_pid = g.pid[gid]
+    free = row_pid == NO_PID
+    slot = jnp.argmax(free).astype(_I)
+    ok = free[slot]
+
+    def put(a, v):
+        return a.at[gid, slot].set(jnp.where(ok, v, a[gid, slot]))
+
+    g2 = Guards(
+        pid=put(g.pid, jnp.asarray(pid, _I)),
+        prio=put(g.prio, jnp.asarray(prio, _I)),
+        seq=put(g.seq, g.next_seq[gid]),
+        next_seq=g.next_seq.at[gid].add(jnp.where(ok, 1, 0).astype(_I)),
+        overflow=g.overflow | ~ok,
+    )
+    return g2, ok
+
+
+def _argbest(g: Guards, gid):
+    """Best waiter: highest priority, then earliest entry (parity with the
+    reference's priority -> entry-time -> seq ordering)."""
+    row_pid = g.pid[gid]
+    live = row_pid != NO_PID
+    p_max = jnp.max(jnp.where(live, g.prio[gid], jnp.iinfo(jnp.int32).min))
+    m = live & (g.prio[gid] == p_max)
+    s_min = jnp.min(jnp.where(m, g.seq[gid], jnp.iinfo(jnp.int32).max))
+    m2 = m & (g.seq[gid] == s_min)
+    return jnp.argmax(m2).astype(_I), jnp.any(live)
+
+
+def pop_best(g: Guards, gid):
+    """Dequeue the best waiter; returns (g, pid) with pid == NO_PID if the
+    guard is empty."""
+    slot, found = _argbest(g, gid)
+    pid = jnp.where(found, g.pid[gid, slot], NO_PID)
+    g2 = g._replace(
+        pid=g.pid.at[gid, slot].set(jnp.where(found, NO_PID, g.pid[gid, slot]))
+    )
+    return g2, pid
+
+
+def remove(g: Guards, gid, pid):
+    """Remove a specific process (parity: ``cmb_resourceguard_remove``, used
+    when a waiting process is interrupted/killed); returns (g, existed)."""
+    row = g.pid[gid]
+    m = row == jnp.asarray(pid, _I)
+    existed = jnp.any(m)
+    return g._replace(pid=g.pid.at[gid].set(jnp.where(m, NO_PID, row))), existed
+
+
+def is_empty(g: Guards, gid):
+    return ~jnp.any(g.pid[gid] != NO_PID)
+
+
+def length(g: Guards, gid):
+    return jnp.sum((g.pid[gid] != NO_PID).astype(_I))
+
+
+def reprioritize(g: Guards, gid, pid, new_prio):
+    """Update a waiter's priority in place (parity: the reprio hooks that
+    reshuffle guard queues when a process's priority changes,
+    `src/cmb_process.c:170-220`)."""
+    row = g.pid[gid]
+    m = row == jnp.asarray(pid, _I)
+    return g._replace(
+        prio=g.prio.at[gid].set(
+            jnp.where(m, jnp.asarray(new_prio, _I), g.prio[gid])
+        )
+    )

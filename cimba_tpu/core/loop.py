@@ -1,0 +1,434 @@
+"""The event loop: one replication's dispatcher, jit-compiled and vmapped.
+
+Reference parity: ``cmb_event_queue_execute`` (`src/cmb_event.c:296-335`)
+— pop next event, advance the clock, run the action, repeat — where the
+action context-switches into a coroutine until it yields
+(`src/cmb_process.c:329-375`).
+
+TPU rendition (the "fiber scheduler lowered to an XLA while-loop" of the
+north star): ``make_run`` builds ``lax.while_loop(cond, step, sim)`` where
+``step`` pops from the flat event set, advances the batched clock, and
+dispatches through ``lax.switch``:
+
+* kind 0 = process wakeup: resume the subject process — an inner bounded
+  while_loop runs its current block (``lax.switch`` over the model's block
+  table) and applies the returned command, chaining while commands complete
+  without yielding.  This is exactly a coroutine running until it waits,
+  with (pc, locals) rows instead of a C stack.
+* kinds >= 1 = user handlers (parity: arbitrary (action, subject, object)
+  events).
+
+Everything is scalar-style over a single replication's :class:`Sim`;
+``jax.vmap`` supplies the replication axis and ``shard_map`` the mesh
+(runner/).  Blocked commands pend on guards and are *re-attempted* on
+wakeup, which reproduces the reference's loop-around-guard-wait fairness
+protocol (`src/cmb_resource.c:202-233`).
+
+Failure containment (parity: §3.5 error recovery, `src/cimba.c:185-209`):
+any structural failure — event/guard overflow, non-finite time, a block
+chain that never yields — sets ``sim.err`` and freezes the replication;
+the experiment runner counts and masks it, and the other replications in
+the batch are unaffected.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE, TIME_DTYPE
+from cimba_tpu.core import eventset as ev
+from cimba_tpu.core import guard as gd
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import ModelSpec
+from cimba_tpu.random import bits as rb
+from cimba_tpu.stats import timeseries as ts
+
+_I = INDEX_DTYPE
+_R = REAL_DTYPE
+_T = TIME_DTYPE
+
+K_PROC = 0  # event kind: resume process `subj` with signal `arg`
+
+# chain-safety bound: a process may not execute more than this many blocks
+# without yielding (a JUMP cycle would otherwise hang the whole batch)
+MAX_CHAIN = 1024
+
+# error codes (sim.err)
+ERR_NONE = 0
+ERR_EVENT_OVERFLOW = 1
+ERR_GUARD_OVERFLOW = 2
+ERR_CHAIN_RUNAWAY = 3
+ERR_USER = 4
+ERR_BAD_RELEASE = 5
+
+
+class Queues(NamedTuple):
+    items: jnp.ndarray  # [NQ, QCAP] f64 ring buffers
+    head: jnp.ndarray   # [NQ] i32
+    size: jnp.ndarray   # [NQ] i32
+    acc: ts.StepAccum   # leaves [NQ]: queue-length recording
+
+
+class Resources(NamedTuple):
+    holder: jnp.ndarray  # [NR] i32, -1 = free
+    acc: ts.StepAccum    # leaves [NR]: utilization recording
+
+
+class Sim(NamedTuple):
+    """One replication's full state."""
+
+    clock: jnp.ndarray
+    rng: rb.RandomState
+    events: ev.EventSet
+    procs: pr.Procs
+    guards: gd.Guards
+    queues: Queues
+    resources: Resources
+    user: Any
+    done: jnp.ndarray      # bool, set by model code (api.stop)
+    err: jnp.ndarray       # i32, ERR_* (0 = healthy)
+    n_events: jnp.ndarray  # i64, dispatched events (bench metric)
+
+
+def _tree_select(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _batched(tree, n):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n,) + jnp.shape(x)), tree
+    )
+
+
+def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
+    """Build one replication's initial state and schedule process starts
+    (parity: the trial-init sequence `benchmark/MM1_multi.c:91-124`)."""
+    nq = max(len(spec.queues), 1)
+    nr = max(len(spec.resources), 1)
+    events = ev.create(spec.event_cap)
+    procs = pr.create(
+        spec.proc_entry, spec.proc_prio, spec.n_flocals, spec.n_ilocals
+    )
+    # start events, in pid order (FIFO among simultaneous starts)
+    for pid in range(spec.n_procs):
+        events, _ = ev.schedule(
+            events, t0, int(spec.proc_prio[pid]), K_PROC, pid, pr.SUCCESS
+        )
+    procs = procs._replace(
+        status=jnp.full((spec.n_procs,), pr.RUNNING, _I)
+    )
+    user = spec.user_init(params) if spec.user_init else jnp.zeros(())
+    t0 = jnp.asarray(t0, _T)
+    return Sim(
+        clock=t0,
+        rng=rb.initialize(seed, replication),
+        events=events,
+        procs=procs,
+        guards=gd.create(spec.n_guards, spec.guard_cap),
+        queues=Queues(
+            items=jnp.zeros((nq, spec.queue_cap_max), _R),
+            head=jnp.zeros((nq,), _I),
+            size=jnp.zeros((nq,), _I),
+            acc=_batched(ts.step_create(t0, 0.0), nq),
+        ),
+        resources=Resources(
+            holder=jnp.full((nr,), -1, _I),
+            acc=_batched(ts.step_create(t0, 0.0), nr),
+        ),
+        user=user,
+        done=jnp.asarray(False),
+        # an event_cap too small for even the start events is a failed
+        # replication from step zero
+        err=jnp.where(
+            events.overflow, jnp.asarray(ERR_EVENT_OVERFLOW, _I), jnp.zeros((), _I)
+        ),
+        n_events=jnp.zeros((), jnp.int64),
+    )
+
+
+# --- micro-ops on Sim --------------------------------------------------------
+
+
+def _set_err(sim: Sim, pred, code) -> Sim:
+    return sim._replace(
+        err=jnp.where((sim.err == 0) & pred, jnp.asarray(code, _I), sim.err)
+    )
+
+
+def _schedule_if(sim: Sim, pred, t, prio, kind, subj, arg) -> Sim:
+    es2, _ = ev.schedule(sim.events, t, prio, kind, subj, arg)
+    es2 = _tree_select(pred, es2, sim.events)
+    sim = sim._replace(events=es2)
+    return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
+
+
+def _guard_signal(sim: Sim, gid) -> Sim:
+    """Wake the best waiter (if any): schedule its retry at the current
+    time with its process priority (parity: cmb_resourceguard_signal
+    scheduling wakeup events rather than switching directly)."""
+    g2, pid = gd.pop_best(sim.guards, gid)
+    woke = pid != gd.NO_PID
+    p = jnp.maximum(pid, 0)
+    sim = sim._replace(guards=g2)
+    return _schedule_if(
+        sim, woke, sim.clock, sim.procs.prio[p], K_PROC, p, pr.SUCCESS
+    )
+
+
+def _guard_wait(sim: Sim, p, gid, cmd: pr.Command) -> Sim:
+    """Pend the blocked command and enqueue the process on the guard."""
+    procs = sim.procs._replace(
+        pend_tag=sim.procs.pend_tag.at[p].set(cmd.tag),
+        pend_f=sim.procs.pend_f.at[p].set(cmd.f),
+        pend_i=sim.procs.pend_i.at[p].set(cmd.i),
+        pend_pc=sim.procs.pend_pc.at[p].set(cmd.next_pc),
+    )
+    g2, ok = gd.enqueue(sim.guards, gid, p, sim.procs.prio[p])
+    sim = sim._replace(procs=procs, guards=g2)
+    return _set_err(sim, ~ok, ERR_GUARD_OVERFLOW)
+
+
+def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
+    """step_record on one row of a batched StepAccum."""
+    one = jax.tree.map(lambda x: x[row], acc)
+    upd = ts.step_record(one, t, v)
+    return jax.tree.map(lambda a, u: a.at[row].set(u), acc, upd)
+
+
+# --- command handlers ---------------------------------------------------------
+
+
+def _make_apply(spec: ModelSpec):
+    q_cap = jnp.asarray(
+        [q.capacity for q in spec.queues] or [1], _I
+    )
+    q_front = jnp.asarray([q.front_guard for q in spec.queues] or [0], _I)
+    q_rear = jnp.asarray([q.rear_guard for q in spec.queues] or [0], _I)
+    r_guard = jnp.asarray([r.guard for r in spec.resources] or [0], _I)
+
+    def set_pc(sim, p, pc):
+        return sim._replace(
+            procs=sim.procs._replace(pc=sim.procs.pc.at[p].set(pc))
+        )
+
+    def h_hold(sim: Sim, p, cmd: pr.Command):
+        dur = jnp.maximum(cmd.f, 0.0)
+        es2, handle = ev.schedule(
+            sim.events, sim.clock + dur, sim.procs.prio[p], K_PROC, p,
+            pr.SUCCESS,
+        )
+        sim = sim._replace(
+            events=es2,
+            procs=sim.procs._replace(
+                wake_handle=sim.procs.wake_handle.at[p].set(handle),
+                pc=sim.procs.pc.at[p].set(cmd.next_pc),
+            ),
+        )
+        sim = _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
+        return sim, jnp.asarray(True)
+
+    def h_exit(sim: Sim, p, cmd: pr.Command):
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                status=sim.procs.status.at[p].set(pr.FINISHED)
+            )
+        )
+        return sim, jnp.asarray(True)
+
+    def h_jump(sim: Sim, p, cmd: pr.Command):
+        return set_pc(sim, p, cmd.next_pc), jnp.asarray(False)
+
+    def h_put(sim: Sim, p, cmd: pr.Command):
+        qid = cmd.i
+        size = sim.queues.size[qid]
+        cap = q_cap[qid]
+        full = size >= cap
+
+        # proceed path: ring insert at (head + size) mod cap (cap <= phys)
+        col = (sim.queues.head[qid] + size) % cap
+        q2 = Queues(
+            items=sim.queues.items.at[qid, col].set(cmd.f),
+            head=sim.queues.head,
+            size=sim.queues.size.at[qid].add(1),
+            acc=_record_row(
+                sim.queues.acc, qid, sim.clock, (size + 1).astype(_R)
+            ),
+        )
+        ok_sim = sim._replace(queues=q2)
+        ok_sim = _guard_signal(ok_sim, q_front[qid])
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+
+        blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd)
+        return _tree_select(full, blocked_sim, ok_sim), full
+
+    def h_get(sim: Sim, p, cmd: pr.Command):
+        qid = cmd.i
+        size = sim.queues.size[qid]
+        empty = size <= 0
+        cap = q_cap[qid]
+
+        head = sim.queues.head[qid]
+        item = sim.queues.items[qid, head]
+        q2 = Queues(
+            items=sim.queues.items,
+            head=sim.queues.head.at[qid].set((head + 1) % cap),
+            size=sim.queues.size.at[qid].add(-1),
+            acc=_record_row(
+                sim.queues.acc, qid, sim.clock, (size - 1).astype(_R)
+            ),
+        )
+        ok_sim = sim._replace(
+            queues=q2,
+            procs=sim.procs._replace(got=sim.procs.got.at[p].set(item)),
+        )
+        ok_sim = _guard_signal(ok_sim, q_rear[qid])
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+
+        blocked_sim = _guard_wait(sim, p, q_front[qid], cmd)
+        return _tree_select(empty, blocked_sim, ok_sim), empty
+
+    def h_acquire(sim: Sim, p, cmd: pr.Command):
+        rid = cmd.i
+        free = sim.resources.holder[rid] < 0
+        may_grab = gd.is_empty(sim.guards, r_guard[rid])
+        ok = free & may_grab
+
+        r2 = Resources(
+            holder=sim.resources.holder.at[rid].set(p),
+            acc=_record_row(sim.resources.acc, rid, sim.clock, 1.0),
+        )
+        ok_sim = sim._replace(resources=r2)
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+
+        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd)
+        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+
+    def h_release(sim: Sim, p, cmd: pr.Command):
+        rid = cmd.i
+        owner_ok = sim.resources.holder[rid] == p
+        r2 = Resources(
+            holder=sim.resources.holder.at[rid].set(-1),
+            acc=_record_row(sim.resources.acc, rid, sim.clock, 0.0),
+        )
+        sim2 = sim._replace(resources=r2)
+        sim2 = _guard_signal(sim2, r_guard[rid])
+        sim2 = set_pc(sim2, p, cmd.next_pc)
+        sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
+        return sim2, jnp.asarray(False)
+
+    handlers = [h_hold, h_exit, h_jump, h_put, h_get, h_acquire, h_release]
+
+    def apply_command(sim: Sim, p, cmd: pr.Command):
+        return lax.switch(
+            jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p, cmd
+        )
+
+    return apply_command
+
+
+# --- the dispatcher -----------------------------------------------------------
+
+
+def make_step(spec: ModelSpec):
+    """Build ``step(sim) -> sim`` dispatching exactly one event."""
+    apply_command = _make_apply(spec)
+    blocks = list(spec.blocks)
+
+    def run_block(sim: Sim, p, sig):
+        return lax.switch(
+            jnp.clip(sim.procs.pc[p], 0, len(blocks) - 1),
+            blocks,
+            sim,
+            p,
+            sig,
+        )
+
+    def resume(sim: Sim, p, sig):
+        """Resume process p: retry a pending command if one exists, then
+        chain blocks until something yields."""
+        pend = pr.Command(
+            sim.procs.pend_tag[p],
+            sim.procs.pend_f[p],
+            sim.procs.pend_i[p],
+            sim.procs.pend_pc[p],
+        )
+        has_pend = pend.tag != pr.NO_PEND
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                pend_tag=sim.procs.pend_tag.at[p].set(pr.NO_PEND)
+            )
+        )
+        # retry pending op (or no-op)
+        retried, ry = apply_command(sim, p, pend)
+        sim = _tree_select(has_pend, retried, sim)
+        yielded = has_pend & ry
+
+        def cond(carry):
+            sim, sig, yielded, n = carry
+            alive = (sim.procs.status[p] == pr.RUNNING) & (sim.err == 0)
+            return ~yielded & alive & (n < MAX_CHAIN)
+
+        def body(carry):
+            sim, sig, _, n = carry
+            sim, cmd = run_block(sim, p, sig)
+            sim, yielded = apply_command(sim, p, cmd)
+            return sim, jnp.asarray(pr.SUCCESS, _I), yielded, n + 1
+
+        sim, _, yielded, n = lax.while_loop(
+            cond, body, (sim, jnp.asarray(sig, _I), yielded, jnp.zeros((), _I))
+        )
+        return _set_err(sim, n >= MAX_CHAIN, ERR_CHAIN_RUNAWAY)
+
+    def on_proc(sim: Sim, subj, arg):
+        alive = sim.procs.status[subj] == pr.RUNNING
+        resumed = resume(sim, subj, arg)
+        return _tree_select(alive, resumed, sim)
+
+    user_handlers = [
+        (lambda fn: (lambda sim, subj, arg: fn(sim, subj, arg)))(fn)
+        for fn in spec.user_handlers
+    ]
+    dispatch_fns = [on_proc] + user_handlers
+
+    def step(sim: Sim) -> Sim:
+        es2, event = ev.pop(sim.events)
+        sim = sim._replace(
+            events=es2,
+            clock=jnp.where(event.found, event.time, sim.clock),
+            n_events=sim.n_events + jnp.where(event.found, 1, 0).astype(jnp.int64),
+            done=sim.done | ~event.found,
+        )
+        dispatched = lax.switch(
+            jnp.clip(event.kind, 0, len(dispatch_fns) - 1),
+            dispatch_fns,
+            sim,
+            event.subj,
+            event.arg,
+        )
+        return _tree_select(event.found, dispatched, sim)
+
+    return step
+
+
+def make_run(spec: ModelSpec, t_end: Optional[float] = None):
+    """Build ``run(sim) -> sim``: dispatch events until the model stops
+    (api.stop), fails, runs out of events, or passes ``t_end``
+    (parity: cmb_event_queue_execute; t_end plays the role of the
+    user-scheduled end event)."""
+    step = make_step(spec)
+
+    def cond(sim: Sim):
+        live = ~sim.done & (sim.err == 0) & ~ev.is_empty(sim.events)
+        if t_end is not None:
+            nxt = jnp.min(sim.events.time)
+            live = live & (nxt <= t_end)
+        return live
+
+    def run(sim: Sim) -> Sim:
+        return lax.while_loop(cond, step, sim)
+
+    return run

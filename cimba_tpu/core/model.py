@@ -1,0 +1,197 @@
+"""Model definition: the static structure a simulation is built from.
+
+The reference builds models imperatively at trial start (create/initialize
+processes, queues, resources — e.g. `benchmark/MM1_multi.c:91-124`).  Under
+jit the structure must be static: a :class:`Model` collects process types,
+blocks, queues and resources at Python time; :meth:`Model.build` freezes it
+into a :class:`ModelSpec` the dispatcher closes over.  Only *state* (clock,
+event slots, queue contents, locals, RNG counters) lives in the traced
+pytree — one replication's state is created by ``core.loop.init_sim`` and
+batched with vmap.
+
+Block registration::
+
+    m = Model("mm1", n_ilocals=1)
+    q = m.objectqueue("buffer", capacity=1024)
+
+    @m.block
+    def a_hold(sim, p, sig):
+        sim, t = api.draw(sim, random.exponential, 1.11)
+        return sim, cmd.hold(t, next_pc=a_put.pc)
+
+    @m.block
+    def a_put(sim, p, sig):
+        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+
+    m.process("arrival", entry=a_hold)
+
+Forward references work because ``next_pc`` is read at trace time, after
+the module is fully defined.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class QueueRef:
+    id: int
+    name: str
+    capacity: int
+    front_guard: int  # getters wait here
+    rear_guard: int   # putters wait here
+
+
+@dataclasses.dataclass
+class ResourceRef:
+    id: int
+    name: str
+    guard: int
+
+
+@dataclasses.dataclass
+class ProcessType:
+    name: str
+    entry_pc: int
+    prio: int
+    count: int
+    first_pid: int = -1  # assigned at build
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Frozen model structure (everything static the stepper needs)."""
+
+    name: str
+    blocks: List[Callable]
+    proc_entry: np.ndarray     # [P] i32
+    proc_prio: np.ndarray      # [P] i32
+    proc_names: List[str]
+    queues: List[QueueRef]
+    resources: List[ResourceRef]
+    n_guards: int
+    guard_cap: int
+    event_cap: int
+    queue_cap_max: int
+    n_flocals: int
+    n_ilocals: int
+    user_init: Optional[Callable[..., Any]]
+    user_handlers: List[Callable]
+
+    @property
+    def n_procs(self) -> int:
+        return len(self.proc_entry)
+
+
+class Model:
+    """Mutable model builder (Python-time only)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        n_flocals: int = 0,
+        n_ilocals: int = 0,
+        event_cap: int = 16,
+        guard_cap: int = 8,
+    ):
+        self.name = name
+        self.n_flocals = n_flocals
+        self.n_ilocals = n_ilocals
+        self.event_cap = event_cap
+        self.guard_cap = guard_cap
+        self._blocks: List[Callable] = []
+        self._types: List[ProcessType] = []
+        self._queues: List[QueueRef] = []
+        self._resources: List[ResourceRef] = []
+        self._n_guards = 0
+        self._user_init: Optional[Callable] = None
+        self._user_handlers: List[Callable] = []
+
+    # --- structure -----------------------------------------------------
+
+    def block(self, fn: Callable) -> Callable:
+        """Register a block; sets ``fn.pc`` to its global index."""
+        fn.pc = len(self._blocks)
+        self._blocks.append(fn)
+        return fn
+
+    def process(self, name: str, entry, *, prio: int = 0, count: int = 1):
+        """Declare ``count`` instances of a process type starting at block
+        ``entry`` (a function registered with :meth:`block`)."""
+        pt = ProcessType(name, entry.pc, prio, count)
+        self._types.append(pt)
+        return pt
+
+    def _guard(self) -> int:
+        g = self._n_guards
+        self._n_guards += 1
+        return g
+
+    def objectqueue(self, name: str, capacity: int) -> QueueRef:
+        """FIFO of f64 payloads (parity: cmb_objectqueue; the reference's
+        void* objects become a float payload — typically a timestamp or an
+        index into user state)."""
+        q = QueueRef(
+            id=len(self._queues),
+            name=name,
+            capacity=capacity,
+            front_guard=self._guard(),
+            rear_guard=self._guard(),
+        )
+        self._queues.append(q)
+        return q
+
+    def resource(self, name: str) -> ResourceRef:
+        """Single-holder resource (parity: cmb_resource)."""
+        r = ResourceRef(id=len(self._resources), name=name, guard=self._guard())
+        self._resources.append(r)
+        return r
+
+    def user_state(self, fn: Callable) -> Callable:
+        """Register ``fn(params) -> pytree`` building per-replication user
+        state (the reference's trial struct, `include/cimba.h:100-118`)."""
+        self._user_init = fn
+        return fn
+
+    def handler(self, fn: Callable) -> Callable:
+        """Register a user event handler ``fn(sim, subj, arg) -> sim``;
+        sets ``fn.kind`` for use with api.schedule (parity: arbitrary
+        (action, subject, object) events, `include/cmb_event.h:75-180`)."""
+        fn.kind = 1 + len(self._user_handlers)  # kind 0 = process wakeup
+        self._user_handlers.append(fn)
+        return fn
+
+    # --- freeze ----------------------------------------------------------
+
+    def build(self) -> ModelSpec:
+        if not self._types:
+            raise ValueError("model has no processes")
+        entries, prios, names = [], [], []
+        for pt in self._types:
+            pt.first_pid = len(entries)
+            for k in range(pt.count):
+                entries.append(pt.entry_pc)
+                prios.append(pt.prio)
+                names.append(pt.name if pt.count == 1 else f"{pt.name}[{k}]")
+        return ModelSpec(
+            name=self.name,
+            blocks=list(self._blocks),
+            proc_entry=np.asarray(entries, np.int32),
+            proc_prio=np.asarray(prios, np.int32),
+            proc_names=names,
+            queues=list(self._queues),
+            resources=list(self._resources),
+            n_guards=max(self._n_guards, 1),
+            guard_cap=self.guard_cap,
+            event_cap=self.event_cap,
+            queue_cap_max=max([q.capacity for q in self._queues], default=1),
+            n_flocals=self.n_flocals,
+            n_ilocals=self.n_ilocals,
+            user_init=self._user_init,
+            user_handlers=list(self._user_handlers),
+        )

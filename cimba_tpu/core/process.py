@@ -1,0 +1,153 @@
+"""Processes as state machines: the coroutine layer, lowered to data.
+
+Reference parity: ``cmb_process`` (`src/cmb_process.c`, 870 lines) gives
+each simulated process a stack, an assembly context switch, and
+hold/interrupt/stop/wait semantics with a signal-code protocol
+(`include/cmb_process.h:59-99`).  All control transfers are routed through
+scheduled events — the dispatcher never jumps directly between coroutines.
+
+TPU redesign (SURVEY.md §7 "coroutines become state machines"): a process
+is a row in a struct-of-arrays — program counter, status, priority, pending
+command, result register, typed locals.  A process *body* is a list of
+**blocks**: pure functions ``block(sim, pid, sig) -> (sim, Command)``
+covering the straight-line code between two yield points of the equivalent
+coroutine.  The dispatcher (core/loop.py) runs blocks through
+``lax.switch`` and chains non-yielding commands in an inner while_loop —
+exactly a coroutine resuming until it next waits, with the C stack replaced
+by the explicit (pc, locals) row.  No stacks, no guard pages, no context
+switch: the entire fiber kernel (reference components #2-#4, 1800 LoC of
+C+asm) becomes array indexing.
+
+Signal codes keep the reference's protocol and values.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+
+_I = INDEX_DTYPE
+_R = REAL_DTYPE
+
+# --- signal protocol (parity: include/cmb_process.h:59-99) -------------------
+SUCCESS = 0
+PREEMPTED = -1
+INTERRUPTED = -2
+STOPPED = -3
+CANCELLED = -4
+TIMEOUT = -5
+
+# --- process status (parity: enum cmb_process_state + queued refinement) -----
+CREATED = 0
+RUNNING = 1   # live: executing, holding, or waiting on a guard
+FINISHED = 2
+
+# --- command tags -------------------------------------------------------------
+C_HOLD = 0      # yield for a duration                      (f=dur)
+C_EXIT = 1      # terminate the process
+C_JUMP = 2      # continue immediately at next_pc
+C_PUT = 3       # blocking put into object queue i          (f=item)
+C_GET = 4       # blocking get from object queue i
+C_ACQUIRE = 5   # blocking acquire of resource i
+C_RELEASE = 6   # release resource i (never blocks)
+N_COMMANDS = 7
+
+
+class Command(NamedTuple):
+    """Uniform command pytree (every block returns one)."""
+
+    tag: jnp.ndarray      # i32
+    f: jnp.ndarray        # f64 payload (duration, item, amount)
+    i: jnp.ndarray        # i32 payload (queue/resource id)
+    next_pc: jnp.ndarray  # i32 block to continue at
+
+
+def _cmd(tag, f=0.0, i=0, next_pc=0) -> Command:
+    return Command(
+        jnp.asarray(tag, _I),
+        jnp.asarray(f, _R),
+        jnp.asarray(i, _I),
+        jnp.asarray(next_pc, _I),
+    )
+
+
+def hold(duration, next_pc) -> Command:
+    """Yield for `duration` sim time (parity: cmb_process_hold)."""
+    return _cmd(C_HOLD, f=duration, next_pc=next_pc)
+
+
+def exit_() -> Command:
+    """Terminate (parity: cmb_process_exit / returning from the body)."""
+    return _cmd(C_EXIT)
+
+
+def jump(next_pc) -> Command:
+    """Continue at another block without yielding."""
+    return _cmd(C_JUMP, next_pc=next_pc)
+
+
+def put(queue, item, next_pc) -> Command:
+    """Blocking put (parity: cmb_objectqueue_put)."""
+    return _cmd(C_PUT, f=item, i=queue, next_pc=next_pc)
+
+
+def get(queue, next_pc) -> Command:
+    """Blocking get (parity: cmb_objectqueue_get); the item lands in the
+    process's result register (api.got)."""
+    return _cmd(C_GET, i=queue, next_pc=next_pc)
+
+
+def acquire(resource, next_pc) -> Command:
+    """Blocking acquire of a binary resource (parity: cmb_resource_acquire)."""
+    return _cmd(C_ACQUIRE, i=resource, next_pc=next_pc)
+
+
+def release(resource, next_pc) -> Command:
+    """Release a binary resource; continues without yielding."""
+    return _cmd(C_RELEASE, i=resource, next_pc=next_pc)
+
+
+def select(pred, a: Command, b: Command) -> Command:
+    """Branch-free choice between two commands (pred ? a : b)."""
+    return Command(*[jnp.where(pred, x, y) for x, y in zip(a, b)])
+
+
+# no pending command sentinel
+NO_PEND = jnp.int32(-1)
+
+
+class Procs(NamedTuple):
+    """All processes of one replication, struct-of-arrays [P]."""
+
+    pc: jnp.ndarray        # i32 current block (global index)
+    status: jnp.ndarray    # i32 CREATED/RUNNING/FINISHED
+    prio: jnp.ndarray      # i32 current priority
+    wake_handle: jnp.ndarray  # i32 event handle of pending hold/timer
+    pend_tag: jnp.ndarray  # i32 blocked command tag, NO_PEND if none
+    pend_f: jnp.ndarray    # f64
+    pend_i: jnp.ndarray    # i32
+    pend_pc: jnp.ndarray   # i32
+    got: jnp.ndarray       # f64 result register (last GET item, ...)
+    locals_f: jnp.ndarray  # [P, NF] f64 user locals
+    locals_i: jnp.ndarray  # [P, NI] i32 user locals
+
+
+def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
+    entry = jnp.asarray(entry_pcs, _I)
+    p = entry.shape[0]
+    return Procs(
+        pc=entry,
+        status=jnp.full((p,), CREATED, _I),
+        prio=jnp.asarray(prios, _I),
+        wake_handle=jnp.full((p,), -1, _I),
+        pend_tag=jnp.full((p,), NO_PEND, _I),
+        pend_f=jnp.zeros((p,), _R),
+        pend_i=jnp.zeros((p,), _I),
+        pend_pc=jnp.zeros((p,), _I),
+        got=jnp.zeros((p,), _R),
+        locals_f=jnp.zeros((p, max(n_flocals, 1)), _R),
+        locals_i=jnp.zeros((p, max(n_ilocals, 1)), _I),
+    )

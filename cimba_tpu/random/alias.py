@@ -15,8 +15,6 @@ import jax.numpy as jnp
 
 from cimba_tpu.config import REAL_DTYPE
 from cimba_tpu.random.bits import RandomState, next_bits64
-from cimba_tpu.random.bits import to_u64
-from cimba_tpu.random.distributions import uniform01
 
 
 class AliasTable(NamedTuple):
@@ -54,10 +52,12 @@ def alias_create(weights) -> AliasTable:
 
 
 def alias_sample(st: RandomState, table: AliasTable):
-    """Sample an index (2 draws: column pick + acceptance coin)."""
+    """Sample an index: ONE 64-bit draw — low word picks the column
+    (modulo, bias n/2^32: negligible for the n <= ~1e5 tables alias
+    sampling is used for), high word is the acceptance coin."""
     n = table.prob.shape[0]
     st, b0, b1 = next_bits64(st)
-    col = (to_u64(b0, b1) % jnp.uint64(n)).astype(jnp.int32)
-    st, u = uniform01(st)
+    col = (b0 % jnp.uint32(n)).astype(jnp.int32)
+    u = b1.astype(REAL_DTYPE) * REAL_DTYPE(2.0**-32)
     take_alias = u >= table.prob[col]
     return st, jnp.where(take_alias, table.alias[col], col).astype(jnp.int64)

@@ -404,9 +404,9 @@ def poisson(st, rate):
         st, _, k = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
         return st, k.astype(jnp.int64)
 
-    # lax.cond, not select-after-both: PTRS diverges (never accepts) for
-    # rate < 10 where its constants go negative, and Knuth burns ~rate
-    # iterations for large rates — exactly one branch may run.
+    # lax.cond picks the right branch for scalar rates; under vmap with
+    # per-lane rates BOTH branches still run masked, which is why each
+    # branch clamps the rate to its own valid domain above.
     return lax.cond(rate < 10.0, knuth, ptrs, st)
 
 
@@ -435,5 +435,13 @@ def discrete_nonuniform(st, probs):
 
 
 def loaded_dice(st, a, b, probs):
+    """Integer in [a, b] with per-face weights; len(probs) must be b-a+1."""
+    probs = jnp.asarray(probs)
+    if isinstance(a, int) and isinstance(b, int):
+        if probs.shape[0] != b - a + 1:
+            raise ValueError(
+                f"loaded_dice needs {b - a + 1} weights for [{a}, {b}], "
+                f"got {probs.shape[0]}"
+            )
     st, i = discrete_nonuniform(st, probs)
     return st, a + i

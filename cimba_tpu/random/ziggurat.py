@@ -41,8 +41,12 @@ _Y_NOR = jnp.asarray(_t.Y_NOR, _R)
 def _zig_draw(st, xtab, ytab, r, v, f, tail_sample):
     """One ziggurat round-trip as a rejection while_loop (scalar-style).
 
-    Each round: 1 bits-draw for (layer, u1) + up to 1 more for the y test;
-    the tail path calls ``tail_sample``.
+    Batched-execution model: every round computes ALL paths — hot accept,
+    y-test, and ``tail_sample`` — and selects, so each round consumes the
+    draws of every path (2 bits-draws + the tail's).  That is the price of
+    branch-free vectorization and exactly why the inversion samplers in
+    ``distributions.py`` are the TPU defaults; this sampler exists for
+    parity and cross-validation (see module docstring).
     """
 
     def cond(carry):

@@ -1,0 +1,111 @@
+"""Event-set unit tests (parity with the reference's test_event/test_hashheap
+coverage: ordering contract, handles, cancel/reschedule, patterns)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu.core import eventset as ev
+
+
+def drain(es):
+    out = []
+    for _ in range(es.time.shape[0] + 1):
+        es, e = ev.pop(es)
+        if not bool(e.found):
+            break
+        out.append((float(e.time), int(e.prio), int(e.kind), int(e.subj)))
+    return es, out
+
+
+def test_orders_by_time_then_prio_desc_then_fifo():
+    es = ev.create(8)
+    # same time, different priorities; equal (time, prio) pairs keep FIFO
+    es, _ = ev.schedule(es, 5.0, 0, 1, 10, 0)
+    es, _ = ev.schedule(es, 1.0, 0, 2, 20, 0)
+    es, _ = ev.schedule(es, 5.0, 7, 3, 30, 0)   # higher prio fires first
+    es, _ = ev.schedule(es, 5.0, 0, 4, 40, 0)   # FIFO after kind=1
+    es, _ = ev.schedule(es, 0.5, -3, 5, 50, 0)
+    _, order = drain(es)
+    assert [o[2] for o in order] == [5, 2, 3, 1, 4]
+
+
+def test_cancel_and_generation_safety():
+    es = ev.create(4)
+    es, h1 = ev.schedule(es, 1.0, 0, 1, 0, 0)
+    es, h2 = ev.schedule(es, 2.0, 0, 2, 0, 0)
+    es, ok = ev.cancel(es, h1)
+    assert bool(ok)
+    es, ok2 = ev.cancel(es, h1)  # double cancel: slot gen bumped
+    assert not bool(ok2)
+    # reuse the slot; the stale handle must not hit the new event
+    es, h3 = ev.schedule(es, 0.5, 0, 3, 0, 0)
+    es, ok3 = ev.cancel(es, h1)
+    assert not bool(ok3)
+    _, order = drain(es)
+    assert [o[2] for o in order] == [3, 2]
+
+
+def test_reschedule_and_reprioritize():
+    es = ev.create(4)
+    es, h1 = ev.schedule(es, 1.0, 0, 1, 0, 0)
+    es, h2 = ev.schedule(es, 2.0, 0, 2, 0, 0)
+    es, ok = ev.reschedule(es, h2, 0.5)
+    assert bool(ok)
+    es2, order = drain(es)
+    assert [o[2] for o in order] == [2, 1]
+    # reprioritize within equal times
+    es = ev.create(4)
+    es, h1 = ev.schedule(es, 1.0, 0, 1, 0, 0)
+    es, h2 = ev.schedule(es, 1.0, 0, 2, 0, 0)
+    es, ok = ev.reprioritize(es, h2, 5)
+    assert bool(ok)
+    _, order = drain(es)
+    assert [o[2] for o in order] == [2, 1]
+
+
+def test_overflow_sets_flag_not_corruption():
+    es = ev.create(2)
+    es, h1 = ev.schedule(es, 1.0, 0, 1, 0, 0)
+    es, h2 = ev.schedule(es, 2.0, 0, 2, 0, 0)
+    assert not bool(es.overflow)
+    es, h3 = ev.schedule(es, 3.0, 0, 3, 0, 0)
+    assert bool(es.overflow) and int(h3) == int(ev.NULL_HANDLE)
+    _, order = drain(es)
+    assert [o[2] for o in order] == [1, 2]
+
+
+def test_nonfinite_time_rejected():
+    es = ev.create(2)
+    es, h = ev.schedule(es, jnp.nan, 0, 1, 0, 0)
+    assert bool(es.overflow) and int(h) == int(ev.NULL_HANDLE)
+
+
+def test_pattern_count_cancel_find():
+    es = ev.create(8)
+    es, _ = ev.schedule(es, 1.0, 0, 7, 100, 0)
+    es, _ = ev.schedule(es, 2.0, 0, 7, 200, 0)
+    es, _ = ev.schedule(es, 3.0, 0, 8, 100, 0)
+    assert int(ev.pattern_count(es, kind=7)) == 2
+    assert int(ev.pattern_count(es, subj=100)) == 2
+    assert int(ev.pattern_count(es, kind=7, subj=200)) == 1
+    assert int(ev.pattern_count(es)) == 3
+    h = ev.pattern_find(es, kind=8)
+    assert int(h) != int(ev.NULL_HANDLE)
+    es, n = ev.pattern_cancel(es, kind=7)
+    assert int(n) == 2
+    _, order = drain(es)
+    assert [o[2] for o in order] == [8]
+
+
+def test_works_under_jit_and_vmap():
+    def program(t_offsets):
+        es = ev.create(4)
+        es, _ = ev.schedule(es, 2.0 + t_offsets, 0, 1, 0, 0)
+        es, _ = ev.schedule(es, 1.0 + t_offsets, 0, 2, 0, 0)
+        es, e = ev.pop(es)
+        return e.kind, e.time
+
+    kinds, times = jax.jit(jax.vmap(program))(jnp.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(kinds), [2, 2, 2, 2])
+    np.testing.assert_allclose(np.asarray(times), [1.0, 2.0, 3.0, 4.0])

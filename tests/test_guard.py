@@ -1,0 +1,59 @@
+"""Guard wait-queue unit tests (parity: test_resourceguard coverage)."""
+
+from cimba_tpu.core import guard as gd
+
+
+def test_pop_order_prio_desc_then_fifo():
+    g = gd.create(2, 4)
+    g, _ = gd.enqueue(g, 0, 10, 0)
+    g, _ = gd.enqueue(g, 0, 11, 5)   # higher prio pops first
+    g, _ = gd.enqueue(g, 0, 12, 0)   # FIFO after 10
+    order = []
+    for _ in range(3):
+        g, pid = gd.pop_best(g, 0)
+        order.append(int(pid))
+    assert order == [11, 10, 12]
+    g, pid = gd.pop_best(g, 0)
+    assert int(pid) == int(gd.NO_PID)
+
+
+def test_guards_are_independent():
+    g = gd.create(2, 4)
+    g, _ = gd.enqueue(g, 0, 1, 0)
+    g, _ = gd.enqueue(g, 1, 2, 0)
+    assert int(gd.length(g, 0)) == 1
+    assert int(gd.length(g, 1)) == 1
+    g, pid = gd.pop_best(g, 1)
+    assert int(pid) == 2
+    assert bool(gd.is_empty(g, 1))
+    assert not bool(gd.is_empty(g, 0))
+
+
+def test_remove_specific_pid():
+    g = gd.create(1, 4)
+    g, _ = gd.enqueue(g, 0, 7, 0)
+    g, _ = gd.enqueue(g, 0, 8, 0)
+    g, existed = gd.remove(g, 0, 7)
+    assert bool(existed)
+    g, existed2 = gd.remove(g, 0, 7)
+    assert not bool(existed2)
+    g, pid = gd.pop_best(g, 0)
+    assert int(pid) == 8
+
+
+def test_reprioritize_reorders():
+    g = gd.create(1, 4)
+    g, _ = gd.enqueue(g, 0, 1, 0)
+    g, _ = gd.enqueue(g, 0, 2, 0)
+    g = gd.reprioritize(g, 0, 2, 9)
+    g, pid = gd.pop_best(g, 0)
+    assert int(pid) == 2
+
+
+def test_overflow_flag():
+    g = gd.create(1, 2)
+    g, ok1 = gd.enqueue(g, 0, 1, 0)
+    g, ok2 = gd.enqueue(g, 0, 2, 0)
+    assert bool(ok1) and bool(ok2) and not bool(g.overflow)
+    g, ok3 = gd.enqueue(g, 0, 3, 0)
+    assert not bool(ok3) and bool(g.overflow)

@@ -1,0 +1,204 @@
+"""Statistics tests: moments vs numpy, merge associativity, weighted/time-
+weighted behavior, dataset order statistics, ACF/PACF vs known processes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import cimba_tpu.stats as cs
+from cimba_tpu.stats import dataset as cds
+from cimba_tpu.stats import timeseries as cts
+
+
+def np_moments(xs):
+    mu = xs.mean()
+    c = xs - mu
+    return mu, (c**2).sum(), (c**3).sum(), (c**4).sum()
+
+
+def fold(xs, ws=None):
+    s = cs.empty()
+    if ws is None:
+        ws = np.ones(xs.shape[0])
+    for x, w in zip(xs, ws):
+        s = cs.add(s, x, w)
+    return s
+
+
+def test_summary_matches_numpy():
+    rng = np.random.default_rng(0)
+    xs = rng.normal(3.0, 2.0, size=200)
+    s = jax.jit(lambda: fold(xs))()
+    mu, m2, m3, m4 = np_moments(xs)
+    assert np.isclose(float(cs.mean(s)), mu)
+    assert np.isclose(float(s.m2), m2)
+    assert np.isclose(float(s.m3), m3, rtol=1e-8)
+    assert np.isclose(float(s.m4), m4, rtol=1e-8)
+    assert float(s.mn) == xs.min() and float(s.mx) == xs.max()
+    assert np.isclose(float(cs.variance(s)), xs.var(ddof=1))
+    assert np.isclose(
+        float(cs.skewness(s)), ((xs - mu) ** 3).mean() / xs.std() ** 3
+    )
+    assert np.isclose(
+        float(cs.kurtosis(s)), ((xs - mu) ** 4).mean() / xs.var() ** 2
+    )
+
+
+def test_merge_equals_concat():
+    rng = np.random.default_rng(1)
+    a = rng.exponential(2.0, size=150)
+    b = rng.exponential(0.5, size=75)
+    sm = cs.merge(fold(a), fold(b))
+    sc = fold(np.concatenate([a, b]))
+    for va, vb in zip(sm, sc):
+        assert np.isclose(float(va), float(vb), rtol=1e-10)
+
+
+def test_merge_with_empty_is_identity():
+    xs = np.asarray([1.0, 2.0, 5.0])
+    s = fold(xs)
+    for merged in (cs.merge(s, cs.empty()), cs.merge(cs.empty(), s)):
+        for va, vb in zip(merged, s):
+            assert float(va) == float(vb)
+
+
+def test_merge_tree_reduces_batch():
+    rng = np.random.default_rng(2)
+    xs = rng.normal(size=(13, 40))  # odd leading dim exercises the fold
+    batched = jax.vmap(lambda row: fold(row))(jnp.asarray(xs))
+    s = jax.jit(cs.merge_tree)(batched)
+    ref = fold(xs.reshape(-1))
+    assert np.isclose(float(cs.mean(s)), float(cs.mean(ref)))
+    assert np.isclose(float(s.m2), float(ref.m2), rtol=1e-10)
+    assert np.isclose(float(s.m4), float(ref.m4), rtol=1e-8)
+    assert int(s.n) == 13 * 40
+
+
+def test_weighted_summary():
+    xs = np.asarray([1.0, 10.0, 100.0])
+    ws = np.asarray([5.0, 3.0, 2.0])
+    s = fold(xs, ws)
+    mu = (xs * ws).sum() / ws.sum()
+    assert np.isclose(float(cs.mean(s)), mu)
+    m2 = (ws * (xs - mu) ** 2).sum()
+    assert np.isclose(float(s.m2), m2)
+
+
+# --- dataset ----------------------------------------------------------------
+
+
+def test_dataset_order_stats():
+    rng = np.random.default_rng(3)
+    xs = rng.uniform(0, 100, size=371)
+    ds = cds.create(512)
+    for x in xs:
+        ds = cds.add(ds, x)
+    assert int(ds.n) == 371 and int(ds.dropped) == 0
+    assert np.isclose(float(cds.mean(ds)), xs.mean())
+    assert np.isclose(float(cds.median(ds)), np.median(xs))
+    mn, q1, md, q3, mx = (float(v) for v in cds.fivenum(ds))
+    assert np.isclose(q1, np.quantile(xs, 0.25))
+    assert np.isclose(q3, np.quantile(xs, 0.75))
+    assert mn == xs.min() and mx == xs.max()
+
+
+def test_dataset_overflow_counts_drops():
+    ds = cds.create(4)
+    for x in range(7):
+        ds = cds.add(ds, float(x))
+    assert int(ds.n) == 4 and int(ds.dropped) == 3
+
+
+def test_dataset_merge():
+    a = cds.create(8)
+    b = cds.create(8)
+    for x in [1.0, 2.0]:
+        a = cds.add(a, x)
+    for x in [3.0, 4.0, 5.0]:
+        b = cds.add(b, x)
+    m = cds.merge(a, b)
+    assert int(m.n) == 5
+    assert np.isclose(float(cds.mean(m)), 3.0)
+
+
+def test_dataset_summarize_matches_fold():
+    rng = np.random.default_rng(4)
+    xs = rng.normal(size=100)
+    ds = cds.create(128)
+    for x in xs:
+        ds = cds.add(ds, x)
+    s = cds.summarize(ds)
+    mu, m2, m3, m4 = np_moments(xs)
+    assert np.isclose(float(s.m1), mu)
+    assert np.isclose(float(s.m2), m2)
+    assert np.isclose(float(s.m4), m4)
+
+
+def test_acf_of_ar1():
+    """AR(1) with phi=0.7: ACF(k) ~ 0.7^k, PACF cuts off after lag 1."""
+    rng = np.random.default_rng(5)
+    n, phi = 4000, 0.7
+    xs = np.zeros(n)
+    for i in range(1, n):
+        xs[i] = phi * xs[i - 1] + rng.normal()
+    ds = cds.create(4096)
+    for x in xs:
+        ds = cds.add(ds, x)
+    rho = np.asarray(cds.acf(ds, 5))
+    assert np.isclose(rho[0], 1.0)
+    assert abs(rho[1] - phi) < 0.06
+    assert abs(rho[2] - phi**2) < 0.08
+    pr = np.asarray(cds.pacf(ds, 4))
+    assert abs(pr[0] - phi) < 0.06
+    assert all(abs(pr[k]) < 0.08 for k in range(1, 4))
+
+
+def test_prints_render():
+    rng = np.random.default_rng(6)
+    ds = cds.create(256)
+    for x in rng.normal(size=200):
+        ds = cds.add(ds, x)
+    assert "#" in cds.histogram_str(ds)
+    assert "median" in cds.fivenum_str(ds)
+    assert "lag" in cds.correlogram_str(ds, 5)
+
+
+# --- timeseries -------------------------------------------------------------
+
+
+def test_step_accum_time_weighted_mean():
+    """Signal 0 on [0,2), 3 on [2,5), 1 on [5,10): mean = (0*2+3*3+1*5)/10."""
+    acc = cts.step_create(t0=0.0, v0=0.0)
+    acc = cts.step_record(acc, 2.0, 3.0)
+    acc = cts.step_record(acc, 5.0, 1.0)
+    s = cts.step_finalize(acc, 10.0)
+    assert np.isclose(float(cs.mean(s)), (0 * 2 + 3 * 3 + 1 * 5) / 10.0)
+    assert np.isclose(float(s.w), 10.0)
+
+
+def test_timeseries_matches_step_accum():
+    rng = np.random.default_rng(7)
+    times = np.cumsum(rng.exponential(1.0, size=50))
+    vals = rng.integers(0, 5, size=50).astype(float)
+    t_end = times[-1] + 2.0
+
+    ts = cts.create(64, t0=times[0])
+    acc = cts.step_create(t0=times[0], v0=vals[0])
+    for t, v in zip(times, vals):
+        ts = cts.add(ts, t, v)
+    for t, v in zip(times[1:], vals[1:]):
+        acc = cts.step_record(acc, t, v)
+    s_ts = cts.summarize(ts, t_end)
+    s_acc = cts.step_finalize(acc, t_end)
+    assert np.isclose(float(cs.mean(s_ts)), float(cs.mean(s_acc)))
+    assert np.isclose(float(s_ts.m2), float(s_acc.m2), rtol=1e-9)
+    assert np.isclose(float(s_ts.w), float(s_acc.w))
+
+
+def test_step_accum_zero_duration_records():
+    acc = cts.step_create(0.0, 1.0)
+    acc = cts.step_record(acc, 0.0, 2.0)  # simultaneous re-record
+    acc = cts.step_record(acc, 4.0, 0.0)
+    s = cts.step_finalize(acc, 4.0)
+    assert np.isclose(float(cs.mean(s)), 2.0)  # value 2 held all 4 units
+    assert np.isclose(float(s.w), 4.0)
