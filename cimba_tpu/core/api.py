@@ -99,3 +99,94 @@ def queue_length(sim: Sim, q):
 def resource_holder(sim: Sim, r):
     """Holding pid of a resource, -1 if free."""
     return sim.resources.holder[r.id if hasattr(r, "id") else r]
+
+
+def pool_level(sim: Sim, pool):
+    """Available units in a resource pool (parity: cmb_resourcepool_level)."""
+    return sim.pools.level[pool.id if hasattr(pool, "id") else pool]
+
+
+def buffer_level(sim: Sim, b):
+    """Stored amount in a buffer (parity: cmb_buffer_level)."""
+    return sim.buffers.level[b.id if hasattr(b, "id") else b]
+
+
+def pqueue_length(sim: Sim, q):
+    """Items in a priority queue (parity: cmb_priorityqueue_length)."""
+    qid = q.id if hasattr(q, "id") else q
+    return jnp.sum(sim.pqueues.live[qid].astype(_I))
+
+
+# --- inter-process verbs (thin wrappers over core.loop; blocks close over
+#     their model's built spec, e.g. via a late-bound `spec()` accessor) ----
+
+
+def interrupt(sim: Sim, spec, target, sig) -> Sim:
+    """Deliver ``sig`` to a waiting process now, aborting its wait
+    (parity: cmb_process_interrupt)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.interrupt(spec, sim, target, jnp.asarray(sig, _I))
+
+
+def stop_process(sim: Sim, spec, target) -> Sim:
+    """Kill a process: drop resources, cancel waits, wake waiters with
+    STOPPED (parity: cmb_process_stop)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.stop_process(spec, sim, target)
+
+
+def timer_add(sim: Sim, p, dur, sig):
+    """(sim, handle): deliver ``sig`` to p after ``dur`` unless cancelled
+    (parity: cmb_process_timer_add)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.timer_add(sim, p, dur, jnp.asarray(sig, _I))
+
+
+def timer_cancel(sim: Sim, handle):
+    """(sim, existed) — parity: cmb_process_timer_cancel."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.timer_cancel(sim, handle)
+
+
+def timers_clear(sim: Sim, p) -> Sim:
+    """Cancel all timers aimed at p (parity: cmb_process_timers_clear)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.timers_clear(sim, p)
+
+
+def priority_set(sim: Sim, p, new_prio) -> Sim:
+    """Change process priority, reshuffling event and guard queues
+    (parity: cmb_process_priority_set)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.priority_set(sim, p, new_prio)
+
+
+def cond_signal(sim: Sim, spec, condition) -> Sim:
+    """Signal a condition variable: wake every waiter whose predicate
+    holds (parity: cmb_condition_signal)."""
+    from cimba_tpu.core import loop as _loop
+
+    cid = condition.id if hasattr(condition, "id") else condition
+    return _loop.cond_signal(spec, sim, cid)
+
+
+def proc_status(sim: Sim, p):
+    """CREATED/RUNNING/FINISHED (parity: cmb_process_status)."""
+    return sim.procs.status[p]
+
+
+def schedule(sim: Sim, t, prio, handler, subj=0, arg=0) -> Sim:
+    """Schedule a user event (parity: cmb_event_schedule with an arbitrary
+    action); ``handler`` is a function registered with Model.handler."""
+    from cimba_tpu.core import loop as _loop
+
+    kind = handler.kind if hasattr(handler, "kind") else handler
+    return _loop._schedule_if(
+        sim, True, t, prio, kind, subj, arg
+    )

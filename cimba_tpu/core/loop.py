@@ -10,25 +10,29 @@ north star): ``make_run`` builds ``lax.while_loop(cond, step, sim)`` where
 ``step`` pops from the flat event set, advances the batched clock, and
 dispatches through ``lax.switch``:
 
-* kind 0 = process wakeup: resume the subject process — an inner bounded
+* kind K_PROC / K_TIMER: resume the subject process — an inner bounded
   while_loop runs its current block (``lax.switch`` over the model's block
   table) and applies the returned command, chaining while commands complete
   without yielding.  This is exactly a coroutine running until it waits,
   with (pc, locals) rows instead of a C stack.
-* kinds >= 1 = user handlers (parity: arbitrary (action, subject, object)
+* kinds >= 2 = user handlers (parity: arbitrary (action, subject, object)
   events).
 
-Everything is scalar-style over a single replication's :class:`Sim`;
-``jax.vmap`` supplies the replication axis and ``shard_map`` the mesh
-(runner/).  Blocked commands pend on guards and are *re-attempted* on
-wakeup, which reproduces the reference's loop-around-guard-wait fairness
-protocol (`src/cmb_resource.c:202-233`).
+Signal delivery contract: a yielding command's continuation block receives
+the wakeup signal as its ``sig`` argument — SUCCESS when the operation
+completed, PREEMPTED/INTERRUPTED/STOPPED/TIMEOUT/app-defined when it was
+aborted.  This is the array-world image of the reference's
+``sig = cmb_resource_acquire(...)`` return value.  Blocked commands pend
+on guards and are *re-attempted* on a SUCCESS wakeup (the reference's
+loop-around-guard-wait fairness protocol, `src/cmb_resource.c:202-233`);
+a non-SUCCESS wakeup aborts the pending operation instead (guard entry
+removed), like ``cmi_process_cancel_awaiteds``.
 
 Failure containment (parity: §3.5 error recovery, `src/cimba.c:185-209`):
 any structural failure — event/guard overflow, non-finite time, a block
-chain that never yields — sets ``sim.err`` and freezes the replication;
-the experiment runner counts and masks it, and the other replications in
-the batch are unaffected.
+chain that never yields, releasing an unheld resource — sets ``sim.err``
+and freezes the replication; the experiment runner counts and masks it,
+and the other replications in the batch are unaffected.
 """
 
 from __future__ import annotations
@@ -51,7 +55,9 @@ _I = INDEX_DTYPE
 _R = REAL_DTYPE
 _T = TIME_DTYPE
 
-K_PROC = 0  # event kind: resume process `subj` with signal `arg`
+K_PROC = 0   # resume process `subj` with signal `arg`
+K_TIMER = 1  # same dispatch; separate kind so timers_clear can pattern-cancel
+N_KINDS = 2  # user handler kinds start here
 
 # chain-safety bound: a process may not execute more than this many blocks
 # without yielding (a JUMP cycle would otherwise hang the whole batch)
@@ -78,6 +84,26 @@ class Resources(NamedTuple):
     acc: ts.StepAccum    # leaves [NR]: utilization recording
 
 
+class Pools(NamedTuple):
+    level: jnp.ndarray   # [NP] f64 available units
+    held: jnp.ndarray    # [NP, P] f64 per-process held amounts
+    acc: ts.StepAccum    # leaves [NP]: in-use recording
+
+
+class Buffers(NamedTuple):
+    level: jnp.ndarray   # [NB] f64 stored amount
+    acc: ts.StepAccum    # leaves [NB]: level recording
+
+
+class PQueues(NamedTuple):
+    items: jnp.ndarray   # [NPQ, CAP] f64 payloads
+    prio: jnp.ndarray    # [NPQ, CAP] f64 item priorities (higher first)
+    seq: jnp.ndarray     # [NPQ, CAP] i32 insertion order (FIFO tiebreak)
+    live: jnp.ndarray    # [NPQ, CAP] bool slot occupancy
+    next_seq: jnp.ndarray  # [NPQ] i32
+    acc: ts.StepAccum    # leaves [NPQ]: length recording
+
+
 class Sim(NamedTuple):
     """One replication's full state."""
 
@@ -88,6 +114,9 @@ class Sim(NamedTuple):
     guards: gd.Guards
     queues: Queues
     resources: Resources
+    pools: Pools
+    buffers: Buffers
+    pqueues: PQueues
     user: Any
     done: jnp.ndarray      # bool, set by model code (api.stop)
     err: jnp.ndarray       # i32, ERR_* (0 = healthy)
@@ -109,20 +138,22 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
     (parity: the trial-init sequence `benchmark/MM1_multi.c:91-124`)."""
     nq = max(len(spec.queues), 1)
     nr = max(len(spec.resources), 1)
+    np_ = max(len(spec.pools), 1)
+    nb = max(len(spec.buffers), 1)
+    npq = max(len(spec.pqueues), 1)
     events = ev.create(spec.event_cap)
     procs = pr.create(
         spec.proc_entry, spec.proc_prio, spec.n_flocals, spec.n_ilocals
     )
-    # start events, in pid order (FIFO among simultaneous starts)
     for pid in range(spec.n_procs):
         events, _ = ev.schedule(
             events, t0, int(spec.proc_prio[pid]), K_PROC, pid, pr.SUCCESS
         )
-    procs = procs._replace(
-        status=jnp.full((spec.n_procs,), pr.RUNNING, _I)
-    )
+    procs = procs._replace(status=jnp.full((spec.n_procs,), pr.RUNNING, _I))
     user = spec.user_init(params) if spec.user_init else jnp.zeros(())
     t0 = jnp.asarray(t0, _T)
+    pool_caps = jnp.asarray([p.capacity for p in spec.pools] or [0.0], _R)
+    buf_init = jnp.asarray([b.initial for b in spec.buffers] or [0.0], _R)
     return Sim(
         clock=t0,
         rng=rb.initialize(seed, replication),
@@ -139,12 +170,29 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
             holder=jnp.full((nr,), -1, _I),
             acc=_batched(ts.step_create(t0, 0.0), nr),
         ),
+        pools=Pools(
+            level=pool_caps,
+            held=jnp.zeros((np_, spec.n_procs), _R),
+            acc=_batched(ts.step_create(t0, 0.0), np_),
+        ),
+        buffers=Buffers(
+            level=buf_init,
+            acc=_batched(ts.step_create(t0, 0.0), nb),
+        ),
+        pqueues=PQueues(
+            items=jnp.zeros((npq, spec.pqueue_cap_max), _R),
+            prio=jnp.zeros((npq, spec.pqueue_cap_max), _R),
+            seq=jnp.zeros((npq, spec.pqueue_cap_max), _I),
+            live=jnp.zeros((npq, spec.pqueue_cap_max), jnp.bool_),
+            next_seq=jnp.zeros((npq,), _I),
+            acc=_batched(ts.step_create(t0, 0.0), npq),
+        ),
         user=user,
         done=jnp.asarray(False),
-        # an event_cap too small for even the start events is a failed
-        # replication from step zero
         err=jnp.where(
-            events.overflow, jnp.asarray(ERR_EVENT_OVERFLOW, _I), jnp.zeros((), _I)
+            events.overflow,
+            jnp.asarray(ERR_EVENT_OVERFLOW, _I),
+            jnp.zeros((), _I),
         ),
         n_events=jnp.zeros((), jnp.int64),
     )
@@ -166,6 +214,24 @@ def _schedule_if(sim: Sim, pred, t, prio, kind, subj, arg) -> Sim:
     return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
 
 
+def _schedule_wake(sim: Sim, pred, p, sig) -> Sim:
+    """Schedule an immediate resume for process p and record the handle in
+    wake_handle so _unwait can cancel it (an untracked wake would double-
+    resume a process that gets interrupted/stopped at the same timestamp)."""
+    es2, handle = ev.schedule(
+        sim.events, sim.clock, sim.procs.prio[p], K_PROC, p, sig
+    )
+    es2 = _tree_select(pred, es2, sim.events)
+    handle = jnp.where(pred, handle, sim.procs.wake_handle[p])
+    sim = sim._replace(
+        events=es2,
+        procs=sim.procs._replace(
+            wake_handle=sim.procs.wake_handle.at[p].set(handle)
+        ),
+    )
+    return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
+
+
 def _guard_signal(sim: Sim, gid) -> Sim:
     """Wake the best waiter (if any): schedule its retry at the current
     time with its process priority (parity: cmb_resourceguard_signal
@@ -174,22 +240,33 @@ def _guard_signal(sim: Sim, gid) -> Sim:
     woke = pid != gd.NO_PID
     p = jnp.maximum(pid, 0)
     sim = sim._replace(guards=g2)
-    return _schedule_if(
-        sim, woke, sim.clock, sim.procs.prio[p], K_PROC, p, pr.SUCCESS
-    )
+    return _schedule_wake(sim, woke, p, pr.SUCCESS)
 
 
 def _guard_wait(sim: Sim, p, gid, cmd: pr.Command) -> Sim:
-    """Pend the blocked command and enqueue the process on the guard."""
+    """Pend the blocked command, enqueue on the guard, and advance pc to
+    the continuation (signals deliver there if the wait is aborted)."""
     procs = sim.procs._replace(
         pend_tag=sim.procs.pend_tag.at[p].set(cmd.tag),
         pend_f=sim.procs.pend_f.at[p].set(cmd.f),
+        pend_f2=sim.procs.pend_f2.at[p].set(cmd.f2),
         pend_i=sim.procs.pend_i.at[p].set(cmd.i),
         pend_pc=sim.procs.pend_pc.at[p].set(cmd.next_pc),
+        pend_guard=sim.procs.pend_guard.at[p].set(jnp.asarray(gid, _I)),
+        pc=sim.procs.pc.at[p].set(cmd.next_pc),
     )
     g2, ok = gd.enqueue(sim.guards, gid, p, sim.procs.prio[p])
     sim = sim._replace(procs=procs, guards=g2)
     return _set_err(sim, ~ok, ERR_GUARD_OVERFLOW)
+
+
+def _clear_pend(sim: Sim, p) -> Sim:
+    return sim._replace(
+        procs=sim.procs._replace(
+            pend_tag=sim.procs.pend_tag.at[p].set(pr.NO_PEND),
+            pend_guard=sim.procs.pend_guard.at[p].set(-1),
+        )
+    )
 
 
 def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
@@ -199,23 +276,231 @@ def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
     return jax.tree.map(lambda a, u: a.at[row].set(u), acc, upd)
 
 
+def _cancel_wake(sim: Sim, p) -> Sim:
+    """Cancel p's outstanding wake event (generation-safe: a no-op if the
+    event already fired).  The analog of cancelling a stale hold timer
+    (`src/cmb_process.c:344-349`)."""
+    es2, _ = ev.cancel(sim.events, sim.procs.wake_handle[p])
+    return sim._replace(
+        events=es2,
+        procs=sim.procs._replace(wake_handle=sim.procs.wake_handle.at[p].set(-1)),
+    )
+
+
+def _unwait(sim: Sim, p) -> Sim:
+    """Detach p from whatever it waits on: guard entry, pending command,
+    wake event (parity: cmi_process_cancel_awaiteds,
+    `src/cmb_process.c:694-748`)."""
+    gid = sim.procs.pend_guard[p]
+    has_guard = gid >= 0
+    g2, _ = gd.remove(sim.guards, jnp.maximum(gid, 0), p)
+    sim = sim._replace(guards=_tree_select(has_guard, g2, sim.guards))
+    sim = _clear_pend(sim, p)
+    sim = _cancel_wake(sim, p)
+    return sim._replace(
+        procs=sim.procs._replace(await_pid=sim.procs.await_pid.at[p].set(-1))
+    )
+
+
+def _wake_waiters(sim: Sim, target, sig) -> Sim:
+    """Wake every process waiting on `target` finishing (WAIT_PROC)."""
+    n_procs = sim.procs.await_pid.shape[0]
+
+    def body(i, sim):
+        waiting = (sim.procs.await_pid[i] == target) & (
+            sim.procs.status[i] == pr.RUNNING
+        )
+        sim = _schedule_wake(sim, waiting, i, sig)
+        return sim._replace(
+            procs=sim.procs._replace(
+                await_pid=sim.procs.await_pid.at[i].set(
+                    jnp.where(waiting, -1, sim.procs.await_pid[i])
+                )
+            )
+        )
+
+    return lax.fori_loop(0, n_procs, body, sim)
+
+
+def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
+    """Terminate process p: status, waiter wakeup, resource cleanup
+    (parity: kill semantics — drop resources, cancel awaits, wake waiters,
+    `src/cmb_process.c:776-828`)."""
+    r_guard = jnp.asarray([r.guard for r in spec.resources] or [0], _I)
+    p_guard = jnp.asarray([pl.guard for pl in spec.pools] or [0], _I)
+    p_cap = jnp.asarray([pl.capacity for pl in spec.pools] or [0.0], _R)
+
+    sim = _unwait(sim, p)
+    # cancel any outstanding timers aimed at p
+    es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p)
+    sim = sim._replace(events=es2)
+    sim = sim._replace(
+        procs=sim.procs._replace(
+            status=sim.procs.status.at[p].set(pr.FINISHED),
+            exit_sig=sim.procs.exit_sig.at[p].set(jnp.asarray(exit_sig, _I)),
+        )
+    )
+    sim = _wake_waiters(sim, p, exit_sig)
+
+    # drop binary resources held by p (holdable drop protocol)
+    def drop_res(rid, sim):
+        held = sim.resources.holder[rid] == p
+        r2 = Resources(
+            holder=sim.resources.holder.at[rid].set(
+                jnp.where(held, -1, sim.resources.holder[rid])
+            ),
+            acc=_tree_select(
+                held,
+                _record_row(sim.resources.acc, rid, sim.clock, 0.0),
+                sim.resources.acc,
+            ),
+        )
+        sim = sim._replace(resources=r2)
+        g2sim = _guard_signal(sim, r_guard[rid])
+        return _tree_select(held, g2sim, sim)
+
+    # pool units held by p return to the pool
+    def drop_pool(k, sim):
+        amt = sim.pools.held[k, p]
+        has = amt > 0.0
+        p2 = Pools(
+            level=sim.pools.level.at[k].add(jnp.where(has, amt, 0.0)),
+            held=sim.pools.held.at[k, p].set(0.0),
+            acc=_tree_select(
+                has,
+                _record_row(
+                    sim.pools.acc, k, sim.clock,
+                    p_cap[k] - (sim.pools.level[k] + amt),
+                ),
+                sim.pools.acc,
+            ),
+        )
+        sim = sim._replace(pools=p2)
+        g2sim = _guard_signal(sim, p_guard[k])
+        return _tree_select(has, g2sim, sim)
+
+    sim = lax.fori_loop(0, sim.resources.holder.shape[0], drop_res, sim)
+    sim = lax.fori_loop(0, sim.pools.level.shape[0], drop_pool, sim)
+    return sim
+
+
+# --- inter-process verbs (callable from blocks via core.api) -----------------
+
+
+def interrupt(spec: ModelSpec, sim: Sim, target, sig) -> Sim:
+    """Deliver ``sig`` to a waiting process NOW, aborting whatever it waits
+    on (parity: cmb_process_interrupt, `include/cmb_process.h:406`)."""
+    target = jnp.asarray(target, _I)
+    alive = sim.procs.status[target] == pr.RUNNING
+    intr = _unwait(sim, target)
+    intr = _schedule_wake(intr, alive, target, jnp.asarray(sig, _I))
+    return _tree_select(alive, intr, sim)
+
+
+def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
+    """Kill a process (parity: cmb_process_stop, `src/cmb_process.c:803-828`):
+    drops its resources, cancels its waits/timers, wakes its waiters with
+    STOPPED."""
+    target = jnp.asarray(target, _I)
+    alive = sim.procs.status[target] == pr.RUNNING
+    stopped = finish_process(spec, sim, target, pr.STOPPED)
+    return _tree_select(alive, stopped, sim)
+
+
+def timer_add(sim: Sim, p, dur, sig):
+    """Schedule a timer delivering ``sig`` to p after ``dur`` (parity:
+    cmb_process_timer_add); returns (sim, handle)."""
+    es2, handle = ev.schedule(
+        sim.events, sim.clock + jnp.maximum(jnp.asarray(dur, _T), 0.0),
+        sim.procs.prio[p], K_TIMER, p, sig,
+    )
+    sim = sim._replace(events=es2)
+    return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW), handle
+
+
+def timer_cancel(sim: Sim, handle):
+    """Cancel a timer by handle (parity: cmb_process_timer_cancel);
+    returns (sim, existed)."""
+    es2, ok = ev.cancel(sim.events, handle)
+    return sim._replace(events=es2), ok
+
+
+def timers_clear(sim: Sim, p) -> Sim:
+    """Cancel all timers aimed at p (parity: cmb_process_timers_clear)."""
+    es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p)
+    return sim._replace(events=es2)
+
+
+def priority_set(sim: Sim, p, new_prio) -> Sim:
+    """Change a process's priority, reshuffling its wake event and guard
+    entry (parity: cmb_process_priority_set, `src/cmb_process.c:170-220`)."""
+    new_prio = jnp.asarray(new_prio, _I)
+    es2, _ = ev.reprioritize(sim.events, sim.procs.wake_handle[p], new_prio)
+    gid = sim.procs.pend_guard[p]
+    g2 = gd.reprioritize(sim.guards, jnp.maximum(gid, 0), p, new_prio)
+    g2 = _tree_select(gid >= 0, g2, sim.guards)
+    return sim._replace(
+        events=es2,
+        guards=g2,
+        procs=sim.procs._replace(prio=sim.procs.prio.at[p].set(new_prio)),
+    )
+
+
+def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
+    """Signal a condition: evaluate the predicate for every waiter and wake
+    all satisfied ones (parity: cmb_condition_signal's two-pass wake-all,
+    `src/cmb_condition.c:106-167`; the woken retry re-checks, so spurious
+    wakeups re-wait inside the framework)."""
+    if not spec.conditions:
+        return sim
+    c_guard = jnp.asarray([c.guard for c in spec.conditions], _I)
+    pred_fns = [
+        (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
+        for c in spec.conditions
+    ]
+    cid = jnp.asarray(cid, _I)
+    gid = c_guard[cid]
+
+    def visit(slot, sim):
+        pid = sim.guards.pid[gid, slot]
+        live = pid != gd.NO_PID
+        q = jnp.maximum(pid, 0)
+        satisfied = lax.switch(
+            jnp.clip(cid, 0, len(pred_fns) - 1), pred_fns, sim, q
+        )
+        wake = live & satisfied
+        g2, _ = gd.remove(sim.guards, gid, q)
+        sim2 = sim._replace(guards=g2)
+        sim2 = _schedule_wake(sim2, wake, q, pr.SUCCESS)
+        return _tree_select(wake, sim2, sim)
+
+    return lax.fori_loop(0, sim.guards.pid.shape[1], visit, sim)
+
+
 # --- command handlers ---------------------------------------------------------
 
 
 def _make_apply(spec: ModelSpec):
-    q_cap = jnp.asarray(
-        [q.capacity for q in spec.queues] or [1], _I
-    )
+    q_cap = jnp.asarray([q.capacity for q in spec.queues] or [1], _I)
     q_front = jnp.asarray([q.front_guard for q in spec.queues] or [0], _I)
     q_rear = jnp.asarray([q.rear_guard for q in spec.queues] or [0], _I)
     r_guard = jnp.asarray([r.guard for r in spec.resources] or [0], _I)
+    p_guard = jnp.asarray([p.guard for p in spec.pools] or [0], _I)
+    p_cap = jnp.asarray([p.capacity for p in spec.pools] or [0.0], _R)
+    b_cap = jnp.asarray([b.capacity for b in spec.buffers] or [0.0], _R)
+    b_front = jnp.asarray([b.front_guard for b in spec.buffers] or [0], _I)
+    b_rear = jnp.asarray([b.rear_guard for b in spec.buffers] or [0], _I)
+    pq_cap = jnp.asarray([q.capacity for q in spec.pqueues] or [1], _I)
+    pq_front = jnp.asarray([q.front_guard for q in spec.pqueues] or [0], _I)
+    pq_rear = jnp.asarray([q.rear_guard for q in spec.pqueues] or [0], _I)
+    c_guard = jnp.asarray([c.guard for c in spec.conditions] or [0], _I)
 
     def set_pc(sim, p, pc):
         return sim._replace(
             procs=sim.procs._replace(pc=sim.procs.pc.at[p].set(pc))
         )
 
-    def h_hold(sim: Sim, p, cmd: pr.Command):
+    def h_hold(sim: Sim, p, cmd: pr.Command, is_retry):
         dur = jnp.maximum(cmd.f, 0.0)
         es2, handle = ev.schedule(
             sim.events, sim.clock + dur, sim.procs.prio[p], K_PROC, p,
@@ -231,24 +516,22 @@ def _make_apply(spec: ModelSpec):
         sim = _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
         return sim, jnp.asarray(True)
 
-    def h_exit(sim: Sim, p, cmd: pr.Command):
-        sim = sim._replace(
-            procs=sim.procs._replace(
-                status=sim.procs.status.at[p].set(pr.FINISHED)
-            )
-        )
-        return sim, jnp.asarray(True)
+    def h_exit(sim: Sim, p, cmd: pr.Command, is_retry):
+        return finish_process(spec, sim, p, pr.SUCCESS), jnp.asarray(True)
 
-    def h_jump(sim: Sim, p, cmd: pr.Command):
+    def h_jump(sim: Sim, p, cmd: pr.Command, is_retry):
         return set_pc(sim, p, cmd.next_pc), jnp.asarray(False)
 
-    def h_put(sim: Sim, p, cmd: pr.Command):
+    def h_put(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
         size = sim.queues.size[qid]
         cap = q_cap[qid]
-        full = size >= cap
+        # no-jump-ahead fairness (parity: src/cmb_resource.c:202-233): a
+        # fresh caller must queue behind existing waiters; a woken caller
+        # IS the dequeued front and may proceed despite others behind it
+        may = is_retry | gd.is_empty(sim.guards, q_rear[qid])
+        full = (size >= cap) | ~may
 
-        # proceed path: ring insert at (head + size) mod cap (cap <= phys)
         col = (sim.queues.head[qid] + size) % cap
         q2 = Queues(
             items=sim.queues.items.at[qid, col].set(cmd.f),
@@ -259,16 +542,18 @@ def _make_apply(spec: ModelSpec):
             ),
         )
         ok_sim = sim._replace(queues=q2)
-        ok_sim = _guard_signal(ok_sim, q_front[qid])
+        ok_sim = _guard_signal(ok_sim, q_front[qid])  # item for getters
+        ok_sim = _guard_signal(ok_sim, q_rear[qid])   # remaining space cascade
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
 
         blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd)
         return _tree_select(full, blocked_sim, ok_sim), full
 
-    def h_get(sim: Sim, p, cmd: pr.Command):
+    def h_get(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
         size = sim.queues.size[qid]
-        empty = size <= 0
+        may = is_retry | gd.is_empty(sim.guards, q_front[qid])
+        empty = (size <= 0) | ~may
         cap = q_cap[qid]
 
         head = sim.queues.head[qid]
@@ -285,29 +570,60 @@ def _make_apply(spec: ModelSpec):
             queues=q2,
             procs=sim.procs._replace(got=sim.procs.got.at[p].set(item)),
         )
-        ok_sim = _guard_signal(ok_sim, q_rear[qid])
+        ok_sim = _guard_signal(ok_sim, q_rear[qid])   # space for putters
+        ok_sim = _guard_signal(ok_sim, q_front[qid])  # leftover items cascade
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
 
         blocked_sim = _guard_wait(sim, p, q_front[qid], cmd)
         return _tree_select(empty, blocked_sim, ok_sim), empty
 
-    def h_acquire(sim: Sim, p, cmd: pr.Command):
-        rid = cmd.i
-        free = sim.resources.holder[rid] < 0
-        may_grab = gd.is_empty(sim.guards, r_guard[rid])
-        ok = free & may_grab
-
+    def _grab_resource(sim, p, rid):
         r2 = Resources(
             holder=sim.resources.holder.at[rid].set(p),
             acc=_record_row(sim.resources.acc, rid, sim.clock, 1.0),
         )
-        ok_sim = sim._replace(resources=r2)
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        return sim._replace(resources=r2)
 
+    def h_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
+        rid = cmd.i
+        free = sim.resources.holder[rid] < 0
+        may_grab = is_retry | gd.is_empty(sim.guards, r_guard[rid])
+        ok = free & may_grab
+
+        ok_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
         blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
 
-    def h_release(sim: Sim, p, cmd: pr.Command):
+    def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
+        """Parity: cmb_resource_preempt (`src/cmb_resource.c:275-325`) —
+        grab if free; kick a holder of <= priority (it resumes with
+        PREEMPTED, its pending waits cancelled); else wait like acquire."""
+        rid = cmd.i
+        holder = sim.resources.holder[rid]
+        free = holder < 0
+        victim = jnp.maximum(holder, 0)
+        can_kick = ~free & (sim.procs.prio[p] >= sim.procs.prio[victim])
+
+        # kick path: cancel victim's awaits, deliver PREEMPTED
+        kick_sim = _unwait(sim, victim)
+        kick_sim = _schedule_wake(kick_sim, can_kick, victim, pr.PREEMPTED)
+        # holder switch: no utilization record needed (still in use)
+        kick_sim = kick_sim._replace(
+            resources=kick_sim.resources._replace(
+                holder=kick_sim.resources.holder.at[rid].set(p)
+            )
+        )
+        kick_sim = set_pc(kick_sim, p, cmd.next_pc)
+
+        free_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd)
+
+        out = _tree_select(
+            free, free_sim, _tree_select(can_kick, kick_sim, blocked_sim)
+        )
+        return out, ~free & ~can_kick
+
+    def h_release(sim: Sim, p, cmd: pr.Command, is_retry):
         rid = cmd.i
         owner_ok = sim.resources.holder[rid] == p
         r2 = Resources(
@@ -320,11 +636,212 @@ def _make_apply(spec: ModelSpec):
         sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
-    handlers = [h_hold, h_exit, h_jump, h_put, h_get, h_acquire, h_release]
+    def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
+        k = cmd.i
+        amt = cmd.f
+        enough = sim.pools.level[k] >= amt
+        may_grab = is_retry | gd.is_empty(sim.guards, p_guard[k])
+        ok = enough & may_grab
 
-    def apply_command(sim: Sim, p, cmd: pr.Command):
+        in_use = p_cap[k] - (sim.pools.level[k] - amt)
+        p2 = Pools(
+            level=sim.pools.level.at[k].add(-amt),
+            held=sim.pools.held.at[k, p].add(amt),
+            acc=_record_row(sim.pools.acc, k, sim.clock, in_use),
+        )
+        ok_sim = sim._replace(pools=p2)
+        # leftovers may satisfy the next waiter (parity: the re-signal after
+        # a successful pool grab in cmb_resourcepool.c)
+        ok_sim = _guard_signal(ok_sim, p_guard[k])
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, p_guard[k], cmd)
+        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+
+    def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
+        k = cmd.i
+        amt = jnp.minimum(cmd.f, sim.pools.held[k, p])  # partial ok
+        owner_ok = sim.pools.held[k, p] >= cmd.f - 1e-12
+        in_use = p_cap[k] - (sim.pools.level[k] + amt)
+        p2 = Pools(
+            level=sim.pools.level.at[k].add(amt),
+            held=sim.pools.held.at[k, p].add(-amt),
+            acc=_record_row(sim.pools.acc, k, sim.clock, in_use),
+        )
+        sim2 = sim._replace(pools=p2)
+        sim2 = _guard_signal(sim2, p_guard[k])
+        sim2 = set_pc(sim2, p, cmd.next_pc)
+        sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
+        return sim2, jnp.asarray(False)
+
+    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry):
+        b = cmd.i
+        amt = cmd.f
+        ok = (sim.buffers.level[b] >= amt) & (
+            is_retry | gd.is_empty(sim.guards, b_front[b])
+        )
+        b2 = Buffers(
+            level=sim.buffers.level.at[b].add(-amt),
+            acc=_record_row(
+                sim.buffers.acc, b, sim.clock, sim.buffers.level[b] - amt
+            ),
+        )
+        ok_sim = sim._replace(buffers=b2)
+        ok_sim = _guard_signal(ok_sim, b_rear[b])   # space freed for putters
+        ok_sim = _guard_signal(ok_sim, b_front[b])  # leftovers for getters
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, b_front[b], cmd)
+        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+
+    def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry):
+        b = cmd.i
+        amt = cmd.f
+        ok = (b_cap[b] - sim.buffers.level[b] >= amt) & (
+            is_retry | gd.is_empty(sim.guards, b_rear[b])
+        )
+        b2 = Buffers(
+            level=sim.buffers.level.at[b].add(amt),
+            acc=_record_row(
+                sim.buffers.acc, b, sim.clock, sim.buffers.level[b] + amt
+            ),
+        )
+        ok_sim = sim._replace(buffers=b2)
+        ok_sim = _guard_signal(ok_sim, b_front[b])  # amount for getters
+        ok_sim = _guard_signal(ok_sim, b_rear[b])   # leftover space cascade
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, b_rear[b], cmd)
+        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+
+    def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry):
+        qid = cmd.i
+        n_live = jnp.sum(sim.pqueues.live[qid].astype(_I))
+        may = is_retry | gd.is_empty(sim.guards, pq_rear[qid])
+        full = (n_live >= pq_cap[qid]) | ~may
+        free_col = jnp.argmax(~sim.pqueues.live[qid]).astype(_I)
+        pq2 = PQueues(
+            items=sim.pqueues.items.at[qid, free_col].set(cmd.f),
+            prio=sim.pqueues.prio.at[qid, free_col].set(cmd.f2),
+            seq=sim.pqueues.seq.at[qid, free_col].set(
+                sim.pqueues.next_seq[qid]
+            ),
+            live=sim.pqueues.live.at[qid, free_col].set(True),
+            next_seq=sim.pqueues.next_seq.at[qid].add(1),
+            acc=_record_row(
+                sim.pqueues.acc, qid, sim.clock, (n_live + 1).astype(_R)
+            ),
+        )
+        ok_sim = sim._replace(pqueues=pq2)
+        ok_sim = _guard_signal(ok_sim, pq_front[qid])
+        ok_sim = _guard_signal(ok_sim, pq_rear[qid])
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, pq_rear[qid], cmd)
+        return _tree_select(full, blocked_sim, ok_sim), full
+
+    def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry):
+        qid = cmd.i
+        live = sim.pqueues.live[qid]
+        may = is_retry | gd.is_empty(sim.guards, pq_front[qid])
+        empty = ~jnp.any(live) | ~may
+        n_live = jnp.sum(live.astype(_I))
+        # highest priority, then FIFO
+        neg_inf = jnp.asarray(-jnp.inf, _R)
+        p_best = jnp.max(jnp.where(live, sim.pqueues.prio[qid], neg_inf))
+        m = live & (sim.pqueues.prio[qid] == p_best)
+        s_min = jnp.min(
+            jnp.where(m, sim.pqueues.seq[qid], jnp.iinfo(jnp.int32).max)
+        )
+        col = jnp.argmax(m & (sim.pqueues.seq[qid] == s_min)).astype(_I)
+        item = sim.pqueues.items[qid, col]
+        pq2 = sim.pqueues._replace(
+            live=sim.pqueues.live.at[qid, col].set(False),
+            acc=_record_row(
+                sim.pqueues.acc, qid, sim.clock, (n_live - 1).astype(_R)
+            ),
+        )
+        ok_sim = sim._replace(
+            pqueues=pq2,
+            procs=sim.procs._replace(got=sim.procs.got.at[p].set(item)),
+        )
+        ok_sim = _guard_signal(ok_sim, pq_rear[qid])
+        ok_sim = _guard_signal(ok_sim, pq_front[qid])
+        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, pq_front[qid], cmd)
+        return _tree_select(empty, blocked_sim, ok_sim), empty
+
+    def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry):
+        """First issue always blocks until a signal (parity: the reference's
+        guard wait enqueues + yields unconditionally); a signal-driven retry
+        re-checks the predicate and re-waits if it no longer holds (the
+        documented spurious-wakeup contract, handled inside the framework)."""
+        cid = cmd.i
+        if spec.conditions:
+            pred_fns = [
+                (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
+                for c in spec.conditions
+            ]
+            satisfied = lax.switch(
+                jnp.clip(cid, 0, len(pred_fns) - 1), pred_fns, sim, p
+            )
+        else:
+            satisfied = jnp.asarray(False)
+        proceed = is_retry & satisfied
+        ok_sim = set_pc(sim, p, cmd.next_pc)
+        blocked_sim = _guard_wait(sim, p, c_guard[cid], cmd)
+        return _tree_select(proceed, ok_sim, blocked_sim), ~proceed
+
+    def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry):
+        tgt = cmd.i
+        finished = sim.procs.status[tgt] == pr.FINISHED
+        # already finished: yield anyway and deliver the target's exit
+        # signal (SUCCESS or STOPPED) through an immediate wakeup, so the
+        # continuation sees the same signal either way
+        done_sim = _schedule_wake(
+            set_pc(sim, p, cmd.next_pc), finished, p, sim.procs.exit_sig[tgt]
+        )
+        wait_sim = set_pc(
+            sim._replace(
+                procs=sim.procs._replace(
+                    await_pid=sim.procs.await_pid.at[p].set(tgt)
+                )
+            ),
+            p,
+            cmd.next_pc,
+        )
+        return _tree_select(finished, done_sim, wait_sim), jnp.asarray(True)
+
+    def h_invalid(sim: Sim, p, cmd: pr.Command, is_retry):
+        """Stub for commands whose component type the model never declared
+        — keeps the traced handler table small (compile time scales with
+        it) while turning stray commands into a contained failure."""
+        return _set_err(sim, True, ERR_USER), jnp.asarray(True)
+
+    def gate(pred, h):
+        return h if pred else h_invalid
+
+    has_q = bool(spec.queues)
+    has_r = bool(spec.resources)
+    handlers = [
+        h_hold,                                  # C_HOLD
+        h_exit,                                  # C_EXIT
+        h_jump,                                  # C_JUMP
+        gate(has_q, h_put),                      # C_PUT
+        gate(has_q, h_get),                      # C_GET
+        gate(has_r, h_acquire),                  # C_ACQUIRE
+        gate(has_r, h_release),                  # C_RELEASE
+        gate(has_r, h_preempt),                  # C_PREEMPT
+        gate(bool(spec.pools), h_pool_acquire),  # C_POOL_ACQ
+        gate(bool(spec.pools), h_pool_release),  # C_POOL_REL
+        gate(bool(spec.buffers), h_buffer_get),  # C_BUF_GET
+        gate(bool(spec.buffers), h_buffer_put),  # C_BUF_PUT
+        gate(bool(spec.pqueues), h_pq_put),      # C_PQ_PUT
+        gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET
+        gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
+        h_wait_proc,                             # C_WAIT_PROC
+    ]
+
+    def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
         return lax.switch(
-            jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p, cmd
+            jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p, cmd,
+            jnp.asarray(is_retry),
         )
 
     return apply_command
@@ -348,39 +865,65 @@ def make_step(spec: ModelSpec):
         )
 
     def resume(sim: Sim, p, sig):
-        """Resume process p: retry a pending command if one exists, then
-        chain blocks until something yields."""
+        """Resume process p with a signal: retry or abort a pending
+        command, then chain blocks until something yields."""
+        # any remaining wake event is stale once we are resumed
+        sim = _cancel_wake(sim, p)
+
         pend = pr.Command(
             sim.procs.pend_tag[p],
             sim.procs.pend_f[p],
+            sim.procs.pend_f2[p],
             sim.procs.pend_i[p],
             sim.procs.pend_pc[p],
         )
         has_pend = pend.tag != pr.NO_PEND
-        sim = sim._replace(
-            procs=sim.procs._replace(
-                pend_tag=sim.procs.pend_tag.at[p].set(pr.NO_PEND)
-            )
-        )
-        # retry pending op (or no-op)
-        retried, ry = apply_command(sim, p, pend)
-        sim = _tree_select(has_pend, retried, sim)
-        yielded = has_pend & ry
+        ok_wake = jnp.asarray(sig, _I) == pr.SUCCESS
+
+        # non-SUCCESS wake of a pended process: abort the wait — remove the
+        # guard entry; the signal flows to the continuation block below.
+        # _unwait must see the original pend_guard, so it runs BEFORE the
+        # pend bookkeeping is cleared (a cleared pend_guard would leave a
+        # zombie guard entry that steals future signals).
+        # A SUCCESS wake re-attempts the pended command as the chain's
+        # first iteration (use_pend) — handlers are traced only here.
+        aborted = _unwait(sim, p)
+        sim = _tree_select(has_pend & ~ok_wake, aborted, _clear_pend(sim, p))
+        use_pend0 = has_pend & ok_wake
 
         def cond(carry):
-            sim, sig, yielded, n = carry
+            sim, sig, yielded, n, use_pend = carry
             alive = (sim.procs.status[p] == pr.RUNNING) & (sim.err == 0)
             return ~yielded & alive & (n < MAX_CHAIN)
 
         def body(carry):
-            sim, sig, _, n = carry
-            sim, cmd = run_block(sim, p, sig)
-            sim, yielded = apply_command(sim, p, cmd)
-            return sim, jnp.asarray(pr.SUCCESS, _I), yielded, n + 1
+            sim, sig, _, n, use_pend = carry
+            sim2, cmd = lax.cond(
+                use_pend,
+                lambda s: (s, pend),
+                lambda s: run_block(s, p, sig),
+                sim,
+            )
+            sim2, yielded = apply_command(sim2, p, cmd, is_retry=use_pend)
+            return (
+                sim2,
+                jnp.asarray(pr.SUCCESS, _I),
+                yielded,
+                n + 1,
+                jnp.asarray(False),
+            )
 
         sim, _, yielded, n = lax.while_loop(
-            cond, body, (sim, jnp.asarray(sig, _I), yielded, jnp.zeros((), _I))
-        )
+            cond,
+            body,
+            (
+                sim,
+                jnp.asarray(sig, _I),
+                jnp.asarray(False),
+                jnp.zeros((), _I),
+                use_pend0,
+            ),
+        )[:4]
         return _set_err(sim, n >= MAX_CHAIN, ERR_CHAIN_RUNAWAY)
 
     def on_proc(sim: Sim, subj, arg):
@@ -392,14 +935,15 @@ def make_step(spec: ModelSpec):
         (lambda fn: (lambda sim, subj, arg: fn(sim, subj, arg)))(fn)
         for fn in spec.user_handlers
     ]
-    dispatch_fns = [on_proc] + user_handlers
+    dispatch_fns = [on_proc, on_proc] + user_handlers  # K_PROC, K_TIMER
 
     def step(sim: Sim) -> Sim:
         es2, event = ev.pop(sim.events)
         sim = sim._replace(
             events=es2,
             clock=jnp.where(event.found, event.time, sim.clock),
-            n_events=sim.n_events + jnp.where(event.found, 1, 0).astype(jnp.int64),
+            n_events=sim.n_events
+            + jnp.where(event.found, 1, 0).astype(jnp.int64),
             done=sim.done | ~event.found,
         )
         dispatched = lax.switch(

@@ -54,6 +54,41 @@ class ResourceRef:
 
 
 @dataclasses.dataclass
+class PoolRef:
+    id: int
+    name: str
+    capacity: float
+    guard: int
+
+
+@dataclasses.dataclass
+class BufferRef:
+    id: int
+    name: str
+    capacity: float
+    initial: float
+    front_guard: int  # getters wait here
+    rear_guard: int   # putters wait here
+
+
+@dataclasses.dataclass
+class PQueueRef:
+    id: int
+    name: str
+    capacity: int
+    front_guard: int
+    rear_guard: int
+
+
+@dataclasses.dataclass
+class ConditionRef:
+    id: int
+    name: str
+    guard: int
+    predicate: Callable  # predicate(sim, pid) -> bool array
+
+
+@dataclasses.dataclass
 class ProcessType:
     name: str
     entry_pc: int
@@ -73,10 +108,15 @@ class ModelSpec:
     proc_names: List[str]
     queues: List[QueueRef]
     resources: List[ResourceRef]
+    pools: List[PoolRef]
+    buffers: List[BufferRef]
+    pqueues: List[PQueueRef]
+    conditions: List[ConditionRef]
     n_guards: int
     guard_cap: int
     event_cap: int
     queue_cap_max: int
+    pqueue_cap_max: int
     n_flocals: int
     n_ilocals: int
     user_init: Optional[Callable[..., Any]]
@@ -108,6 +148,10 @@ class Model:
         self._types: List[ProcessType] = []
         self._queues: List[QueueRef] = []
         self._resources: List[ResourceRef] = []
+        self._pools: List[PoolRef] = []
+        self._buffers: List[BufferRef] = []
+        self._pqueues: List[PQueueRef] = []
+        self._conditions: List[ConditionRef] = []
         self._n_guards = 0
         self._user_init: Optional[Callable] = None
         self._user_handlers: List[Callable] = []
@@ -152,6 +196,47 @@ class Model:
         self._resources.append(r)
         return r
 
+    def resourcepool(self, name: str, capacity: float) -> PoolRef:
+        """Counting resource of ``capacity`` fungible units (parity:
+        cmb_resourcepool)."""
+        p = PoolRef(
+            id=len(self._pools), name=name, capacity=float(capacity),
+            guard=self._guard(),
+        )
+        self._pools.append(p)
+        return p
+
+    def buffer(self, name: str, capacity: float, initial: float = 0.0) -> BufferRef:
+        """Producer-consumer store of a fungible amount (parity: cmb_buffer)."""
+        b = BufferRef(
+            id=len(self._buffers), name=name, capacity=float(capacity),
+            initial=float(initial), front_guard=self._guard(),
+            rear_guard=self._guard(),
+        )
+        self._buffers.append(b)
+        return b
+
+    def priorityqueue(self, name: str, capacity: int) -> PQueueRef:
+        """Object queue ordered by per-item priority, FIFO within equal
+        priorities (parity: cmb_priorityqueue)."""
+        q = PQueueRef(
+            id=len(self._pqueues), name=name, capacity=capacity,
+            front_guard=self._guard(), rear_guard=self._guard(),
+        )
+        self._pqueues.append(q)
+        return q
+
+    def condition(self, name: str, predicate: Callable) -> ConditionRef:
+        """Condition variable: processes wait until ``predicate(sim, pid)``
+        holds at a signal (parity: cmb_condition; the reference's C
+        predicate pointer becomes a traced function registered here)."""
+        c = ConditionRef(
+            id=len(self._conditions), name=name, guard=self._guard(),
+            predicate=predicate,
+        )
+        self._conditions.append(c)
+        return c
+
     def user_state(self, fn: Callable) -> Callable:
         """Register ``fn(params) -> pytree`` building per-replication user
         state (the reference's trial struct, `include/cimba.h:100-118`)."""
@@ -162,7 +247,8 @@ class Model:
         """Register a user event handler ``fn(sim, subj, arg) -> sim``;
         sets ``fn.kind`` for use with api.schedule (parity: arbitrary
         (action, subject, object) events, `include/cmb_event.h:75-180`)."""
-        fn.kind = 1 + len(self._user_handlers)  # kind 0 = process wakeup
+        # kinds 0/1 are the framework's K_PROC/K_TIMER (core.loop)
+        fn.kind = 2 + len(self._user_handlers)
         self._user_handlers.append(fn)
         return fn
 
@@ -186,10 +272,15 @@ class Model:
             proc_names=names,
             queues=list(self._queues),
             resources=list(self._resources),
+            pools=list(self._pools),
+            buffers=list(self._buffers),
+            pqueues=list(self._pqueues),
+            conditions=list(self._conditions),
             n_guards=max(self._n_guards, 1),
             guard_cap=self.guard_cap,
             event_cap=self.event_cap,
             queue_cap_max=max([q.capacity for q in self._queues], default=1),
+            pqueue_cap_max=max([q.capacity for q in self._pqueues], default=1),
             n_flocals=self.n_flocals,
             n_ilocals=self.n_ilocals,
             user_init=self._user_init,

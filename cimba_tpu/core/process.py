@@ -46,14 +46,23 @@ RUNNING = 1   # live: executing, holding, or waiting on a guard
 FINISHED = 2
 
 # --- command tags -------------------------------------------------------------
-C_HOLD = 0      # yield for a duration                      (f=dur)
-C_EXIT = 1      # terminate the process
-C_JUMP = 2      # continue immediately at next_pc
-C_PUT = 3       # blocking put into object queue i          (f=item)
-C_GET = 4       # blocking get from object queue i
-C_ACQUIRE = 5   # blocking acquire of resource i
-C_RELEASE = 6   # release resource i (never blocks)
-N_COMMANDS = 7
+C_HOLD = 0       # yield for a duration                      (f=dur)
+C_EXIT = 1       # terminate the process
+C_JUMP = 2       # continue immediately at next_pc
+C_PUT = 3        # blocking put into object queue i          (f=item)
+C_GET = 4        # blocking get from object queue i
+C_ACQUIRE = 5    # blocking acquire of binary resource i
+C_RELEASE = 6    # release binary resource i (never blocks)
+C_PREEMPT = 7    # priority acquire of resource i (may kick the holder)
+C_POOL_ACQ = 8   # blocking acquire of f units from pool i
+C_POOL_REL = 9   # release f units back to pool i (never blocks)
+C_BUF_GET = 10   # blocking take of f units from buffer i
+C_BUF_PUT = 11   # blocking add of f units into buffer i
+C_PQ_PUT = 12    # blocking put into priority queue i        (f=item, f2=prio)
+C_PQ_GET = 13    # blocking get from priority queue i
+C_COND_WAIT = 14 # wait on condition i until signaled & predicate true
+C_WAIT_PROC = 15 # wait for process i to finish
+N_COMMANDS = 16
 
 
 class Command(NamedTuple):
@@ -61,14 +70,16 @@ class Command(NamedTuple):
 
     tag: jnp.ndarray      # i32
     f: jnp.ndarray        # f64 payload (duration, item, amount)
-    i: jnp.ndarray        # i32 payload (queue/resource id)
+    f2: jnp.ndarray       # f64 second payload (item priority, ...)
+    i: jnp.ndarray        # i32 payload (queue/resource/pool id)
     next_pc: jnp.ndarray  # i32 block to continue at
 
 
-def _cmd(tag, f=0.0, i=0, next_pc=0) -> Command:
+def _cmd(tag, f=0.0, f2=0.0, i=0, next_pc=0) -> Command:
     return Command(
         jnp.asarray(tag, _I),
         jnp.asarray(f, _R),
+        jnp.asarray(f2, _R),
         jnp.asarray(i, _I),
         jnp.asarray(next_pc, _I),
     )
@@ -110,6 +121,57 @@ def release(resource, next_pc) -> Command:
     return _cmd(C_RELEASE, i=resource, next_pc=next_pc)
 
 
+def preempt(resource, next_pc) -> Command:
+    """Priority acquire (parity: cmb_resource_preempt): takes the resource
+    from a holder of equal or lower priority (myprio >= holder prio, as in
+    `src/cmb_resource.c:294`), delivering PREEMPTED to it."""
+    return _cmd(C_PREEMPT, i=resource, next_pc=next_pc)
+
+
+def pool_acquire(pool, amount, next_pc) -> Command:
+    """Blocking acquire of ``amount`` units (parity: cmb_resourcepool_acquire)."""
+    return _cmd(C_POOL_ACQ, f=amount, i=pool, next_pc=next_pc)
+
+
+def pool_release(pool, amount, next_pc) -> Command:
+    """Release units back (parity: cmb_resourcepool_release; partial release
+    allowed)."""
+    return _cmd(C_POOL_REL, f=amount, i=pool, next_pc=next_pc)
+
+
+def buffer_get(buffer, amount, next_pc) -> Command:
+    """Take ``amount`` from a fungible store (parity: cmb_buffer_get)."""
+    return _cmd(C_BUF_GET, f=amount, i=buffer, next_pc=next_pc)
+
+
+def buffer_put(buffer, amount, next_pc) -> Command:
+    """Add ``amount`` into a fungible store (parity: cmb_buffer_put)."""
+    return _cmd(C_BUF_PUT, f=amount, i=buffer, next_pc=next_pc)
+
+
+def pq_put(pqueue, item, prio, next_pc) -> Command:
+    """Blocking put with per-item priority (parity: cmb_priorityqueue_put)."""
+    return _cmd(C_PQ_PUT, f=item, f2=prio, i=pqueue, next_pc=next_pc)
+
+
+def pq_get(pqueue, next_pc) -> Command:
+    """Blocking get of the highest-priority item (parity:
+    cmb_priorityqueue_get)."""
+    return _cmd(C_PQ_GET, i=pqueue, next_pc=next_pc)
+
+
+def cond_wait(condition, next_pc) -> Command:
+    """Wait until the condition is signaled and its predicate holds
+    (parity: cmb_condition_wait; spurious wakeups re-wait internally)."""
+    return _cmd(C_COND_WAIT, i=condition, next_pc=next_pc)
+
+
+def wait_process(pid, next_pc) -> Command:
+    """Wait for another process to finish (parity: cmb_process_wait_process);
+    delivers SUCCESS if it exited, STOPPED if it was killed."""
+    return _cmd(C_WAIT_PROC, i=pid, next_pc=next_pc)
+
+
 def select(pred, a: Command, b: Command) -> Command:
     """Branch-free choice between two commands (pred ? a : b)."""
     return Command(*[jnp.where(pred, x, y) for x, y in zip(a, b)])
@@ -128,8 +190,12 @@ class Procs(NamedTuple):
     wake_handle: jnp.ndarray  # i32 event handle of pending hold/timer
     pend_tag: jnp.ndarray  # i32 blocked command tag, NO_PEND if none
     pend_f: jnp.ndarray    # f64
+    pend_f2: jnp.ndarray   # f64
     pend_i: jnp.ndarray    # i32
     pend_pc: jnp.ndarray   # i32
+    pend_guard: jnp.ndarray  # i32 guard the process waits on, -1 if none
+    await_pid: jnp.ndarray  # i32 process this one waits for (-1 none)
+    exit_sig: jnp.ndarray  # i32 signal delivered to waiters (SUCCESS/STOPPED)
     got: jnp.ndarray       # f64 result register (last GET item, ...)
     locals_f: jnp.ndarray  # [P, NF] f64 user locals
     locals_i: jnp.ndarray  # [P, NI] i32 user locals
@@ -145,8 +211,12 @@ def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
         wake_handle=jnp.full((p,), -1, _I),
         pend_tag=jnp.full((p,), NO_PEND, _I),
         pend_f=jnp.zeros((p,), _R),
+        pend_f2=jnp.zeros((p,), _R),
         pend_i=jnp.zeros((p,), _I),
         pend_pc=jnp.zeros((p,), _I),
+        pend_guard=jnp.full((p,), -1, _I),
+        await_pid=jnp.full((p,), -1, _I),
+        exit_sig=jnp.full((p,), SUCCESS, _I),
         got=jnp.zeros((p,), _R),
         locals_f=jnp.zeros((p, max(n_flocals, 1)), _R),
         locals_i=jnp.zeros((p, max(n_ilocals, 1)), _I),

@@ -95,15 +95,31 @@ def oracle_mm1(seed, rep, n_objects, arr_mean=1.0 / 0.9, srv_mean=1.0):
     return clock, np.asarray(waits)
 
 
-def run_framework(seed, reps, n_objects):
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_exp():
+    """One spec + one jitted experiment shared by all tests (seed,
+    n_objects, reps are traced data, so every call reuses the compile)."""
     spec, _ = mm1.build()
     run = cl.make_run(spec)
 
-    def one(rep):
-        sim = cl.init_sim(spec, seed, rep, mm1.params(n_objects))
-        return run(sim)
+    @functools.partial(jax.jit, static_argnums=2)
+    def exp(seed, n_objects, reps):
+        def one(rep):
+            sim = cl.init_sim(spec, seed, rep, (1.0 / 0.9, 1.0, n_objects))
+            return run(sim)
 
-    return jax.jit(jax.vmap(one))(jnp.arange(reps))
+        return jax.vmap(one)(jnp.arange(reps))
+
+    return exp
+
+
+def run_framework(seed, reps, n_objects):
+    return _cached_exp()(
+        jnp.uint64(seed), jnp.asarray(n_objects, jnp.int32), reps
+    )
 
 
 def test_matches_oracle_exactly():

@@ -1,0 +1,606 @@
+"""Toolkit behavior tests: deterministic micro-models driving pools,
+buffers, priority queues, conditions, wait/stop/interrupt/preempt/timers.
+
+Mirrors the reference's per-component unit tests (test_resourcepool.c,
+test_buffer.c, test_priorityqueue.c, test_condition.c, test_process.c) as
+scripted scenarios with exact expected timelines — no randomness, so every
+assertion is sharp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+
+def run1(m, params=None, t_end=None):
+    spec = m.build()
+    run = cl.make_run(spec, t_end=t_end)
+    sim = cl.init_sim(spec, 0, 0, params)
+    out = jax.jit(run)(sim)
+    assert int(out.err) == 0, f"replication failed: err={int(out.err)}"
+    return out, spec
+
+
+def test_pool_contention_timeline():
+    """3 machines, 2 repairmen: third acquire waits for the first release."""
+    m = Model("repair", n_flocals=1, event_cap=16, guard_cap=4)
+    pool = m.resourcepool("repair", capacity=2.0)
+
+    @m.block
+    def fail(sim, p, sig):
+        return sim, cmd.hold((p + 1).astype(jnp.float64), next_pc=acq.pc)
+
+    @m.block
+    def acq(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 1.0, next_pc=repair.pc)
+
+    @m.block
+    def repair(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))  # grant time
+        return sim, cmd.hold(10.0, next_pc=rel.pc)
+
+    @m.block
+    def rel(sim, p, sig):
+        return sim, cmd.pool_release(pool.id, 1.0, next_pc=done.pc)
+
+    @m.block
+    def done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("machine", entry=fail, count=3)
+    out, _ = run1(m)
+    np.testing.assert_allclose(
+        np.asarray(out.procs.locals_f[:, 0]), [1.0, 2.0, 11.0]
+    )
+    assert float(out.pools.level[0]) == 2.0  # all returned
+    assert float(out.clock) == 21.0
+
+
+def test_buffer_blocks_until_amount_available():
+    m = Model("buf", n_flocals=2, event_cap=16, guard_cap=4)
+    buf = m.buffer("tank", capacity=10.0, initial=0.0)
+
+    @m.block
+    def want(sim, p, sig):
+        return sim, cmd.buffer_get(buf.id, 8.0, next_pc=got_it.pc)
+
+    @m.block
+    def got_it(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, api.buffer_level(sim, buf))
+        return sim, cmd.exit_()
+
+    @m.block
+    def fill1(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=put1.pc)
+
+    @m.block
+    def put1(sim, p, sig):
+        return sim, cmd.buffer_put(buf.id, 5.0, next_pc=fill2.pc)
+
+    @m.block
+    def fill2(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=put2.pc)
+
+    @m.block
+    def put2(sim, p, sig):
+        return sim, cmd.buffer_put(buf.id, 5.0, next_pc=pdone.pc)
+
+    @m.block
+    def pdone(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("consumer", entry=want)
+    m.process("producer", entry=fill1)
+    out, _ = run1(m)
+    # first put (level 5 < 8) wakes the consumer spuriously; it re-waits;
+    # the second put at t=2 satisfies it
+    assert float(out.procs.locals_f[0, 0]) == 2.0
+    np.testing.assert_allclose(float(out.procs.locals_f[0, 1]), 2.0)
+
+
+def test_priorityqueue_order():
+    m = Model("pq", n_flocals=3, event_cap=16, guard_cap=4)
+    pq = m.priorityqueue("jobs", capacity=8)
+
+    @m.block
+    def put_a(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 10.0, 1.0, next_pc=put_b.pc)
+
+    @m.block
+    def put_b(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 20.0, 5.0, next_pc=put_c.pc)
+
+    @m.block
+    def put_c(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 30.0, 5.0, next_pc=pdone.pc)
+
+    @m.block
+    def pdone(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def delay(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=take0.pc)
+
+    def taker(k, nxt):
+        def take(sim, p, sig):
+            return sim, cmd.pq_get(pq.id, next_pc=nxt)
+
+        return take
+
+    @m.block
+    def store0(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.got(sim, p))
+        return sim, cmd.pq_get(pq.id, next_pc=store1.pc)
+
+    @m.block
+    def store1(sim, p, sig):
+        sim = api.set_local_f(sim, p, 1, api.got(sim, p))
+        return sim, cmd.pq_get(pq.id, next_pc=store2.pc)
+
+    @m.block
+    def store2(sim, p, sig):
+        sim = api.set_local_f(sim, p, 2, api.got(sim, p))
+        return sim, cmd.exit_()
+
+    @m.block
+    def take0(sim, p, sig):
+        return sim, cmd.pq_get(pq.id, next_pc=store0.pc)
+
+    m.process("producer", entry=put_a)
+    m.process("consumer", entry=delay)
+    out, _ = run1(m)
+    # highest priority first; FIFO within priority 5: 20 then 30; then 10
+    np.testing.assert_allclose(
+        np.asarray(out.procs.locals_f[1, :]), [20.0, 30.0, 10.0]
+    )
+
+
+def test_condition_predicate_gating():
+    m = Model("cond", n_flocals=1, event_cap=16, guard_cap=4)
+
+    @m.user_state
+    def user_init(params):
+        return {"count": jnp.zeros((), jnp.float64)}
+
+    cv = m.condition("enough", lambda sim, p: sim.user["count"] >= 2.0)
+
+    @m.block
+    def waiter(sim, p, sig):
+        return sim, cmd.cond_wait(cv.id, next_pc=granted.pc)
+
+    @m.block
+    def granted(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.exit_()
+
+    @m.block
+    def tick(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=bump.pc)
+
+    @m.block
+    def bump(sim, p, sig):
+        sim = api.set_user(sim, {"count": sim.user["count"] + 1.0})
+        sim = api.cond_signal(sim, spec_holder[0], cv)
+        return sim, cmd.select(
+            sim.user["count"] >= 2.0, cmd.exit_(), cmd.jump(tick.pc)
+        )
+
+    m.process("waiter", entry=waiter)
+    m.process("incrementer", entry=tick)
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    # count hits 2 at t=2; signal at t=1 (count=1) must NOT wake the waiter
+    assert float(out.procs.locals_f[0, 0]) == 2.0
+
+
+def test_wait_process_success_and_stopped():
+    m = Model("waitp", n_flocals=2, event_cap=16, guard_cap=4)
+
+    @m.block
+    def worker(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=worker_done.pc)
+
+    @m.block
+    def worker_done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def victim(sim, p, sig):
+        return sim, cmd.hold(50.0, next_pc=worker_done.pc)
+
+    @m.block
+    def waiter1(sim, p, sig):
+        return sim, cmd.wait_process(0, next_pc=w1done.pc)  # worker pid 0
+
+    @m.block
+    def w1done(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    @m.block
+    def waiter2(sim, p, sig):
+        return sim, cmd.wait_process(1, next_pc=w1done.pc)  # victim pid 1
+
+    @m.block
+    def killer(sim, p, sig):
+        return sim, cmd.hold(3.0, next_pc=kill.pc)
+
+    @m.block
+    def kill(sim, p, sig):
+        sim = api.stop_process(sim, spec_holder[0], 1)
+        return sim, cmd.exit_()
+
+    m.process("worker", entry=worker)    # pid 0
+    m.process("victim", entry=victim)    # pid 1
+    m.process("waiter1", entry=waiter1)  # pid 2
+    m.process("waiter2", entry=waiter2)  # pid 3
+    m.process("killer", entry=killer)    # pid 4
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    # waiter1: worker exits at t=5 -> SUCCESS
+    assert float(out.procs.locals_f[2, 0]) == 5.0
+    assert int(out.procs.locals_f[2, 1]) == pr.SUCCESS
+    # waiter2: victim stopped at t=3 -> STOPPED
+    assert float(out.procs.locals_f[3, 0]) == 3.0
+    assert int(out.procs.locals_f[3, 1]) == pr.STOPPED
+    assert int(out.procs.status[1]) == pr.FINISHED
+
+
+def test_interrupt_delivers_signal_to_continuation():
+    m = Model("intr", n_flocals=2, event_cap=16, guard_cap=4)
+
+    @m.block
+    def sleeper(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=woke.pc)
+
+    @m.block
+    def woke(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    @m.block
+    def rude(sim, p, sig):
+        return sim, cmd.hold(2.0, next_pc=poke.pc)
+
+    @m.block
+    def poke(sim, p, sig):
+        sim = api.interrupt(sim, spec_holder[0], 0, -7)  # app-defined signal
+        return sim, cmd.exit_()
+
+    m.process("sleeper", entry=sleeper)  # pid 0
+    m.process("rude", entry=rude)        # pid 1
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    assert float(out.procs.locals_f[0, 0]) == 2.0
+    assert int(out.procs.locals_f[0, 1]) == -7
+    # the stale 100-unit hold wake must have been cancelled: clock stays 2
+    assert float(out.clock) == 2.0
+
+
+def test_acquire_with_timeout():
+    m = Model("timeout", n_flocals=2, event_cap=16, guard_cap=4)
+    res = m.resource("server")
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=hog_hold.pc)
+
+    @m.block
+    def hog_hold(sim, p, sig):
+        return sim, cmd.hold(50.0, next_pc=hog_rel.pc)
+
+    @m.block
+    def hog_rel(sim, p, sig):
+        return sim, cmd.release(res.id, next_pc=hog_done.pc)
+
+    @m.block
+    def hog_done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def impatient(sim, p, sig):
+        sim, _ = api.timer_add(sim, p, 5.0, pr.TIMEOUT)
+        return sim, cmd.acquire(res.id, next_pc=verdict.pc)
+
+    @m.block
+    def verdict(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    m.process("hog", entry=hog)              # pid 0
+    m.process("impatient", entry=impatient)  # pid 1
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 5.0
+    assert int(out.procs.locals_f[1, 1]) == pr.TIMEOUT
+    # the aborted waiter must be off the guard: hog still finishes cleanly
+    assert float(out.clock) == 50.0
+    assert int(out.resources.holder[0]) == -1
+
+
+def test_preempt_kicks_lower_priority_holder():
+    m = Model("preempt", n_flocals=2, event_cap=16, guard_cap=4)
+    res = m.resource("gun")
+
+    @m.block
+    def low(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=low_hold.pc)
+
+    @m.block
+    def low_hold(sim, p, sig):
+        return sim, cmd.hold(10.0, next_pc=low_after.pc)
+
+    @m.block
+    def low_after(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    @m.block
+    def high(sim, p, sig):
+        return sim, cmd.hold(2.0, next_pc=high_preempt.pc)
+
+    @m.block
+    def high_preempt(sim, p, sig):
+        return sim, cmd.preempt(res.id, next_pc=high_hold.pc)
+
+    @m.block
+    def high_hold(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=high_rel.pc)
+
+    @m.block
+    def high_rel(sim, p, sig):
+        return sim, cmd.release(res.id, next_pc=high_done.pc)
+
+    @m.block
+    def high_done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("low", entry=low, prio=0)    # pid 0
+    m.process("high", entry=high, prio=5)  # pid 1
+    out, _ = run1(m)
+    # low is kicked at t=2 with PREEMPTED (its 10-unit hold cancelled)
+    assert float(out.procs.locals_f[0, 0]) == 2.0
+    assert int(out.procs.locals_f[0, 1]) == pr.PREEMPTED
+    assert int(out.resources.holder[0]) == -1  # high released at t=3
+    assert float(out.clock) == 3.0
+
+
+def test_stop_releases_held_resources():
+    m = Model("stoprel", n_flocals=1, event_cap=16, guard_cap=4)
+    res = m.resource("tool")
+    pool = m.resourcepool("crew", capacity=3.0)
+
+    @m.block
+    def holder(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=holder_pool.pc)
+
+    @m.block
+    def holder_pool(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 2.0, next_pc=holder_hold.pc)
+
+    @m.block
+    def holder_hold(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=holder_exit.pc)
+
+    @m.block
+    def holder_exit(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def second(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=second_got.pc)
+
+    @m.block
+    def second_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.release(res.id, next_pc=holder_exit.pc)
+
+    @m.block
+    def killer(sim, p, sig):
+        return sim, cmd.hold(3.0, next_pc=kill.pc)
+
+    @m.block
+    def kill(sim, p, sig):
+        sim = api.stop_process(sim, spec_holder[0], 0)
+        return sim, cmd.exit_()
+
+    m.process("holder", entry=holder)  # pid 0
+    m.process("second", entry=second)  # pid 1, waits for the tool
+    m.process("killer", entry=killer)  # pid 2
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    # killer stops holder at t=3: tool freed -> second grabs it at t=3
+    assert float(out.procs.locals_f[1, 0]) == 3.0
+    assert float(out.pools.level[0]) == 3.0  # pool units returned
+    assert int(out.procs.status[0]) == pr.FINISHED
+
+
+def test_priority_set_reorders_guard():
+    m = Model("prioset", n_flocals=1, event_cap=16, guard_cap=4)
+    res = m.resource("desk")
+
+    @m.block
+    def first(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=first_hold.pc)
+
+    @m.block
+    def first_hold(sim, p, sig):
+        return sim, cmd.hold(10.0, next_pc=first_rel.pc)
+
+    @m.block
+    def first_rel(sim, p, sig):
+        return sim, cmd.release(res.id, next_pc=fin.pc)
+
+    @m.block
+    def fin(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def want(sim, p, sig):
+        return sim, cmd.hold((p).astype(jnp.float64) * 0.5, next_pc=claim.pc)
+
+    @m.block
+    def claim(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=got.pc)
+
+    @m.block
+    def got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.release(res.id, next_pc=fin.pc)
+
+    @m.block
+    def booster(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=boost.pc)
+
+    @m.block
+    def boost(sim, p, sig):
+        sim = api.priority_set(sim, 2, 9)  # promote the later waiter
+        return sim, cmd.exit_()
+
+    m.process("first", entry=first)          # pid 0 holds until t=10
+    m.process("claimant", entry=want, count=2)  # pids 1, 2 wait (1 first)
+    m.process("booster", entry=booster)      # pid 3 promotes pid 2 at t=5
+    out, _ = run1(m)
+    # without the boost pid 1 (earlier) would get the desk first; the
+    # boosted pid 2 overtakes it at t=10
+    assert float(out.procs.locals_f[2, 0]) == 10.0
+    assert float(out.procs.locals_f[1, 0]) == 10.0  # then pid 1, same time
+    assert int(out.err) == 0
+
+
+def test_aborted_wait_leaves_no_zombie_guard_entry():
+    """Regression: a TIMEOUT-aborted waiter must be removed from the guard;
+    a zombie entry would steal the signal meant for the next waiter."""
+    m = Model("zombie", n_flocals=2, event_cap=16, guard_cap=4)
+    res = m.resource("tool")
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=hog_hold.pc)
+
+    @m.block
+    def hog_hold(sim, p, sig):
+        return sim, cmd.hold(50.0, next_pc=hog_rel.pc)
+
+    @m.block
+    def hog_rel(sim, p, sig):
+        return sim, cmd.release(res.id, next_pc=fin.pc)
+
+    @m.block
+    def fin(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def impatient(sim, p, sig):
+        sim, _ = api.timer_add(sim, p, 5.0, pr.TIMEOUT)
+        return sim, cmd.acquire(res.id, next_pc=gave_up.pc)
+
+    @m.block
+    def gave_up(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def patient(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=pat_acq.pc)
+
+    @m.block
+    def pat_acq(sim, p, sig):
+        return sim, cmd.acquire(res.id, next_pc=pat_got.pc)
+
+    @m.block
+    def pat_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.release(res.id, next_pc=fin.pc)
+
+    m.process("hog", entry=hog)            # pid 0: holds until 50
+    m.process("impatient", entry=impatient)  # pid 1: times out at 5, exits
+    m.process("patient", entry=patient)    # pid 2: must get it at 50
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[2, 0]) == 50.0
+    assert int(out.procs.status[2]) == pr.FINISHED
+    assert int(out.resources.holder[0]) == -1
+
+
+def test_pool_release_cascades_to_all_satisfiable_waiters():
+    """Regression: one big release must wake every waiter the freed units
+    can satisfy (the reference's leftover re-signal)."""
+    m = Model("cascade", n_flocals=1, event_cap=16, guard_cap=4)
+    pool = m.resourcepool("units", capacity=10.0)
+
+    @m.block
+    def grab_all(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 10.0, next_pc=keep.pc)
+
+    @m.block
+    def keep(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=free_all.pc)
+
+    @m.block
+    def free_all(sim, p, sig):
+        return sim, cmd.pool_release(pool.id, 10.0, next_pc=fin.pc)
+
+    @m.block
+    def fin(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def want2(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=take2.pc)
+
+    @m.block
+    def take2(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 2.0, next_pc=got2.pc)
+
+    @m.block
+    def got2(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.hold(100.0, next_pc=rel2.pc)
+
+    @m.block
+    def rel2(sim, p, sig):
+        return sim, cmd.pool_release(pool.id, 2.0, next_pc=fin.pc)
+
+    m.process("hoarder", entry=grab_all)      # pid 0
+    m.process("small", entry=want2, count=2)  # pids 1, 2: both fit at t=5
+    out, _ = run1(m)
+    np.testing.assert_allclose(
+        np.asarray(out.procs.locals_f[1:3, 0]), [5.0, 5.0]
+    )
+
+
+def test_mmc_matches_erlang_c():
+    from cimba_tpu.models import mmc
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    c, lam, mu = 3, 2.4, 1.0
+    spec, _ = mmc.build(c)
+    res = ex.run_experiment(
+        spec, mmc.params(3000, lam, mu), 16, seed=11
+    )
+    assert int(res.n_failed) == 0
+    pooled = ex.pooled_summary(res.sims.user["wait"])
+    w_theory = mmc.erlang_c_sojourn(c, lam, mu)
+    assert abs(float(sm.mean(pooled)) - w_theory) < 0.25 * w_theory
