@@ -51,12 +51,23 @@ def create(n_guards: int, capacity: int) -> Guards:
     )
 
 
-def enqueue(g: Guards, gid, pid, prio):
-    """Add a waiting process; returns (g, ok)."""
+def enqueue(g: Guards, gid, pid, prio, seq_override=None):
+    """Add a waiting process; returns (g, ok, seq).
+
+    ``seq_override`` >= 0 re-enqueues with a previously-held sequence
+    number: a woken waiter whose retry failed keeps its FIFO position
+    (parity with the reference, where the front waiter is never dequeued
+    on an unsatisfied signal and so cannot lose its place)."""
     row_pid = g.pid[gid]
     free = row_pid == NO_PID
     slot = jnp.argmax(free).astype(_I)
     ok = free[slot]
+    fresh = g.next_seq[gid]
+    if seq_override is None:
+        seq = fresh
+    else:
+        so = jnp.asarray(seq_override, _I)
+        seq = jnp.where(so >= 0, so, fresh)
 
     def put(a, v):
         return a.at[gid, slot].set(jnp.where(ok, v, a[gid, slot]))
@@ -64,11 +75,13 @@ def enqueue(g: Guards, gid, pid, prio):
     g2 = Guards(
         pid=put(g.pid, jnp.asarray(pid, _I)),
         prio=put(g.prio, jnp.asarray(prio, _I)),
-        seq=put(g.seq, g.next_seq[gid]),
-        next_seq=g.next_seq.at[gid].add(jnp.where(ok, 1, 0).astype(_I)),
+        seq=put(g.seq, seq),
+        next_seq=g.next_seq.at[gid].add(
+            jnp.where(ok & (seq == fresh), 1, 0).astype(_I)
+        ),
         overflow=g.overflow | ~ok,
     )
-    return g2, ok
+    return g2, ok, seq
 
 
 def _argbest(g: Guards, gid):

@@ -145,11 +145,18 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
     procs = pr.create(
         spec.proc_entry, spec.proc_prio, spec.n_flocals, spec.n_ilocals
     )
+    start_handles = []
     for pid in range(spec.n_procs):
-        events, _ = ev.schedule(
+        events, handle = ev.schedule(
             events, t0, int(spec.proc_prio[pid]), K_PROC, pid, pr.SUCCESS
         )
-    procs = procs._replace(status=jnp.full((spec.n_procs,), pr.RUNNING, _I))
+        start_handles.append(handle)
+    procs = procs._replace(
+        status=jnp.full((spec.n_procs,), pr.RUNNING, _I),
+        # tracked like any other wake so an interrupt arriving before the
+        # start event pops cancels it instead of being swallowed
+        wake_handle=jnp.stack(start_handles).astype(_I),
+    )
     user = spec.user_init(params) if spec.user_init else jnp.zeros(())
     t0 = jnp.asarray(t0, _T)
     pool_caps = jnp.asarray([p.capacity for p in spec.pools] or [0.0], _R)
@@ -177,7 +184,11 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         ),
         buffers=Buffers(
             level=buf_init,
-            acc=_batched(ts.step_create(t0, 0.0), nb),
+            # the recorded signal starts at each buffer's *initial* level,
+            # not 0 — otherwise time-average levels are biased low
+            acc=_batched(ts.step_create(t0, 0.0), nb)._replace(
+                last_v=buf_init
+            ),
         ),
         pqueues=PQueues(
             items=jnp.zeros((npq, spec.pqueue_cap_max), _R),
@@ -243,9 +254,19 @@ def _guard_signal(sim: Sim, gid) -> Sim:
     return _schedule_wake(sim, woke, p, pr.SUCCESS)
 
 
-def _guard_wait(sim: Sim, p, gid, cmd: pr.Command) -> Sim:
+def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False) -> Sim:
     """Pend the blocked command, enqueue on the guard, and advance pc to
-    the continuation (signals deliver there if the wait is aborted)."""
+    the continuation (signals deliver there if the wait is aborted).
+
+    A retry re-enqueues with the process's original FIFO sequence so a
+    woken-but-unsatisfied waiter keeps its place (no starvation; parity
+    with the reference's evaluate-the-front-without-dequeuing signals)."""
+    seq_override = jnp.where(
+        jnp.asarray(is_retry), sim.procs.pend_seq[p], jnp.asarray(-1, _I)
+    )
+    g2, ok, seq = gd.enqueue(
+        sim.guards, gid, p, sim.procs.prio[p], seq_override=seq_override
+    )
     procs = sim.procs._replace(
         pend_tag=sim.procs.pend_tag.at[p].set(cmd.tag),
         pend_f=sim.procs.pend_f.at[p].set(cmd.f),
@@ -253,9 +274,9 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command) -> Sim:
         pend_i=sim.procs.pend_i.at[p].set(cmd.i),
         pend_pc=sim.procs.pend_pc.at[p].set(cmd.next_pc),
         pend_guard=sim.procs.pend_guard.at[p].set(jnp.asarray(gid, _I)),
+        pend_seq=sim.procs.pend_seq.at[p].set(seq),
         pc=sim.procs.pc.at[p].set(cmd.next_pc),
     )
-    g2, ok = gd.enqueue(sim.guards, gid, p, sim.procs.prio[p])
     sim = sim._replace(procs=procs, guards=g2)
     return _set_err(sim, ~ok, ERR_GUARD_OVERFLOW)
 
@@ -446,6 +467,20 @@ def priority_set(sim: Sim, p, new_prio) -> Sim:
     )
 
 
+def _cond_satisfied(spec: ModelSpec, sim: Sim, cid, pid):
+    """Evaluate condition ``cid``'s registered predicate for ``pid``."""
+    if not spec.conditions:
+        return jnp.asarray(False)
+    pred_fns = [
+        (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
+        for c in spec.conditions
+    ]
+    return lax.switch(
+        jnp.clip(jnp.asarray(cid, _I), 0, len(pred_fns) - 1), pred_fns, sim,
+        pid,
+    )
+
+
 def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
     """Signal a condition: evaluate the predicate for every waiter and wake
     all satisfied ones (parity: cmb_condition_signal's two-pass wake-all,
@@ -454,10 +489,6 @@ def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
     if not spec.conditions:
         return sim
     c_guard = jnp.asarray([c.guard for c in spec.conditions], _I)
-    pred_fns = [
-        (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
-        for c in spec.conditions
-    ]
     cid = jnp.asarray(cid, _I)
     gid = c_guard[cid]
 
@@ -465,9 +496,7 @@ def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
         pid = sim.guards.pid[gid, slot]
         live = pid != gd.NO_PID
         q = jnp.maximum(pid, 0)
-        satisfied = lax.switch(
-            jnp.clip(cid, 0, len(pred_fns) - 1), pred_fns, sim, q
-        )
+        satisfied = _cond_satisfied(spec, sim, cid, q)
         wake = live & satisfied
         g2, _ = gd.remove(sim.guards, gid, q)
         sim2 = sim._replace(guards=g2)
@@ -546,7 +575,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, q_rear[qid])   # remaining space cascade
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
 
-        blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd)
+        blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd, is_retry)
         return _tree_select(full, blocked_sim, ok_sim), full
 
     def h_get(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -574,7 +603,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, q_front[qid])  # leftover items cascade
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
 
-        blocked_sim = _guard_wait(sim, p, q_front[qid], cmd)
+        blocked_sim = _guard_wait(sim, p, q_front[qid], cmd, is_retry)
         return _tree_select(empty, blocked_sim, ok_sim), empty
 
     def _grab_resource(sim, p, rid):
@@ -591,7 +620,7 @@ def _make_apply(spec: ModelSpec):
         ok = free & may_grab
 
         ok_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd)
+        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
 
     def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -616,7 +645,7 @@ def _make_apply(spec: ModelSpec):
         kick_sim = set_pc(kick_sim, p, cmd.next_pc)
 
         free_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd)
+        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry)
 
         out = _tree_select(
             free, free_sim, _tree_select(can_kick, kick_sim, blocked_sim)
@@ -654,7 +683,7 @@ def _make_apply(spec: ModelSpec):
         # a successful pool grab in cmb_resourcepool.c)
         ok_sim = _guard_signal(ok_sim, p_guard[k])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, p_guard[k], cmd)
+        blocked_sim = _guard_wait(sim, p, p_guard[k], cmd, is_retry)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
 
     def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -689,7 +718,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, b_rear[b])   # space freed for putters
         ok_sim = _guard_signal(ok_sim, b_front[b])  # leftovers for getters
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, b_front[b], cmd)
+        blocked_sim = _guard_wait(sim, p, b_front[b], cmd, is_retry)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
 
     def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -708,7 +737,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, b_front[b])  # amount for getters
         ok_sim = _guard_signal(ok_sim, b_rear[b])   # leftover space cascade
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, b_rear[b], cmd)
+        blocked_sim = _guard_wait(sim, p, b_rear[b], cmd, is_retry)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
 
     def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -733,7 +762,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, pq_front[qid])
         ok_sim = _guard_signal(ok_sim, pq_rear[qid])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, pq_rear[qid], cmd)
+        blocked_sim = _guard_wait(sim, p, pq_rear[qid], cmd, is_retry)
         return _tree_select(full, blocked_sim, ok_sim), full
 
     def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -764,7 +793,7 @@ def _make_apply(spec: ModelSpec):
         ok_sim = _guard_signal(ok_sim, pq_rear[qid])
         ok_sim = _guard_signal(ok_sim, pq_front[qid])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, pq_front[qid], cmd)
+        blocked_sim = _guard_wait(sim, p, pq_front[qid], cmd, is_retry)
         return _tree_select(empty, blocked_sim, ok_sim), empty
 
     def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -773,19 +802,10 @@ def _make_apply(spec: ModelSpec):
         re-checks the predicate and re-waits if it no longer holds (the
         documented spurious-wakeup contract, handled inside the framework)."""
         cid = cmd.i
-        if spec.conditions:
-            pred_fns = [
-                (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
-                for c in spec.conditions
-            ]
-            satisfied = lax.switch(
-                jnp.clip(cid, 0, len(pred_fns) - 1), pred_fns, sim, p
-            )
-        else:
-            satisfied = jnp.asarray(False)
+        satisfied = _cond_satisfied(spec, sim, cid, p)
         proceed = is_retry & satisfied
         ok_sim = set_pc(sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, c_guard[cid], cmd)
+        blocked_sim = _guard_wait(sim, p, c_guard[cid], cmd, is_retry)
         return _tree_select(proceed, ok_sim, blocked_sim), ~proceed
 
     def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry):
@@ -888,7 +908,18 @@ def make_step(spec: ModelSpec):
         # A SUCCESS wake re-attempts the pended command as the chain's
         # first iteration (use_pend) — handlers are traced only here.
         aborted = _unwait(sim, p)
-        sim = _tree_select(has_pend & ~ok_wake, aborted, _clear_pend(sim, p))
+        # on a SUCCESS wake the guard entry is normally gone (popped by the
+        # signal), but a user timer with sig=SUCCESS can wake a pended
+        # process directly — remove any surviving entry so the retry can't
+        # leave a duplicate/zombie behind
+        gid = sim.procs.pend_guard[p]
+        g_clean, _ = gd.remove(sim.guards, jnp.maximum(gid, 0), p)
+        cleaned = sim._replace(
+            guards=_tree_select(gid >= 0, g_clean, sim.guards)
+        )
+        sim = _tree_select(
+            has_pend & ~ok_wake, aborted, _clear_pend(cleaned, p)
+        )
         use_pend0 = has_pend & ok_wake
 
         def cond(carry):

@@ -194,6 +194,7 @@ class Procs(NamedTuple):
     pend_i: jnp.ndarray    # i32
     pend_pc: jnp.ndarray   # i32
     pend_guard: jnp.ndarray  # i32 guard the process waits on, -1 if none
+    pend_seq: jnp.ndarray  # i32 guard FIFO position (kept across retries)
     await_pid: jnp.ndarray  # i32 process this one waits for (-1 none)
     exit_sig: jnp.ndarray  # i32 signal delivered to waiters (SUCCESS/STOPPED)
     got: jnp.ndarray       # f64 result register (last GET item, ...)
@@ -215,6 +216,7 @@ def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
         pend_i=jnp.zeros((p,), _I),
         pend_pc=jnp.zeros((p,), _I),
         pend_guard=jnp.full((p,), -1, _I),
+        pend_seq=jnp.full((p,), -1, _I),
         await_pid=jnp.full((p,), -1, _I),
         exit_sig=jnp.full((p,), SUCCESS, _I),
         got=jnp.zeros((p,), _R),

@@ -1,0 +1,131 @@
+"""AWACS radar scenario: many target agents + a scanning sensor with an
+in-step vectorized physics computation.
+
+Reference parity: the tutorial-5 AWACS scenario (`tutorial/tut_5_1.c` CPU,
+`tut_5_3.c` multi-GPU): 1000 target coroutines fly straight-line legs with
+random turn points; one sensor coroutine wakes every dwell interval and
+scores all targets (terrain-masked detection) — on the GPU via CUDA kernels
+launched from inside the coroutine.
+
+TPU rendition of "level-3 parallelism": the physics IS jax — the sensor's
+block computes detection over the whole [N, 2] position array in one
+vectorized expression (later: a Pallas kernel via the same hook — a block
+is arbitrary traced compute).  Per-target processes stay as framework
+processes (count=N instances of one type), exercising the engine at the
+reference's process counts.
+
+Model state: user["pos"] [N,2], user["vel"] [N,2] updated lazily — each
+target process re-draws its leg at leg-end events; the sensor extrapolates
+positions analytically between updates (pos + vel * (t - t_mark)), so
+movement costs nothing between events, exactly like the reference storing
+(position, velocity, t_mark) per target.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+_R = REAL_DTYPE
+_I = INDEX_DTYPE
+
+ARENA = 100.0          # square arena half-size
+SPEED = 5.0            # target speed
+LEG_MEAN = 4.0         # mean straight-leg duration
+DETECT_RANGE = 40.0    # sensor detection radius
+DWELL = 0.04 * 25      # dwell interval (scaled tut_5 pattern)
+
+
+def build(n_targets: int):
+    m = Model(
+        "awacs",
+        event_cap=2 * n_targets + 8,
+        guard_cap=2,
+    )
+
+    @m.user_state
+    def user_init(params):
+        (t_end,) = params
+        return {
+            "t_end": jnp.asarray(t_end, _R),
+            "pos": jnp.zeros((n_targets, 2), _R),
+            "vel": jnp.zeros((n_targets, 2), _R),
+            "t_mark": jnp.zeros((n_targets,), _R),
+            "detections": sm.empty(),  # per-dwell detection counts
+            "dwells": jnp.zeros((), _I),
+        }
+
+    def _current_positions(sim):
+        dt = sim.clock - sim.user["t_mark"]
+        return sim.user["pos"] + sim.user["vel"] * dt[:, None]
+
+    @m.block
+    def tgt_leg(sim, p, sig):
+        """Start a new straight leg: random heading, exponential duration."""
+        # target index within the type (targets are pids 0..N-1)
+        idx = p
+        # fold the position forward to now, then draw a new velocity
+        pos_now = sim.user["pos"][idx] + sim.user["vel"][idx] * (
+            sim.clock - sim.user["t_mark"][idx]
+        )
+        # soft-bounce: if outside the arena, head back toward the center
+        sim, heading = api.draw(sim, cr.uniform, 0.0, 2.0 * jnp.pi)
+        to_center = -pos_now
+        outside = jnp.linalg.norm(pos_now) > ARENA
+        center_heading = jnp.arctan2(to_center[1], to_center[0])
+        heading = jnp.where(outside, center_heading, heading)
+        vel = SPEED * jnp.stack([jnp.cos(heading), jnp.sin(heading)])
+        u = sim.user
+        sim = api.set_user(
+            sim,
+            {
+                **u,
+                "pos": u["pos"].at[idx].set(pos_now),
+                "vel": u["vel"].at[idx].set(vel),
+                "t_mark": u["t_mark"].at[idx].set(sim.clock),
+            },
+        )
+        sim, leg = api.draw(sim, cr.exponential, LEG_MEAN)
+        done = sim.clock >= sim.user["t_end"]
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(leg, next_pc=tgt_leg.pc)
+        )
+
+    @m.block
+    def sensor_dwell(sim, p, sig):
+        """One radar dwell: vectorized detection over ALL targets — the
+        physics hook (CUDA kernel in the reference, jax/Pallas here)."""
+        pos = _current_positions(sim)
+        r2 = jnp.sum(pos * pos, axis=1)
+        # detection: inside range with a smooth SNR-ish falloff, plus one
+        # uniform draw for the whole dwell (scan noise)
+        sim, noise = api.draw(sim, cr.uniform01)
+        p_det = jnp.clip(1.2 - jnp.sqrt(r2) / DETECT_RANGE, 0.0, 1.0)
+        detected = jnp.sum((p_det > noise).astype(_R))
+        u = sim.user
+        sim = api.set_user(
+            sim,
+            {
+                **u,
+                "detections": sm.add(u["detections"], detected),
+                "dwells": u["dwells"] + 1,
+            },
+        )
+        done = sim.clock >= sim.user["t_end"]
+        sim = api.stop(sim, done)
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(DWELL, next_pc=sensor_dwell.pc)
+        )
+
+    m.process("target", entry=tgt_leg, count=n_targets)  # pids 0..N-1
+    m.process("sensor", entry=sensor_dwell, prio=1)      # pid N
+    return m.build(), {}
+
+
+def params(t_end: float):
+    return (t_end,)

@@ -1,0 +1,92 @@
+"""Flag-mask logging from inside jitted simulation code.
+
+Reference parity: ``cmb_logger`` (`src/cmb_logger.c`) — a 32-bit flag mask
+(4 reserved levels + 28 user bits), line format
+``[trial] [seed] time process func: msg``, INFO compiled out by
+``-DNLOGINFO``, ``error`` triggering per-trial recovery.
+
+TPU rendition: the mask is *trace-time* state.  A disabled level costs
+literally nothing (the call traces to no ops — the NLOGINFO story without
+a rebuild of the library, just a re-jit); an enabled level lowers to
+``jax.debug.print`` host callbacks carrying the replication clock and pid.
+``error`` additionally sets the replication's failure flag — the analog of
+the reference's longjmp-to-worker recovery (§3.5), minus the longjmp.
+
+Changing flags affects subsequently *traced* code: re-jit (or clear jit
+caches) after flipping levels, exactly as the reference requires a
+recompile for NLOGINFO.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# reserved level bits (parity: CMB_LOGGER_* flag values)
+FATAL = 1 << 0
+ERROR = 1 << 1
+WARNING = 1 << 2
+INFO = 1 << 3
+#: first free user bit (28 available, parity with the reference's layout)
+USER = 1 << 4
+
+_mask = FATAL | ERROR | WARNING  # INFO off by default, like release builds
+
+
+def flags_on(bits: int) -> None:
+    """Enable levels (parity: cmb_logger_flags_on)."""
+    global _mask
+    _mask |= bits
+
+
+def flags_off(bits: int) -> None:
+    """Disable levels (parity: cmb_logger_flags_off)."""
+    global _mask
+    _mask &= ~bits
+
+
+def flags() -> int:
+    return _mask
+
+
+def _emit(level_name, sim, p, fmt, *args, **kwargs):
+    jax.debug.print(
+        "[{level}] t={t:.6f} p={p} err={e} | " + fmt,
+        level=level_name,
+        t=sim.clock,
+        p=p,
+        e=sim.err,
+        *args,
+        **kwargs,
+        ordered=False,
+    )
+
+
+def info(sim, p, fmt: str, *args, **kwargs):
+    """Log at INFO if enabled at trace time; returns sim unchanged."""
+    if _mask & INFO:
+        _emit("info", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def warning(sim, p, fmt: str, *args, **kwargs):
+    if _mask & WARNING:
+        _emit("warn", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def user(bit: int, sim, p, fmt: str, *args, **kwargs):
+    """Log on a user-defined flag bit (parity: the 28 user bits)."""
+    if _mask & bit:
+        _emit(f"u{bit:x}", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def error(sim, p, fmt: str, *args, **kwargs):
+    """Log AND mark the replication failed (parity: cmb_logger_error's
+    abandon-this-trial recovery — the runner counts it, the batch
+    continues)."""
+    from cimba_tpu.core import api
+
+    if _mask & ERROR:
+        _emit("error", sim, p, fmt, *args, **kwargs)
+    return api.fail(sim)

@@ -1,0 +1,268 @@
+// cimba-tpu native runtime pieces (C++17, no external deps).
+//
+// Two jobs, mirroring the reference's native layer re-imagined for this
+// framework:
+//
+// 1. Hardware entropy (parity: src/port/x86-64/linux/cmi_random_hwseed.asm
+//    — RDSEED with RDRAND retry fallback and clock mashup last resort),
+//    here via compiler intrinsics + CPUID runtime detection instead of
+//    hand assembly.
+//
+// 2. A scalar oracle engine: a plain-C++ discrete-event core implementing
+//    the exact semantics of the JAX engine (threefry2x32 streams, 32-bit
+//    uniforms, (time, prio DESC, seq) event ordering, guard pend/retry
+//    protocol) so large runs of the batched XLA path can be cross-checked
+//    against an independent sequential implementation at speeds the Python
+//    oracle cannot reach.  This inherits the role of the reference's
+//    C library as the trusted scalar ground truth.
+//
+// Exposed as a tiny extern "C" surface loaded via ctypes
+// (cimba_tpu/native/__init__.py); no pybind11 per environment constraints.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <queue>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+#include <immintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Threefry-2x32 (Salmon et al. SC'11), bitwise-identical to random/bits.py
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kParity = 0x1BD11BDAu;
+constexpr int kRotA[4] = {13, 15, 26, 6};
+constexpr int kRotB[4] = {17, 29, 16, 24};
+
+inline uint32_t rotl(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline void mix4(uint32_t& x0, uint32_t& x1, const int rot[4]) {
+  for (int i = 0; i < 4; ++i) {
+    x0 += x1;
+    x1 = rotl(x1, rot[i]);
+    x1 ^= x0;
+  }
+}
+
+void threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                  uint32_t* o0, uint32_t* o1) {
+  const uint32_t ks2 = k0 ^ k1 ^ kParity;
+  uint32_t x0 = c0 + k0;
+  uint32_t x1 = c1 + k1;
+  mix4(x0, x1, kRotA); x0 += k1;  x1 += ks2 + 1;
+  mix4(x0, x1, kRotB); x0 += ks2; x1 += k0 + 2;
+  mix4(x0, x1, kRotA); x0 += k0;  x1 += k1 + 3;
+  mix4(x0, x1, kRotB); x0 += k1;  x1 += ks2 + 4;
+  mix4(x0, x1, kRotA); x0 += ks2; x1 += k0 + 5;
+  *o0 = x0;
+  *o1 = x1;
+}
+
+uint64_t fmix64(uint64_t h) {
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDull;
+  h ^= h >> 33;
+  h *= 0xC4CEB9FE1A85EC53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+struct Stream {
+  uint32_t k0, k1, lo, hi;
+
+  static Stream init(uint64_t seed, uint64_t replication) {
+    const uint64_t mixed = fmix64(seed + 0x9E3779B97F4A7C15ull * replication);
+    return Stream{static_cast<uint32_t>(mixed & 0xFFFFFFFFull),
+                  static_cast<uint32_t>(mixed >> 32), 0u, 0u};
+  }
+
+  void next(uint32_t* b0, uint32_t* b1) {
+    threefry2x32(k0, k1, lo, hi, b0, b1);
+    if (++lo == 0u) ++hi;
+  }
+
+  // 32-bit-resolution uniform (bitwise-identical to uniform01)
+  double uniform01() {
+    uint32_t b0, b1;
+    next(&b0, &b1);
+    return static_cast<double>(b1) * 0x1p-32;
+  }
+
+  // 53-bit uniform (uniform01_53): hi word + 21 bits of the low word
+  double uniform01_53() {
+    uint32_t b0, b1;
+    next(&b0, &b1);
+    return static_cast<double>(b1) * 0x1p-32 +
+           static_cast<double>(b0 >> 11) * 0x1p-53;
+  }
+
+  double exponential(double mean) { return -std::log1p(-uniform01_53()) * mean; }
+};
+
+// ---------------------------------------------------------------------------
+// Scalar M/M/1 oracle with the engine's exact event semantics
+// ---------------------------------------------------------------------------
+
+struct Ev {
+  double t;
+  int32_t prio;
+  int32_t seq;
+  int32_t target;  // 0 arrival-start/hold-wake, 1 arrival-put, 2 service
+                   // retry/start, 3 service-done
+  double payload;
+};
+
+struct EvOrder {
+  bool operator()(const Ev& a, const Ev& b) const {
+    if (a.t != b.t) return a.t > b.t;          // min-heap on time
+    if (a.prio != b.prio) return a.prio < b.prio;  // higher prio first
+    return a.seq > b.seq;                      // FIFO
+  }
+};
+
+struct MM1Result {
+  double clock;
+  double n, mean, m2, min, max;
+  uint64_t events;
+};
+
+MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                  double arr_mean, double srv_mean) {
+  Stream rng = Stream::init(seed, rep);
+  std::priority_queue<Ev, std::vector<Ev>, EvOrder> heap;
+  int32_t seq = 0;
+  auto sched = [&](double t, int32_t target, double payload) {
+    heap.push(Ev{t, 0, seq++, target, payload});
+  };
+
+  double clock = 0.0;
+  uint64_t produced = 0, events = 0;
+  std::queue<double> fifo;
+  bool service_waiting = false;
+
+  // streaming summary (same Pebay singleton-merge as stats/summary.py)
+  double sn = 0, smean = 0, sm2 = 0, smin = HUGE_VAL, smax = -HUGE_VAL;
+  auto record = [&](double x) {
+    sn += 1.0;
+    const double d = x - smean;
+    smean += d / sn;
+    sm2 += d * (x - smean);
+    if (x < smin) smin = x;
+    if (x > smax) smax = x;
+  };
+
+  auto arrival_chain = [&]() {
+    const double t = rng.exponential(arr_mean);  // drawn even on exit pass
+    if (produced >= n_objects) return;           // arrival exits
+    sched(clock + t, 1, 0.0);
+  };
+  auto service_try = [&]() {
+    if (fifo.empty()) {
+      service_waiting = true;
+      return;
+    }
+    const double item = fifo.front();
+    fifo.pop();
+    const double t = rng.exponential(srv_mean);
+    sched(clock + t, 3, item);
+  };
+
+  sched(0.0, 0, 0.0);  // arrival start
+  sched(0.0, 2, 0.0);  // service start
+
+  bool done = false;
+  while (!heap.empty() && !done) {
+    const Ev ev = heap.top();
+    heap.pop();
+    clock = ev.t;
+    ++events;
+    switch (ev.target) {
+      case 0:
+        arrival_chain();
+        break;
+      case 1:
+        ++produced;
+        fifo.push(clock);
+        if (service_waiting) {
+          service_waiting = false;
+          sched(clock, 2, 0.0);  // guard signal -> retry event
+        }
+        arrival_chain();  // put never blocks at these capacities
+        break;
+      case 2:
+        service_try();
+        break;
+      case 3:
+        record(clock - ev.payload);
+        if (static_cast<uint64_t>(sn) >= n_objects) {
+          done = true;
+        } else {
+          service_try();
+        }
+        break;
+    }
+  }
+  return MM1Result{clock, sn, smean, sm2, smin, smax, events};
+}
+
+}  // namespace
+
+extern "C" {
+
+// Threefry known-answer access for binding sanity checks.
+void cimba_threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                        uint32_t* o0, uint32_t* o1) {
+  threefry2x32(k0, k1, c0, c1, o0, o1);
+}
+
+// Hardware entropy (parity: cmb_random_hwseed).
+uint64_t cimba_hwseed(void) {
+#if defined(__x86_64__)
+  unsigned int a, b, c, d;
+  // CPUID leaf 7: RDSEED bit EBX[18]; leaf 1: RDRAND bit ECX[30]
+  if (__get_cpuid_count(7, 0, &a, &b, &c, &d) && (b & (1u << 18))) {
+    unsigned long long v;
+    for (int i = 0; i < 64; ++i) {
+      if (_rdseed64_step(&v)) return v;
+    }
+  }
+  if (__get_cpuid(1, &a, &b, &c, &d) && (c & (1u << 30))) {
+    unsigned long long v;
+    for (int i = 0; i < 64; ++i) {
+      if (_rdrand64_step(&v)) return v;
+    }
+  }
+#endif
+  // clock mashup fallback (parity with the reference's C wrapper)
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  uint64_t v = static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+  struct timespec tm;
+  clock_gettime(CLOCK_MONOTONIC, &tm);
+  v ^= static_cast<uint64_t>(tm.tv_nsec) << 17;
+  return fmix64(v);
+}
+
+// Scalar M/M/1 oracle; outputs [clock, n, mean, m2, min, max, events].
+void cimba_oracle_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                      double arr_mean, double srv_mean, double* out7) {
+  const MM1Result r = run_mm1(seed, rep, n_objects, arr_mean, srv_mean);
+  out7[0] = r.clock;
+  out7[1] = r.n;
+  out7[2] = r.mean;
+  out7[3] = r.m2;
+  out7[4] = r.min;
+  out7[5] = r.max;
+  out7[6] = static_cast<double>(r.events);
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+"""Auxiliary subsystems: checkpoint/resume bit-identity, logger gating,
+DbC assert tiers, hwseed, debug dumps."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import checkpoint as ckpt
+from cimba_tpu.utils import dbc, debug, logger, seed as hs
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    """run(0..end) == restore(checkpoint at t=mid) then run to end."""
+    spec, _ = mm1.build()
+    run_mid = jax.jit(cl.make_run(spec, t_end=50.0))
+    run_end = jax.jit(cl.make_run(spec, t_end=120.0))
+
+    def batch(fn, sims):
+        return jax.vmap(fn)(sims)
+
+    sims0 = jax.vmap(
+        lambda r: cl.init_sim(spec, 21, r, mm1.params(10_000))
+    )(jnp.arange(4))
+
+    direct = batch(run_end, sims0)
+
+    half = batch(run_mid, sims0)
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, half)
+    restored = ckpt.restore(path, half)
+    resumed = batch(run_end, restored)
+
+    for a, b in zip(jax.tree.leaves(direct), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_structure_mismatch_rejected(tmp_path):
+    spec, _ = mm1.build()
+    sim = cl.init_sim(spec, 0, 0, mm1.params(10))
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, sim)
+    import pytest
+
+    with pytest.raises(ValueError):
+        ckpt.restore(path, {"different": jnp.zeros(3)})
+
+
+def test_logger_error_fails_replication():
+    m = Model("logerr", event_cap=8, guard_cap=2)
+
+    @m.block
+    def boom(sim, p, sig):
+        sim = logger.error(sim, p, "deliberate failure")
+        return sim, cmd.exit_()
+
+    m.process("boomer", entry=boom)
+    spec = m.build()
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+    assert int(out.err) == cl.ERR_USER
+
+
+def test_logger_info_gating_is_trace_time():
+    calls = []
+    orig = logger._emit
+    logger._emit = lambda *a, **k: calls.append(a[0])
+    try:
+        m = Model("loginfo", event_cap=8, guard_cap=2)
+
+        @m.block
+        def chatty(sim, p, sig):
+            sim = logger.info(sim, p, "hello")
+            return sim, cmd.exit_()
+
+        m.process("chatty", entry=chatty)
+        spec = m.build()
+        logger.flags_off(logger.INFO)
+        jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+        assert calls == []  # INFO disabled -> traced to nothing
+        logger.flags_on(logger.INFO)
+        jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+        assert calls == ["info"]
+    finally:
+        logger._emit = orig
+        logger.flags_off(logger.INFO)
+
+
+def test_assert_tiers():
+    m = Model("dbc", event_cap=8, guard_cap=2)
+
+    @m.block
+    def checked(sim, p, sig):
+        sim = dbc.assert_release(sim, api.clock(sim) < -1.0)  # always false
+        return sim, cmd.exit_()
+
+    m.process("checked", entry=checked)
+    spec = m.build()
+    dbc.configure(nassert=False)
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+    assert int(out.err) == cl.ERR_USER
+
+    dbc.configure(nassert=True)  # compiled out -> no failure
+    try:
+        out2 = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+        assert int(out2.err) == 0
+    finally:
+        dbc.configure(nassert=False)
+
+
+def test_hwseed_entropy():
+    seeds = {hs.hwseed() for _ in range(16)}
+    assert len(seeds) == 16
+    assert all(0 <= s < 2**64 for s in seeds)
+
+
+def test_debug_dumps_render():
+    spec, _ = mm1.build()
+    sim = cl.init_sim(spec, 0, 0, mm1.params(100))
+    step = jax.jit(cl.make_step(spec))
+    for _ in range(3):
+        sim = step(sim)
+    text = debug.sim_str(sim, spec)
+    assert "event set" in text and "arrival" in text and "service" in text
+    assert "clock=" in text

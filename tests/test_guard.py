@@ -5,9 +5,9 @@ from cimba_tpu.core import guard as gd
 
 def test_pop_order_prio_desc_then_fifo():
     g = gd.create(2, 4)
-    g, _ = gd.enqueue(g, 0, 10, 0)
-    g, _ = gd.enqueue(g, 0, 11, 5)   # higher prio pops first
-    g, _ = gd.enqueue(g, 0, 12, 0)   # FIFO after 10
+    g, _, _ = gd.enqueue(g, 0, 10, 0)
+    g, _, _ = gd.enqueue(g, 0, 11, 5)   # higher prio pops first
+    g, _, _ = gd.enqueue(g, 0, 12, 0)   # FIFO after 10
     order = []
     for _ in range(3):
         g, pid = gd.pop_best(g, 0)
@@ -19,8 +19,8 @@ def test_pop_order_prio_desc_then_fifo():
 
 def test_guards_are_independent():
     g = gd.create(2, 4)
-    g, _ = gd.enqueue(g, 0, 1, 0)
-    g, _ = gd.enqueue(g, 1, 2, 0)
+    g, _, _ = gd.enqueue(g, 0, 1, 0)
+    g, _, _ = gd.enqueue(g, 1, 2, 0)
     assert int(gd.length(g, 0)) == 1
     assert int(gd.length(g, 1)) == 1
     g, pid = gd.pop_best(g, 1)
@@ -31,8 +31,8 @@ def test_guards_are_independent():
 
 def test_remove_specific_pid():
     g = gd.create(1, 4)
-    g, _ = gd.enqueue(g, 0, 7, 0)
-    g, _ = gd.enqueue(g, 0, 8, 0)
+    g, _, _ = gd.enqueue(g, 0, 7, 0)
+    g, _, _ = gd.enqueue(g, 0, 8, 0)
     g, existed = gd.remove(g, 0, 7)
     assert bool(existed)
     g, existed2 = gd.remove(g, 0, 7)
@@ -43,8 +43,8 @@ def test_remove_specific_pid():
 
 def test_reprioritize_reorders():
     g = gd.create(1, 4)
-    g, _ = gd.enqueue(g, 0, 1, 0)
-    g, _ = gd.enqueue(g, 0, 2, 0)
+    g, _, _ = gd.enqueue(g, 0, 1, 0)
+    g, _, _ = gd.enqueue(g, 0, 2, 0)
     g = gd.reprioritize(g, 0, 2, 9)
     g, pid = gd.pop_best(g, 0)
     assert int(pid) == 2
@@ -52,8 +52,20 @@ def test_reprioritize_reorders():
 
 def test_overflow_flag():
     g = gd.create(1, 2)
-    g, ok1 = gd.enqueue(g, 0, 1, 0)
-    g, ok2 = gd.enqueue(g, 0, 2, 0)
+    g, ok1, _ = gd.enqueue(g, 0, 1, 0)
+    g, ok2, _ = gd.enqueue(g, 0, 2, 0)
     assert bool(ok1) and bool(ok2) and not bool(g.overflow)
-    g, ok3 = gd.enqueue(g, 0, 3, 0)
+    g, ok3, _ = gd.enqueue(g, 0, 3, 0)
     assert not bool(ok3) and bool(g.overflow)
+
+def test_seq_override_preserves_fifo_position():
+    """A re-enqueue with seq_override keeps the original FIFO rank."""
+    g = gd.create(1, 4)
+    g, _, seq_a = gd.enqueue(g, 0, 10, 0)
+    g, _, _ = gd.enqueue(g, 0, 11, 0)
+    g, pid = gd.pop_best(g, 0)          # pops 10 (front)
+    assert int(pid) == 10
+    g, _, seq_back = gd.enqueue(g, 0, 10, 0, seq_override=seq_a)
+    assert int(seq_back) == int(seq_a)
+    g, pid2 = gd.pop_best(g, 0)         # 10 is still in front of 11
+    assert int(pid2) == 10

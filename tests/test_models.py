@@ -1,0 +1,67 @@
+"""Job-shop and AWACS model tests: conservation laws, condition firing,
+many-process scaling, physics-hook behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import awacs, jobshop
+from cimba_tpu.stats import summary as sm
+
+
+def test_jobshop_conserves_jobs_and_runs_maintenance():
+    spec, refs = jobshop.build(backlog=4.0)
+    run = cl.make_run(spec)
+
+    def one(rep):
+        return run(cl.init_sim(spec, 5, rep, jobshop.params(300)))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(4))
+    assert int(jnp.sum(sims.err)) == 0
+    done = np.asarray(sims.user["done"].n)
+    np.testing.assert_array_equal(done, 300)  # every job completes
+    # all crew returned, WIP drained to whatever stage B hasn't pulled
+    np.testing.assert_allclose(np.asarray(sims.pools.level[:, 0]), 3.0)
+    # the backlog condition fired at least once per replication at this
+    # arrival pressure
+    assert (np.asarray(sims.user["maintenance_runs"]) >= 1).all()
+
+
+def test_jobshop_sojourn_increases_with_load():
+    spec, _ = jobshop.build()
+    run = cl.make_run(spec)
+
+    def one(rep, arr_mean):
+        return run(
+            cl.init_sim(spec, 6, rep, (arr_mean, 0.4, 200))
+        )
+
+    light = jax.jit(jax.vmap(lambda r: one(r, 2.0)))(jnp.arange(4))
+    heavy = jax.jit(jax.vmap(lambda r: one(r, 0.9)))(jnp.arange(4))
+    # completion time of the 200th job shrinks when arrivals speed up
+    assert float(heavy.clock.mean()) < float(light.clock.mean())
+
+
+def test_awacs_detects_and_scales_with_targets():
+    outs = {}
+    for n in (8, 32):
+        spec, _ = awacs.build(n)
+        run = cl.make_run(spec)
+        sim = jax.jit(run)(cl.init_sim(spec, 9, 0, awacs.params(20.0)))
+        assert int(sim.err) == 0
+        assert int(sim.user["dwells"]) > 10
+        outs[n] = float(sm.mean(sim.user["detections"]))
+    # detections per dwell scale with target count (targets start at the
+    # center, inside detection range)
+    assert outs[32] > 2.0 * outs[8]
+    assert outs[8] > 0.0
+
+
+def test_awacs_positions_stay_in_arena_neighborhood():
+    spec, _ = awacs.build(16)
+    run = cl.make_run(spec)
+    sim = jax.jit(run)(cl.init_sim(spec, 4, 0, awacs.params(50.0)))
+    pos = np.asarray(sim.user["pos"])
+    # soft-bounce keeps targets within arena + one leg's travel
+    assert np.linalg.norm(pos, axis=1).max() < awacs.ARENA + awacs.SPEED * 30

@@ -604,3 +604,71 @@ def test_mmc_matches_erlang_c():
     pooled = ex.pooled_summary(res.sims.user["wait"])
     w_theory = mmc.erlang_c_sojourn(c, lam, mu)
     assert abs(float(sm.mean(pooled)) - w_theory) < 0.25 * w_theory
+
+def test_big_demand_waiter_keeps_front_position():
+    """Regression: a woken waiter whose retry fails must keep its FIFO
+    position — a small-demand waiter behind it must not overtake (the
+    reference's no-jump-ahead/no-starvation guarantee)."""
+    m = Model("starve", n_flocals=1, event_cap=16, guard_cap=4)
+    pool = m.resourcepool("units", capacity=10.0)
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 10.0, next_pc=hog_keep.pc)
+
+    @m.block
+    def hog_keep(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=hog_dribble.pc)
+
+    @m.block
+    def hog_dribble(sim, p, sig):
+        # release 2 units at t=1, the rest at t=2
+        return sim, cmd.pool_release(pool.id, 2.0, next_pc=hog_wait2.pc)
+
+    @m.block
+    def hog_wait2(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=hog_rest.pc)
+
+    @m.block
+    def hog_rest(sim, p, sig):
+        return sim, cmd.pool_release(pool.id, 8.0, next_pc=fin2.pc)
+
+    @m.block
+    def fin2(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def big(sim, p, sig):
+        return sim, cmd.hold(0.1, next_pc=big_acq.pc)
+
+    @m.block
+    def big_acq(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 8.0, next_pc=big_got.pc)
+
+    @m.block
+    def big_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.pool_release(pool.id, 8.0, next_pc=fin2.pc)
+
+    @m.block
+    def small(sim, p, sig):
+        return sim, cmd.hold(0.2, next_pc=small_acq.pc)
+
+    @m.block
+    def small_acq(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 2.0, next_pc=small_got.pc)
+
+    @m.block
+    def small_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.pool_release(pool.id, 2.0, next_pc=fin2.pc)
+
+    m.process("hog", entry=hog)      # pid 0
+    m.process("big", entry=big)      # pid 1: queues first, wants 8
+    m.process("small", entry=small)  # pid 2: queues second, wants 2
+    out, _ = run1(m)
+    # at t=1 only 2 units free: big (front) retries, fails, KEEPS front;
+    # small must NOT sneak in; at t=2 all 10 free: big gets 8 first, and
+    # its grant re-signal lets small take 2 at the same instant
+    assert float(out.procs.locals_f[1, 0]) == 2.0  # big got at t=2
+    assert float(out.procs.locals_f[2, 0]) == 2.0  # small after big, same t
