@@ -30,7 +30,7 @@ BASELINE_EVENTS_PER_SEC = 375e6  # 64-core reference aggregate
 
 
 def main():
-    spec, _ = mm1.build()
+    spec, _ = mm1.build(record=False)  # benchmark build, like -DNLOGINFO
     run = cl.make_run(spec)
 
     def experiment(n_objects):

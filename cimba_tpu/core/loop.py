@@ -167,37 +167,60 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         events=events,
         procs=procs,
         guards=gd.create(spec.n_guards, spec.guard_cap),
+        # absent components carry no state at all (None prunes the
+        # pytree — the while_loop body then never touches those leaves),
+        # and recording accumulators exist only if some member records
         queues=Queues(
             items=jnp.zeros((nq, spec.queue_cap_max), _R),
             head=jnp.zeros((nq,), _I),
             size=jnp.zeros((nq,), _I),
-            acc=_batched(ts.step_create(t0, 0.0), nq),
-        ),
+            acc=_batched(ts.step_create(t0, 0.0), nq)
+            if any(q.record for q in spec.queues)
+            else None,
+        )
+        if spec.queues
+        else None,
         resources=Resources(
             holder=jnp.full((nr,), -1, _I),
-            acc=_batched(ts.step_create(t0, 0.0), nr),
-        ),
+            acc=_batched(ts.step_create(t0, 0.0), nr)
+            if any(r.record for r in spec.resources)
+            else None,
+        )
+        if spec.resources
+        else None,
         pools=Pools(
             level=pool_caps,
             held=jnp.zeros((np_, spec.n_procs), _R),
-            acc=_batched(ts.step_create(t0, 0.0), np_),
-        ),
+            acc=_batched(ts.step_create(t0, 0.0), np_)
+            if any(pl.record for pl in spec.pools)
+            else None,
+        )
+        if spec.pools
+        else None,
         buffers=Buffers(
             level=buf_init,
             # the recorded signal starts at each buffer's *initial* level,
             # not 0 — otherwise time-average levels are biased low
             acc=_batched(ts.step_create(t0, 0.0), nb)._replace(
                 last_v=buf_init
-            ),
-        ),
+            )
+            if any(b.record for b in spec.buffers)
+            else None,
+        )
+        if spec.buffers
+        else None,
         pqueues=PQueues(
             items=jnp.zeros((npq, spec.pqueue_cap_max), _R),
             prio=jnp.zeros((npq, spec.pqueue_cap_max), _R),
             seq=jnp.zeros((npq, spec.pqueue_cap_max), _I),
             live=jnp.zeros((npq, spec.pqueue_cap_max), jnp.bool_),
             next_seq=jnp.zeros((npq,), _I),
-            acc=_batched(ts.step_create(t0, 0.0), npq),
-        ),
+            acc=_batched(ts.step_create(t0, 0.0), npq)
+            if any(q.record for q in spec.pqueues)
+            else None,
+        )
+        if spec.pqueues
+        else None,
         user=user,
         done=jnp.asarray(False),
         err=jnp.where(
@@ -297,6 +320,20 @@ def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
     return jax.tree.map(lambda a, u: a.at[row].set(u), acc, upd)
 
 
+def _record_row_if(flags, acc, row, t, v):
+    """Recording gated by per-component static flags: traces to nothing
+    when no component records (parity: the reference's optional recording
+    — a documented hot-loop cost), and to a masked update when only some
+    do."""
+    if acc is None or not any(flags):
+        return acc
+    rec = _record_row(acc, row, t, v)
+    if all(flags):
+        return rec
+    mask = jnp.asarray(flags)[row]
+    return _tree_select(mask, rec, acc)
+
+
 def _cancel_wake(sim: Sim, p) -> Sim:
     """Cancel p's outstanding wake event (generation-safe: a no-op if the
     event already fired).  The analog of cancelling a stale hold timer
@@ -351,6 +388,9 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     p_guard = jnp.asarray([pl.guard for pl in spec.pools] or [0], _I)
     p_cap = jnp.asarray([pl.capacity for pl in spec.pools] or [0.0], _R)
 
+    r_rec = [r.record for r in spec.resources]
+    p_rec = [pl.record for pl in spec.pools]
+
     sim = _unwait(sim, p)
     # cancel any outstanding timers aimed at p
     es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p)
@@ -372,7 +412,7 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
             ),
             acc=_tree_select(
                 held,
-                _record_row(sim.resources.acc, rid, sim.clock, 0.0),
+                _record_row_if(r_rec, sim.resources.acc, rid, sim.clock, 0.0),
                 sim.resources.acc,
             ),
         )
@@ -389,8 +429,8 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
             held=sim.pools.held.at[k, p].set(0.0),
             acc=_tree_select(
                 has,
-                _record_row(
-                    sim.pools.acc, k, sim.clock,
+                _record_row_if(
+                    p_rec, sim.pools.acc, k, sim.clock,
                     p_cap[k] - (sim.pools.level[k] + amt),
                 ),
                 sim.pools.acc,
@@ -400,8 +440,10 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
         g2sim = _guard_signal(sim, p_guard[k])
         return _tree_select(has, g2sim, sim)
 
-    sim = lax.fori_loop(0, sim.resources.holder.shape[0], drop_res, sim)
-    sim = lax.fori_loop(0, sim.pools.level.shape[0], drop_pool, sim)
+    if spec.resources:
+        sim = lax.fori_loop(0, sim.resources.holder.shape[0], drop_res, sim)
+    if spec.pools:
+        sim = lax.fori_loop(0, sim.pools.level.shape[0], drop_pool, sim)
     return sim
 
 
@@ -523,6 +565,11 @@ def _make_apply(spec: ModelSpec):
     pq_front = jnp.asarray([q.front_guard for q in spec.pqueues] or [0], _I)
     pq_rear = jnp.asarray([q.rear_guard for q in spec.pqueues] or [0], _I)
     c_guard = jnp.asarray([c.guard for c in spec.conditions] or [0], _I)
+    q_rec = [q.record for q in spec.queues]
+    r_rec = [r.record for r in spec.resources]
+    p_rec = [pl.record for pl in spec.pools]
+    b_rec = [b.record for b in spec.buffers]
+    pq_rec = [q.record for q in spec.pqueues]
 
     def set_pc(sim, p, pc):
         return sim._replace(
@@ -566,13 +613,14 @@ def _make_apply(spec: ModelSpec):
             items=sim.queues.items.at[qid, col].set(cmd.f),
             head=sim.queues.head,
             size=sim.queues.size.at[qid].add(1),
-            acc=_record_row(
-                sim.queues.acc, qid, sim.clock, (size + 1).astype(_R)
+            acc=_record_row_if(
+                q_rec, sim.queues.acc, qid, sim.clock, (size + 1).astype(_R)
             ),
         )
         ok_sim = sim._replace(queues=q2)
-        ok_sim = _guard_signal(ok_sim, q_front[qid])  # item for getters
-        ok_sim = _guard_signal(ok_sim, q_rear[qid])   # remaining space cascade
+        # a successful put frees no space, so only the getter side can
+        # newly be satisfiable
+        ok_sim = _guard_signal(ok_sim, q_front[qid])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
 
         blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd, is_retry)
@@ -591,8 +639,8 @@ def _make_apply(spec: ModelSpec):
             items=sim.queues.items,
             head=sim.queues.head.at[qid].set((head + 1) % cap),
             size=sim.queues.size.at[qid].add(-1),
-            acc=_record_row(
-                sim.queues.acc, qid, sim.clock, (size - 1).astype(_R)
+            acc=_record_row_if(
+                q_rec, sim.queues.acc, qid, sim.clock, (size - 1).astype(_R)
             ),
         )
         ok_sim = sim._replace(
@@ -609,7 +657,9 @@ def _make_apply(spec: ModelSpec):
     def _grab_resource(sim, p, rid):
         r2 = Resources(
             holder=sim.resources.holder.at[rid].set(p),
-            acc=_record_row(sim.resources.acc, rid, sim.clock, 1.0),
+            acc=_record_row_if(
+                r_rec, sim.resources.acc, rid, sim.clock, 1.0
+            ),
         )
         return sim._replace(resources=r2)
 
@@ -657,7 +707,9 @@ def _make_apply(spec: ModelSpec):
         owner_ok = sim.resources.holder[rid] == p
         r2 = Resources(
             holder=sim.resources.holder.at[rid].set(-1),
-            acc=_record_row(sim.resources.acc, rid, sim.clock, 0.0),
+            acc=_record_row_if(
+                r_rec, sim.resources.acc, rid, sim.clock, 0.0
+            ),
         )
         sim2 = sim._replace(resources=r2)
         sim2 = _guard_signal(sim2, r_guard[rid])
@@ -676,7 +728,7 @@ def _make_apply(spec: ModelSpec):
         p2 = Pools(
             level=sim.pools.level.at[k].add(-amt),
             held=sim.pools.held.at[k, p].add(amt),
-            acc=_record_row(sim.pools.acc, k, sim.clock, in_use),
+            acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
         )
         ok_sim = sim._replace(pools=p2)
         # leftovers may satisfy the next waiter (parity: the re-signal after
@@ -694,7 +746,7 @@ def _make_apply(spec: ModelSpec):
         p2 = Pools(
             level=sim.pools.level.at[k].add(amt),
             held=sim.pools.held.at[k, p].add(-amt),
-            acc=_record_row(sim.pools.acc, k, sim.clock, in_use),
+            acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
         )
         sim2 = sim._replace(pools=p2)
         sim2 = _guard_signal(sim2, p_guard[k])
@@ -710,8 +762,9 @@ def _make_apply(spec: ModelSpec):
         )
         b2 = Buffers(
             level=sim.buffers.level.at[b].add(-amt),
-            acc=_record_row(
-                sim.buffers.acc, b, sim.clock, sim.buffers.level[b] - amt
+            acc=_record_row_if(
+                b_rec, sim.buffers.acc, b, sim.clock,
+                sim.buffers.level[b] - amt,
             ),
         )
         ok_sim = sim._replace(buffers=b2)
@@ -729,13 +782,18 @@ def _make_apply(spec: ModelSpec):
         )
         b2 = Buffers(
             level=sim.buffers.level.at[b].add(amt),
-            acc=_record_row(
-                sim.buffers.acc, b, sim.clock, sim.buffers.level[b] + amt
+            acc=_record_row_if(
+                b_rec, sim.buffers.acc, b, sim.clock,
+                sim.buffers.level[b] + amt,
             ),
         )
         ok_sim = sim._replace(buffers=b2)
-        ok_sim = _guard_signal(ok_sim, b_front[b])  # amount for getters
-        ok_sim = _guard_signal(ok_sim, b_rear[b])   # leftover space cascade
+        ok_sim = _guard_signal(ok_sim, b_front[b])  # content for getters
+        # amounts are fractional: one get can free space for SEVERAL
+        # putters, and each successful put must pass the wake along or the
+        # next blocked putter is stranded (unlike object queues, where a
+        # get frees exactly one slot and wakes exactly one putter)
+        ok_sim = _guard_signal(ok_sim, b_rear[b])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
         blocked_sim = _guard_wait(sim, p, b_rear[b], cmd, is_retry)
         return _tree_select(~ok, blocked_sim, ok_sim), ~ok
@@ -754,13 +812,14 @@ def _make_apply(spec: ModelSpec):
             ),
             live=sim.pqueues.live.at[qid, free_col].set(True),
             next_seq=sim.pqueues.next_seq.at[qid].add(1),
-            acc=_record_row(
-                sim.pqueues.acc, qid, sim.clock, (n_live + 1).astype(_R)
+            acc=_record_row_if(
+                pq_rec, sim.pqueues.acc, qid, sim.clock,
+                (n_live + 1).astype(_R),
             ),
         )
         ok_sim = sim._replace(pqueues=pq2)
+        # put frees no slots: only the getter side can newly proceed
         ok_sim = _guard_signal(ok_sim, pq_front[qid])
-        ok_sim = _guard_signal(ok_sim, pq_rear[qid])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
         blocked_sim = _guard_wait(sim, p, pq_rear[qid], cmd, is_retry)
         return _tree_select(full, blocked_sim, ok_sim), full
@@ -782,8 +841,9 @@ def _make_apply(spec: ModelSpec):
         item = sim.pqueues.items[qid, col]
         pq2 = sim.pqueues._replace(
             live=sim.pqueues.live.at[qid, col].set(False),
-            acc=_record_row(
-                sim.pqueues.acc, qid, sim.clock, (n_live - 1).astype(_R)
+            acc=_record_row_if(
+                pq_rec, sim.pqueues.acc, qid, sim.clock,
+                (n_live - 1).astype(_R),
             ),
         )
         ok_sim = sim._replace(

@@ -44,6 +44,7 @@ class QueueRef:
     capacity: int
     front_guard: int  # getters wait here
     rear_guard: int   # putters wait here
+    record: bool = True  # queue-length StepAccum recording
 
 
 @dataclasses.dataclass
@@ -51,6 +52,7 @@ class ResourceRef:
     id: int
     name: str
     guard: int
+    record: bool = True
 
 
 @dataclasses.dataclass
@@ -59,6 +61,7 @@ class PoolRef:
     name: str
     capacity: float
     guard: int
+    record: bool = True
 
 
 @dataclasses.dataclass
@@ -69,6 +72,7 @@ class BufferRef:
     initial: float
     front_guard: int  # getters wait here
     rear_guard: int   # putters wait here
+    record: bool = True
 
 
 @dataclasses.dataclass
@@ -78,6 +82,7 @@ class PQueueRef:
     capacity: int
     front_guard: int
     rear_guard: int
+    record: bool = True
 
 
 @dataclasses.dataclass
@@ -176,52 +181,68 @@ class Model:
         self._n_guards += 1
         return g
 
-    def objectqueue(self, name: str, capacity: int) -> QueueRef:
+    def objectqueue(
+        self, name: str, capacity: int, record: bool = True
+    ) -> QueueRef:
         """FIFO of f64 payloads (parity: cmb_objectqueue; the reference's
         void* objects become a float payload — typically a timestamp or an
-        index into user state)."""
+        index into user state).  ``record=False`` disables queue-length
+        recording at trace time (parity: the reference's optional
+        recording; measurable speedup in hot models)."""
         q = QueueRef(
             id=len(self._queues),
             name=name,
             capacity=capacity,
             front_guard=self._guard(),
             rear_guard=self._guard(),
+            record=record,
         )
         self._queues.append(q)
         return q
 
-    def resource(self, name: str) -> ResourceRef:
+    def resource(self, name: str, record: bool = True) -> ResourceRef:
         """Single-holder resource (parity: cmb_resource)."""
-        r = ResourceRef(id=len(self._resources), name=name, guard=self._guard())
+        r = ResourceRef(
+            id=len(self._resources), name=name, guard=self._guard(),
+            record=record,
+        )
         self._resources.append(r)
         return r
 
-    def resourcepool(self, name: str, capacity: float) -> PoolRef:
+    def resourcepool(
+        self, name: str, capacity: float, record: bool = True
+    ) -> PoolRef:
         """Counting resource of ``capacity`` fungible units (parity:
         cmb_resourcepool)."""
         p = PoolRef(
             id=len(self._pools), name=name, capacity=float(capacity),
-            guard=self._guard(),
+            guard=self._guard(), record=record,
         )
         self._pools.append(p)
         return p
 
-    def buffer(self, name: str, capacity: float, initial: float = 0.0) -> BufferRef:
+    def buffer(
+        self, name: str, capacity: float, initial: float = 0.0,
+        record: bool = True,
+    ) -> BufferRef:
         """Producer-consumer store of a fungible amount (parity: cmb_buffer)."""
         b = BufferRef(
             id=len(self._buffers), name=name, capacity=float(capacity),
             initial=float(initial), front_guard=self._guard(),
-            rear_guard=self._guard(),
+            rear_guard=self._guard(), record=record,
         )
         self._buffers.append(b)
         return b
 
-    def priorityqueue(self, name: str, capacity: int) -> PQueueRef:
+    def priorityqueue(
+        self, name: str, capacity: int, record: bool = True
+    ) -> PQueueRef:
         """Object queue ordered by per-item priority, FIFO within equal
         priorities (parity: cmb_priorityqueue)."""
         q = PQueueRef(
             id=len(self._pqueues), name=name, capacity=capacity,
             front_guard=self._guard(), rear_guard=self._guard(),
+            record=record,
         )
         self._pqueues.append(q)
         return q

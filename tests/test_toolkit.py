@@ -672,3 +672,43 @@ def test_big_demand_waiter_keeps_front_position():
     # its grant re-signal lets small take 2 at the same instant
     assert float(out.procs.locals_f[1, 0]) == 2.0  # big got at t=2
     assert float(out.procs.locals_f[2, 0]) == 2.0  # small after big, same t
+
+
+def test_buffer_put_cascade_wakes_all_fitting_putters():
+    """Regression: fractional amounts mean one get can free space for
+    several blocked putters — each successful put must pass the wake on."""
+    m = Model("bufcascade", n_flocals=1, event_cap=16, guard_cap=4)
+    buf = m.buffer("tank", capacity=10.0, initial=10.0)
+
+    @m.block
+    def putter(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=do_put.pc)
+
+    @m.block
+    def do_put(sim, p, sig):
+        return sim, cmd.buffer_put(buf.id, 1.0, next_pc=put_done.pc)
+
+    @m.block
+    def put_done(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.exit_()
+
+    @m.block
+    def taker(sim, p, sig):
+        return sim, cmd.hold(2.0, next_pc=take.pc)
+
+    @m.block
+    def take(sim, p, sig):
+        return sim, cmd.buffer_get(buf.id, 8.0, next_pc=fin3.pc)
+
+    @m.block
+    def fin3(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("putter", entry=putter, count=2)  # pids 0,1 block at t=1
+    m.process("taker", entry=taker)             # frees 8.0 at t=2
+    out, _ = run1(m)
+    np.testing.assert_allclose(
+        np.asarray(out.procs.locals_f[0:2, 0]), [2.0, 2.0]
+    )
+    np.testing.assert_allclose(float(out.buffers.level[0]), 4.0)
