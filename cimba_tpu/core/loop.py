@@ -87,6 +87,8 @@ class Resources(NamedTuple):
 class Pools(NamedTuple):
     level: jnp.ndarray   # [NP] f64 available units
     held: jnp.ndarray    # [NP, P] f64 per-process held amounts
+    held_seq: jnp.ndarray  # [NP, P] i32 grab order (LIFO victim selection)
+    next_seq: jnp.ndarray  # [NP] i32
     acc: ts.StepAccum    # leaves [NP]: in-use recording
 
 
@@ -191,6 +193,8 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         pools=Pools(
             level=pool_caps,
             held=jnp.zeros((np_, spec.n_procs), _R),
+            held_seq=jnp.zeros((np_, spec.n_procs), _I),
+            next_seq=jnp.zeros((np_,), _I),
             acc=_batched(ts.step_create(t0, 0.0), np_)
             if any(pl.record for pl in spec.pools)
             else None,
@@ -380,6 +384,67 @@ def _wake_waiters(sim: Sim, target, sig) -> Sim:
     return lax.fori_loop(0, n_procs, body, sim)
 
 
+def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
+    """Command-specific cleanup when a pended wait is aborted:
+
+    * pool acquire: roll the holding back to its pre-call amount and
+      return the difference (parity: the INTERRUPTED unwind in
+      cmi_pool_acquire_inner) — except on PREEMPTED, where a mugger
+      already took everything;
+    * buffer get/put: keep the partial amount and report the obtained/
+      deposited quantity in the result register (partial-fulfillment
+      contract, `src/cmb_buffer.c:194-346`)."""
+    sig = jnp.asarray(sig, _I)
+    if spec.pools:
+        p_guard_c = jnp.asarray([pl.guard for pl in spec.pools], _I)
+        p_rec_c = [pl.record for pl in spec.pools]
+        p_cap_c = jnp.asarray([pl.capacity for pl in spec.pools], _R)
+        k = jnp.clip(pend.i, 0, len(spec.pools) - 1)
+        is_pool = (pend.tag == pr.C_POOL_ACQ) | (pend.tag == pr.C_POOL_PRE)
+        do_rb = is_pool & (sig != pr.PREEMPTED)
+        excess = jnp.maximum(sim.pools.held[k, p] - pend.f2, 0.0)
+        rb = sim._replace(
+            pools=sim.pools._replace(
+                level=sim.pools.level.at[k].add(excess),
+                held=sim.pools.held.at[k, p].add(-excess),
+                acc=_record_row_if(
+                    p_rec_c, sim.pools.acc, k, sim.clock,
+                    p_cap_c[k] - (sim.pools.level[k] + excess),
+                ),
+            )
+        )
+        rb = _guard_signal(rb, p_guard_c[k])
+        sim = _tree_select(do_rb, rb, sim)
+    if spec.buffers:
+        is_buf = (pend.tag == pr.C_BUF_GET) | (pend.tag == pr.C_BUF_PUT)
+        obtained = pend.f2 - pend.f
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                got=sim.procs.got.at[p].set(
+                    jnp.where(is_buf, obtained, sim.procs.got[p])
+                )
+            )
+        )
+    return sim
+
+
+def _abort_wait(spec: ModelSpec, sim: Sim, p, sig) -> Sim:
+    """Abort whatever p is waiting on AND run the command-specific abort
+    cleanup (pool rollback, buffer partial-fulfillment report).  Every
+    wait-aborting path — timer/interrupt delivery, preemption, mugging,
+    stop — must come through here; clearing the pend without the cleanup
+    silently breaks the rollback/partial-fulfillment contracts."""
+    pend = pr.Command(
+        sim.procs.pend_tag[p],
+        sim.procs.pend_f[p],
+        sim.procs.pend_f2[p],
+        sim.procs.pend_i[p],
+        sim.procs.pend_pc[p],
+    )
+    # _abort_cleanup self-gates on pend.tag, so NO_PEND is a clean no-op
+    return _abort_cleanup(spec, _unwait(sim, p), p, pend, sig)
+
+
 def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     """Terminate process p: status, waiter wakeup, resource cleanup
     (parity: kill semantics — drop resources, cancel awaits, wake waiters,
@@ -391,7 +456,7 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     r_rec = [r.record for r in spec.resources]
     p_rec = [pl.record for pl in spec.pools]
 
-    sim = _unwait(sim, p)
+    sim = _abort_wait(spec, sim, p, exit_sig)
     # cancel any outstanding timers aimed at p
     es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p)
     sim = sim._replace(events=es2)
@@ -424,7 +489,7 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     def drop_pool(k, sim):
         amt = sim.pools.held[k, p]
         has = amt > 0.0
-        p2 = Pools(
+        p2 = sim.pools._replace(
             level=sim.pools.level.at[k].add(jnp.where(has, amt, 0.0)),
             held=sim.pools.held.at[k, p].set(0.0),
             acc=_tree_select(
@@ -455,7 +520,7 @@ def interrupt(spec: ModelSpec, sim: Sim, target, sig) -> Sim:
     on (parity: cmb_process_interrupt, `include/cmb_process.h:406`)."""
     target = jnp.asarray(target, _I)
     alive = sim.procs.status[target] == pr.RUNNING
-    intr = _unwait(sim, target)
+    intr = _abort_wait(spec, sim, target, sig)
     intr = _schedule_wake(intr, alive, target, jnp.asarray(sig, _I))
     return _tree_select(alive, intr, sim)
 
@@ -683,8 +748,9 @@ def _make_apply(spec: ModelSpec):
         victim = jnp.maximum(holder, 0)
         can_kick = ~free & (sim.procs.prio[p] >= sim.procs.prio[victim])
 
-        # kick path: cancel victim's awaits, deliver PREEMPTED
-        kick_sim = _unwait(sim, victim)
+        # kick path: cancel victim's awaits (incl. pool rollback /
+        # buffer partial report if it was waiting on one), deliver PREEMPTED
+        kick_sim = _abort_wait(spec, sim, victim, pr.PREEMPTED)
         kick_sim = _schedule_wake(kick_sim, can_kick, victim, pr.PREEMPTED)
         # holder switch: no utilization record needed (still in use)
         kick_sim = kick_sim._replace(
@@ -717,33 +783,123 @@ def _make_apply(spec: ModelSpec):
         sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
-    def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
-        k = cmd.i
-        amt = cmd.f
-        enough = sim.pools.level[k] >= amt
-        may_grab = is_retry | gd.is_empty(sim.guards, p_guard[k])
-        ok = enough & may_grab
-
-        in_use = p_cap[k] - (sim.pools.level[k] - amt)
-        p2 = Pools(
-            level=sim.pools.level.at[k].add(-amt),
-            held=sim.pools.held.at[k, p].add(amt),
-            acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
+    def _pool_stamp(sim, k, q):
+        """Stamp q's grab order on its first units (LIFO victim order)."""
+        fresh = sim.pools.held[k, q] <= 0.0
+        pools = sim.pools._replace(
+            held_seq=sim.pools.held_seq.at[k, q].set(
+                jnp.where(fresh, sim.pools.next_seq[k], sim.pools.held_seq[k, q])
+            ),
+            next_seq=sim.pools.next_seq.at[k].add(
+                jnp.where(fresh, 1, 0).astype(_I)
+            ),
         )
-        ok_sim = sim._replace(pools=p2)
-        # leftovers may satisfy the next waiter (parity: the re-signal after
-        # a successful pool grab in cmb_resourcepool.c)
-        ok_sim = _guard_signal(ok_sim, p_guard[k])
+        return sim._replace(pools=pools)
+
+    def _pool_acquire_impl(sim: Sim, p, cmd: pr.Command, is_retry, mug):
+        """Greedy acquire (parity: cmi_pool_acquire_inner,
+        `src/cmb_resourcepool.c:362-533`): take available units NOW, then
+        (preempt variant) mug strictly-lower-priority holders lowest-prio-
+        first / LIFO, then pend for the remainder.  pend_f carries the
+        remaining claim; pend_f2 the pre-call holding for abort rollback."""
+        k = cmd.i
+        rem = cmd.f
+        init_held = jnp.where(
+            is_retry, sim.procs.pend_f2[p], sim.pools.held[k, p]
+        )
+
+        # greedy grab (the reference pool has no no-jump-ahead gate: new
+        # callers race for available units; FIFO applies to the wait line)
+        take = jnp.clip(rem, 0.0, sim.pools.level[k])
+        sim = _pool_stamp(sim, k, p)
+        sim = sim._replace(
+            pools=sim.pools._replace(
+                level=sim.pools.level.at[k].add(-take),
+                held=sim.pools.held.at[k, p].add(take),
+            )
+        )
+        rem = rem - take
+
+        if mug:
+            n_procs = sim.procs.prio.shape[0]
+            pididx = jnp.arange(n_procs)
+
+            def can_mug(carry):
+                sim, rem = carry
+                vmask = (
+                    (sim.pools.held[k] > 0.0)
+                    & (sim.procs.prio < sim.procs.prio[p])
+                    & (pididx != p)
+                )
+                return (rem > 0.0) & jnp.any(vmask)
+
+            def mug_one(carry):
+                sim, rem = carry
+                vmask = (
+                    (sim.pools.held[k] > 0.0)
+                    & (sim.procs.prio < sim.procs.prio[p])
+                    & (pididx != p)
+                )
+                # lowest priority first, then LIFO (latest grab first)
+                vprio = jnp.min(
+                    jnp.where(vmask, sim.procs.prio, jnp.iinfo(jnp.int32).max)
+                )
+                m2 = vmask & (sim.procs.prio == vprio)
+                vseq = jnp.max(jnp.where(m2, sim.pools.held_seq[k], -1))
+                v = jnp.argmax(m2 & (sim.pools.held_seq[k] == vseq)).astype(_I)
+                loot = sim.pools.held[k, v]
+                used = jnp.minimum(loot, rem)
+                surplus = loot - used
+                sim = sim._replace(
+                    pools=sim.pools._replace(
+                        held=sim.pools.held.at[k, v]
+                        .set(0.0)
+                        .at[k, p]
+                        .add(used),
+                        level=sim.pools.level.at[k].add(surplus),
+                    )
+                )
+                # victim loses everything and resumes with PREEMPTED
+                sim = _abort_wait(spec, sim, v, pr.PREEMPTED)
+                sim = _schedule_wake(sim, True, v, pr.PREEMPTED)
+                return sim, rem - used
+
+            sim, rem = lax.while_loop(can_mug, mug_one, (sim, rem))
+
+        done = rem <= 0.0
+        in_use = p_cap[k] - sim.pools.level[k]
+        sim = sim._replace(
+            pools=sim.pools._replace(
+                acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use)
+            )
+        )
+        # leftovers may satisfy the next waiter — signaled ONLY on success
+        # (parity: cmi_pool_acquire_inner signals after completing a grab;
+        # signaling from a still-blocked partial grab would ping-pong
+        # wakes between starved waiters forever)
+        ok_sim = _guard_signal(sim, p_guard[k])
         ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, p_guard[k], cmd, is_retry)
-        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+        blocked_sim = _guard_wait(
+            sim,
+            p,
+            p_guard[k],
+            cmd._replace(f=rem, f2=init_held),
+            is_retry,
+        )
+        return _tree_select(done, ok_sim, blocked_sim), ~done
+
+    def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
+        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=False)
+
+    def h_pool_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
+        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=True)
 
     def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
         k = cmd.i
         amt = jnp.minimum(cmd.f, sim.pools.held[k, p])  # partial ok
         owner_ok = sim.pools.held[k, p] >= cmd.f - 1e-12
         in_use = p_cap[k] - (sim.pools.level[k] + amt)
-        p2 = Pools(
+        p2 = sim.pools._replace(
             level=sim.pools.level.at[k].add(amt),
             held=sim.pools.held.at[k, p].add(-amt),
             acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
@@ -754,49 +910,57 @@ def _make_apply(spec: ModelSpec):
         sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
-    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry):
+    def _buffer_xfer_impl(sim: Sim, p, cmd: pr.Command, is_retry, getting):
+        """Greedy partial-fulfillment transfer shared by get/put (parity:
+        cmb_buffer_get/_put, `src/cmb_buffer.c:194-346`): move what fits
+        now, wait for the remainder; an aborted wait keeps the partial
+        amount and the continuation reads it via api.got.
+
+        Signals: opposite guard on any progress (the transfer freed space /
+        added content for the other side); SAME-side guard only on
+        completion — a partial grab leaves this side drained/full, so a
+        same-side wake could only spin (and a zero-progress re-signal
+        would ping-pong wakes between starved waiters forever)."""
         b = cmd.i
-        amt = cmd.f
-        ok = (sim.buffers.level[b] >= amt) & (
-            is_retry | gd.is_empty(sim.guards, b_front[b])
+        rem = cmd.f
+        total = jnp.where(is_retry, sim.procs.pend_f2[p], cmd.f)
+        room = sim.buffers.level[b] if getting else b_cap[b] - sim.buffers.level[b]
+        moved = jnp.clip(rem, 0.0, room)
+        level2 = sim.buffers.level[b] + jnp.where(getting, -moved, moved)
+        rem2 = rem - moved
+        done = rem2 <= 0.0
+        my_guard = b_front[b] if getting else b_rear[b]
+        other_guard = b_rear[b] if getting else b_front[b]
+        sim = sim._replace(
+            buffers=Buffers(
+                level=sim.buffers.level.at[b].set(level2),
+                acc=_record_row_if(
+                    b_rec, sim.buffers.acc, b, sim.clock, level2
+                ),
+            )
         )
-        b2 = Buffers(
-            level=sim.buffers.level.at[b].add(-amt),
-            acc=_record_row_if(
-                b_rec, sim.buffers.acc, b, sim.clock,
-                sim.buffers.level[b] - amt,
+        sig_sim = _guard_signal(sim, other_guard)
+        sim = _tree_select(moved > 0.0, sig_sim, sim)
+        ok_sim = _guard_signal(sim, my_guard)  # pass leftover wake along
+        ok_sim = set_pc(
+            ok_sim._replace(
+                procs=ok_sim.procs._replace(
+                    got=ok_sim.procs.got.at[p].set(total)
+                )
             ),
+            p,
+            cmd.next_pc,
         )
-        ok_sim = sim._replace(buffers=b2)
-        ok_sim = _guard_signal(ok_sim, b_rear[b])   # space freed for putters
-        ok_sim = _guard_signal(ok_sim, b_front[b])  # leftovers for getters
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, b_front[b], cmd, is_retry)
-        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+        blocked_sim = _guard_wait(
+            sim, p, my_guard, cmd._replace(f=rem2, f2=total), is_retry
+        )
+        return _tree_select(done, ok_sim, blocked_sim), ~done
+
+    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry):
+        return _buffer_xfer_impl(sim, p, cmd, is_retry, getting=True)
 
     def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry):
-        b = cmd.i
-        amt = cmd.f
-        ok = (b_cap[b] - sim.buffers.level[b] >= amt) & (
-            is_retry | gd.is_empty(sim.guards, b_rear[b])
-        )
-        b2 = Buffers(
-            level=sim.buffers.level.at[b].add(amt),
-            acc=_record_row_if(
-                b_rec, sim.buffers.acc, b, sim.clock,
-                sim.buffers.level[b] + amt,
-            ),
-        )
-        ok_sim = sim._replace(buffers=b2)
-        ok_sim = _guard_signal(ok_sim, b_front[b])  # content for getters
-        # amounts are fractional: one get can free space for SEVERAL
-        # putters, and each successful put must pass the wake along or the
-        # next blocked putter is stranded (unlike object queues, where a
-        # get frees exactly one slot and wakes exactly one putter)
-        ok_sim = _guard_signal(ok_sim, b_rear[b])
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, b_rear[b], cmd, is_retry)
-        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+        return _buffer_xfer_impl(sim, p, cmd, is_retry, getting=False)
 
     def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
@@ -916,6 +1080,7 @@ def _make_apply(spec: ModelSpec):
         gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET
         gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
         h_wait_proc,                             # C_WAIT_PROC
+        gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE
     ]
 
     def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
@@ -967,7 +1132,7 @@ def make_step(spec: ModelSpec):
         # zombie guard entry that steals future signals).
         # A SUCCESS wake re-attempts the pended command as the chain's
         # first iteration (use_pend) — handlers are traced only here.
-        aborted = _unwait(sim, p)
+        aborted = _abort_wait(spec, sim, p, sig)
         # on a SUCCESS wake the guard entry is normally gone (popped by the
         # signal), but a user timer with sig=SUCCESS can wake a pended
         # process directly — remove any surviving entry so the retry can't

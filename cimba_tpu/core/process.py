@@ -62,7 +62,8 @@ C_PQ_PUT = 12    # blocking put into priority queue i        (f=item, f2=prio)
 C_PQ_GET = 13    # blocking get from priority queue i
 C_COND_WAIT = 14 # wait on condition i until signaled & predicate true
 C_WAIT_PROC = 15 # wait for process i to finish
-N_COMMANDS = 16
+C_POOL_PRE = 16  # greedy pool acquire that may mug lower-priority holders
+N_COMMANDS = 17
 
 
 class Command(NamedTuple):
@@ -129,8 +130,20 @@ def preempt(resource, next_pc) -> Command:
 
 
 def pool_acquire(pool, amount, next_pc) -> Command:
-    """Blocking acquire of ``amount`` units (parity: cmb_resourcepool_acquire)."""
+    """Blocking acquire of ``amount`` units (parity: cmb_resourcepool_acquire,
+    `src/cmb_resourcepool.c:362-533`): greedily grabs whatever is available
+    now and waits for the remainder; aborted waits (INTERRUPTED/TIMEOUT)
+    roll the holding back to what it was before the call."""
     return _cmd(C_POOL_ACQ, f=amount, i=pool, next_pc=next_pc)
+
+
+def pool_preempt(pool, amount, next_pc) -> Command:
+    """Greedy pool acquire that may also mug strictly-lower-priority
+    holders (parity: cmb_resourcepool_preempt): victims are taken lowest
+    priority first, LIFO within a priority, lose their ENTIRE holding, and
+    resume with PREEMPTED; the surplus beyond the claim returns to the
+    pool."""
+    return _cmd(C_POOL_PRE, f=amount, i=pool, next_pc=next_pc)
 
 
 def pool_release(pool, amount, next_pc) -> Command:

@@ -712,3 +712,224 @@ def test_buffer_put_cascade_wakes_all_fitting_putters():
         np.asarray(out.procs.locals_f[0:2, 0]), [2.0, 2.0]
     )
     np.testing.assert_allclose(float(out.buffers.level[0]), 4.0)
+
+
+def test_pool_preempt_mugs_lowest_priority_lifo():
+    """pool_preempt takes victims lowest-priority-first / LIFO, victims
+    lose everything and get PREEMPTED, surplus returns to the pool."""
+    m = Model("mug", n_flocals=2, event_cap=32, guard_cap=4)
+    pool = m.resourcepool("units", capacity=10.0)
+
+    @m.block
+    def grab(sim, p, sig):
+        # pid 0 grabs 4 at t=0; pid 1 grabs 4 at t=0 (after 0, LIFO newer)
+        return sim, cmd.pool_acquire(pool.id, 4.0, next_pc=sit.pc)
+
+    @m.block
+    def sit(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=after.pc)
+
+    @m.block
+    def after(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    @m.block
+    def boss(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=boss_take.pc)
+
+    @m.block
+    def boss_take(sim, p, sig):
+        # wants 5: 2 available + mugs ONE victim (LIFO -> pid 1's 4 units,
+        # uses 3, returns 1 surplus)
+        return sim, cmd.pool_preempt(pool.id, 5.0, next_pc=boss_got.pc)
+
+    @m.block
+    def boss_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sim.pools.held[pool.id, p])
+        return sim, cmd.exit_()
+
+    m.process("low", entry=grab, prio=0, count=2)  # pids 0, 1
+    m.process("boss", entry=boss, prio=5)          # pid 2
+    out, _ = run1(m)
+    # boss succeeded at t=1
+    assert float(out.procs.locals_f[2, 0]) == 1.0
+    # pid 1 (LIFO victim) was preempted at t=1 with PREEMPTED
+    assert float(out.procs.locals_f[1, 0]) == 1.0
+    assert int(out.procs.locals_f[1, 1]) == pr.PREEMPTED
+    # pid 0 kept its holding and finished normally at t=100
+    assert float(out.procs.locals_f[0, 0]) == 100.0
+    assert int(out.procs.locals_f[0, 1]) == pr.SUCCESS
+    # accounting at grant time: boss held 5 (2 available + 3 of the
+    # victim's 4, surplus 1 returned); everything returned by exits
+    np.testing.assert_allclose(float(out.procs.locals_f[2, 1]), 5.0)
+    np.testing.assert_allclose(float(out.pools.level[0]), 10.0)
+
+
+def test_pool_acquire_rollback_on_timeout():
+    """An interrupted greedy pool wait returns its partial grabs (parity:
+    the INTERRUPTED unwind in cmi_pool_acquire_inner)."""
+    m = Model("rollback", n_flocals=2, event_cap=32, guard_cap=4)
+    pool = m.resourcepool("units", capacity=10.0)
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 7.0, next_pc=hold_it.pc)
+
+    @m.block
+    def hold_it(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=fin4.pc)
+
+    @m.block
+    def fin4(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def greedy(sim, p, sig):
+        # wants 6: grabs the 3 available, waits for 3 more with a timeout
+        sim, _ = api.timer_add(sim, p, 5.0, pr.TIMEOUT)
+        return sim, cmd.pool_acquire(pool.id, 6.0, next_pc=verdict2.pc)
+
+    @m.block
+    def verdict2(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        # rollback evidence at timeout time: nothing held, 3 back in pool
+        sim = api.fail(
+            sim,
+            (sim.pools.held[pool.id, p] != 0.0)
+            | (sim.pools.level[pool.id] != 3.0),
+        )
+        return sim, cmd.exit_()
+
+    m.process("hog", entry=hog)       # pid 0: takes 7 instantly
+    m.process("greedy", entry=greedy)  # pid 1: partial 3, times out at 5
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 5.0
+    assert int(out.procs.locals_f[1, 1]) == pr.TIMEOUT
+    # in-sim rollback check ran in verdict2 (api.fail would set err);
+    # after the hog exits everything is back in the pool
+    np.testing.assert_allclose(float(out.pools.level[0]), 10.0)
+
+
+def test_buffer_partial_fulfillment_on_interrupt():
+    """An interrupted buffer get KEEPS its partial take and reports the
+    obtained amount via api.got (parity: cmb_buffer partial fulfillment)."""
+    m = Model("partial", n_flocals=3, event_cap=32, guard_cap=4)
+    buf = m.buffer("tank", capacity=10.0, initial=3.0)
+
+    @m.block
+    def want6(sim, p, sig):
+        sim, _ = api.timer_add(sim, p, 5.0, pr.TIMEOUT)
+        return sim, cmd.buffer_get(buf.id, 6.0, next_pc=check.pc)
+
+    @m.block
+    def check(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        sim = api.set_local_f(sim, p, 2, api.got(sim, p))  # amount obtained
+        return sim, cmd.exit_()
+
+    m.process("consumer", entry=want6)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[0, 0]) == 5.0
+    assert int(out.procs.locals_f[0, 1]) == pr.TIMEOUT
+    # it drained the 3 available and keeps them; got reports 3.0
+    np.testing.assert_allclose(float(out.procs.locals_f[0, 2]), 3.0)
+    np.testing.assert_allclose(float(out.buffers.level[0]), 0.0)
+
+
+def test_pool_rollback_on_interrupt_delivery():
+    """Regression: rollback must fire for interrupt()-delivered aborts too,
+    not only timer-delivered ones (the pend is cleared at delivery time)."""
+    m = Model("rbintr", n_flocals=3, event_cap=32, guard_cap=4)
+    pool = m.resourcepool("units", capacity=10.0)
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 7.0, next_pc=hold_it.pc)
+
+    @m.block
+    def hold_it(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=fin5.pc)
+
+    @m.block
+    def fin5(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def greedy(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 6.0, next_pc=verdict3.pc)
+
+    @m.block
+    def verdict3(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        sim = api.set_local_f(sim, p, 2, sim.pools.held[pool.id, p])
+        return sim, cmd.exit_()
+
+    @m.block
+    def rude2(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=poke2.pc)
+
+    @m.block
+    def poke2(sim, p, sig):
+        sim = api.interrupt(sim, spec_holder[0], 1, pr.INTERRUPTED)
+        return sim, cmd.exit_()
+
+    m.process("hog", entry=hog)        # pid 0: takes 7
+    m.process("greedy", entry=greedy)  # pid 1: partial 3, interrupted at 5
+    m.process("rude", entry=rude2)     # pid 2
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    assert float(out.procs.locals_f[1, 0]) == 5.0
+    assert int(out.procs.locals_f[1, 1]) == pr.INTERRUPTED
+    # partial 3 units rolled back at interrupt delivery: holds nothing
+    np.testing.assert_allclose(float(out.procs.locals_f[1, 2]), 0.0)
+
+
+def test_buffer_partial_report_on_interrupt_delivery():
+    """Regression: buffer partial-fulfillment report for interrupt()-
+    delivered aborts (api.got must hold the drained amount)."""
+    m = Model("bufintr", n_flocals=3, event_cap=32, guard_cap=4)
+    buf = m.buffer("tank", capacity=10.0, initial=3.0)
+
+    @m.block
+    def want6(sim, p, sig):
+        return sim, cmd.buffer_get(buf.id, 6.0, next_pc=check2.pc)
+
+    @m.block
+    def check2(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        sim = api.set_local_f(sim, p, 2, api.got(sim, p))
+        return sim, cmd.exit_()
+
+    @m.block
+    def rude3(sim, p, sig):
+        return sim, cmd.hold(4.0, next_pc=poke3.pc)
+
+    @m.block
+    def poke3(sim, p, sig):
+        sim = api.interrupt(sim, spec_holder[0], 0, pr.INTERRUPTED)
+        return sim, cmd.exit_()
+
+    @m.block
+    def fin6(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("consumer", entry=want6)  # pid 0: drains 3, waits for 3
+    m.process("rude", entry=rude3)      # pid 1
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    run = cl.make_run(spec_holder[0])
+    out = jax.jit(run)(cl.init_sim(spec_holder[0], 0, 0))
+    assert int(out.err) == 0
+    assert float(out.procs.locals_f[0, 0]) == 4.0
+    assert int(out.procs.locals_f[0, 1]) == pr.INTERRUPTED
+    np.testing.assert_allclose(float(out.procs.locals_f[0, 2]), 3.0)
