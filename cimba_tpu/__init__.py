@@ -23,3 +23,8 @@ Architecture (see SURVEY.md for the full design translation):
 from cimba_tpu import config as config  # noqa: F401  (side effect: x64 setup)
 
 __version__ = "0.1.0"
+
+# convenience re-exports (import is cheap; submodules lazy-load jax anyway)
+from cimba_tpu.core import api, cmd  # noqa: E402, F401
+from cimba_tpu.core.loop import Sim, init_sim, make_run, make_step  # noqa: E402, F401
+from cimba_tpu.core.model import Model  # noqa: E402, F401

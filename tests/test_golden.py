@@ -1,0 +1,89 @@
+"""Seed-pinned golden runs (the reference's golden-file mechanism,
+`test/tools/test_stochastic.py` + `test/reference/*.txt`, translated):
+full model runs with fixed seeds whose results are pinned to 1e-12.
+
+Any semantic drift — event ordering, RNG consumption, guard protocol,
+statistics accumulation — shows up here even if distributional tests
+still pass.  Values were generated on the CPU backend; the engine's
+within-backend determinism makes them stable across batching layouts, and
+cross-backend agreement holds to f64-accumulation tolerance (the looser
+rtol on m2).
+
+Regenerate after an INTENTIONAL semantic change with:
+    python -m tests.test_golden
+"""
+
+import jax
+import numpy as np
+
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mg1, mm1, mmc
+
+GOLDEN = {
+    # model: (seed, rep, params) -> (clock, n_events, m1, m2, mn, mx)
+    "mm1": (
+        (777, 3, mm1.params(500)),
+        (563.6007325975469, 1046, 6.648322754634136, 9289.83086148609,
+         0.118860917529787, 17.67583232398144),
+    ),
+    "mmc": (
+        (777, 5, mmc.params(400, 2.4, 1.0)),
+        (187.9299965705548, 1064, 2.1212906904515667, None, None, None),
+    ),
+    "mg1": (
+        (777, 7, (1.25, 1.0, 1.5, 400)),
+        (534.9388620042981, 866, 6.65407153510022, None, None, None),
+    ),
+}
+
+
+def _run(name):
+    if name == "mm1":
+        spec, _ = mm1.build()
+    elif name == "mmc":
+        spec, _ = mmc.build(3)
+    else:
+        spec, _ = mg1.build()
+    (seed, rep, params), _ = GOLDEN[name]
+    return jax.jit(cl.make_run(spec))(cl.init_sim(spec, seed, rep, params))
+
+
+def _check(name):
+    sim = _run(name)
+    _, (clock, n_events, m1, m2, mn, mx) = GOLDEN[name]
+    assert int(sim.err) == 0
+    np.testing.assert_allclose(float(sim.clock), clock, rtol=1e-12)
+    assert int(sim.n_events) == n_events
+    w = sim.user["wait"]
+    np.testing.assert_allclose(float(w.m1), m1, rtol=1e-12)
+    if m2 is not None:
+        np.testing.assert_allclose(float(w.m2), m2, rtol=1e-9)
+        np.testing.assert_allclose(float(w.mn), mn, rtol=1e-12)
+        np.testing.assert_allclose(float(w.mx), mx, rtol=1e-12)
+
+
+def test_golden_mm1():
+    _check("mm1")
+
+
+def test_golden_mmc():
+    _check("mmc")
+
+
+def test_golden_mg1():
+    _check("mg1")
+
+
+if __name__ == "__main__":  # regeneration helper
+    for name in GOLDEN:
+        sim = _run(name)
+        w = sim.user["wait"]
+        print(
+            name,
+            repr(float(sim.clock)),
+            int(sim.n_events),
+            repr(float(w.m1)),
+            repr(float(w.m2)),
+            repr(float(w.mn)),
+            repr(float(w.mx)),
+        )
