@@ -24,8 +24,17 @@ import jax.numpy as jnp
 from cimba_tpu.core import loop as cl
 from cimba_tpu.models import mm1
 
-R = int(os.environ.get("CIMBA_BENCH_R", 4096))
-N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", 2000))
+def _default_scale():
+    """Backend-sized defaults: wide batches for accelerators, small ones
+    for a CPU smoke run (matters on 1-core CI boxes)."""
+    if jax.default_backend() in ("tpu", "gpu"):
+        return 8192, 2000
+    return 256, 500
+
+
+_DR, _DN = _default_scale()
+R = int(os.environ.get("CIMBA_BENCH_R", _DR))
+N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", _DN))
 BASELINE_EVENTS_PER_SEC = 375e6  # 64-core reference aggregate
 
 

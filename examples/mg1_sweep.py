@@ -1,0 +1,30 @@
+"""Example: the M/G/1 4x5x10 parameter sweep (reference README ~"M/G/1
+sweep" experiment) — one batched run, one row of parameters per
+replication, results vs Pollaczek-Khinchine theory.
+
+Run:  python examples/mg1_sweep.py
+"""
+
+import numpy as np
+
+from cimba_tpu.models import mg1
+from cimba_tpu.runner import experiment as ex
+
+
+def main():
+    spec, _ = mg1.build()
+    params, cells = mg1.sweep_params(n_objects=20_000, reps_per_cell=10)
+    res = ex.run_experiment(spec, params, len(cells), seed=7)
+    means = np.asarray(res.sims.user["wait"].m1)
+    print(f"{len(cells)} replications, failed: {int(res.n_failed)}")
+    print(" cv    rho   simulated  theory")
+    for cv, rho in dict.fromkeys(cells):
+        idx = [k for k, c in enumerate(cells) if c == (cv, rho)]
+        print(
+            f"{cv:4.2f}  {rho:4.2f}  {means[idx].mean():9.3f}  "
+            f"{mg1.pk_sojourn(rho, cv):7.3f}"
+        )
+
+
+if __name__ == "__main__":
+    main()
