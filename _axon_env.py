@@ -1,0 +1,34 @@
+"""The one place that knows how to disable the axon accelerator plugin.
+
+The plugin registers at interpreter startup (sitecustomize on PYTHONPATH),
+gated on ``PALLAS_AXON_POOL_IPS`` being non-empty; once registered, a
+wedged tunnel hangs jax backend init even under ``JAX_PLATFORMS=cpu``.
+CPU-only entry points (tests, dry runs, bench fallback) therefore need a
+*fresh process* whose environment clears that gate — built here, nowhere
+else.  stdlib-only: importable before jax in every entry context.
+"""
+
+import os
+
+
+def cpu_env(n_devices=None, base=None):
+    """A copy of ``base`` (default: os.environ) with the accelerator
+    plugin disabled and the CPU backend forced; ``n_devices`` adds
+    ``--xla_force_host_platform_device_count`` (replacing any existing
+    count flag)."""
+    env = dict(os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def plugin_enabled():
+    """True when the axon plugin will have registered itself at startup."""
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))

@@ -11,34 +11,92 @@ is smaller than the reference's 1e6 objects so total wall time stays
 CI-friendly, but the *rate* is the metric and is workload-size independent
 once the loop is warm.
 
+Backend robustness: the accelerator backend is probed in a subprocess with
+a hard timeout *before* jax is imported here, because a wedged tunnel hangs
+backend init forever.  On probe failure the bench falls back to the CPU
+backend (structured, reported in the JSON detail) rather than dying with a
+traceback.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+BASELINE_EVENTS_PER_SEC = 375e6  # 64-core reference aggregate
+PROBE_TIMEOUT_S = int(os.environ.get("CIMBA_BENCH_PROBE_TIMEOUT", "240"))
 
-from cimba_tpu.core import loop as cl
-from cimba_tpu.models import mm1
+
+def _probe_backend():
+    """(backend_name | None, reason): initialize jax in a subprocess so a
+    hung accelerator tunnel can't take this process with it.  Normal init
+    is 20-40 s; a probe that outlives PROBE_TIMEOUT_S is already wedged."""
+    code = "import jax; jax.devices(); print(jax.default_backend())"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init exceeded {PROBE_TIMEOUT_S}s (tunnel wedged?)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return None, tail[-1] if tail else f"probe rc={proc.returncode}"
+    return proc.stdout.strip().splitlines()[-1], "ok"
+
+
+def _reexec_cpu(reason):
+    """Re-exec this script with the accelerator plugin disabled (see
+    _axon_env: in-process env changes are too late once the plugin has
+    registered at interpreter startup)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _axon_env
+
+    env = _axon_env.cpu_env()
+    env["CIMBA_BENCH_CPU_CHILD"] = "1"
+    env["CIMBA_BENCH_FALLBACK_REASON"] = reason or ""
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _axon_env  # noqa: E402  (stdlib-only, pre-jax by design)
+
+_fallback_reason = os.environ.get("CIMBA_BENCH_FALLBACK_REASON") or None
+if not os.environ.get("CIMBA_BENCH_CPU_CHILD"):
+    if os.environ.get("CIMBA_BENCH_FORCE_CPU"):
+        _reexec_cpu("")
+    elif _axon_env.plugin_enabled():
+        # only an armed accelerator plugin can wedge init — probe it in a
+        # throwaway process; without it, import jax directly
+        _backend, _why = _probe_backend()
+        if _backend is None:
+            _reexec_cpu(_why)
+
+import jax  # noqa: E402  (after backend decision, by design)
+import jax.numpy as jnp  # noqa: E402
+
+from cimba_tpu.core import loop as cl  # noqa: E402
+from cimba_tpu.models import mm1  # noqa: E402
+
 
 def _default_scale():
     """Backend-sized defaults: wide batches for accelerators, small ones
     for a CPU smoke run (matters on 1-core CI boxes)."""
-    if jax.default_backend() in ("tpu", "gpu"):
+    if jax.default_backend() != "cpu":
         return 8192, 2000
     return 256, 500
 
 
-_DR, _DN = _default_scale()
-R = int(os.environ.get("CIMBA_BENCH_R", _DR))
-N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", _DN))
-BASELINE_EVENTS_PER_SEC = 375e6  # 64-core reference aggregate
-
-
 def main():
+    R, N_OBJECTS = _default_scale()
+    R = int(os.environ.get("CIMBA_BENCH_R", R))
+    N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", N_OBJECTS))
+
     spec, _ = mm1.build(record=False)  # benchmark build, like -DNLOGINFO
     run = cl.make_run(spec)
 
@@ -66,6 +124,16 @@ def main():
 
     events = int(events)
     rate = events / wall
+    detail = {
+        "replications": R,
+        "objects_per_replication": N_OBJECTS,
+        "total_events": events,
+        "wall_s": wall,
+        "failed_replications": int(failed),
+        "backend": jax.default_backend(),
+    }
+    if _fallback_reason is not None:
+        detail["backend_fallback"] = _fallback_reason
     print(
         json.dumps(
             {
@@ -73,18 +141,28 @@ def main():
                 "value": rate,
                 "unit": "events/s",
                 "vs_baseline": rate / BASELINE_EVENTS_PER_SEC,
-                "detail": {
-                    "replications": R,
-                    "objects_per_replication": N_OBJECTS,
-                    "total_events": events,
-                    "wall_s": wall,
-                    "failed_replications": int(failed),
-                    "backend": jax.default_backend(),
-                },
+                "detail": detail,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # structured failure beats a bare traceback
+        print(
+            json.dumps(
+                {
+                    "metric": "mm1_events_per_sec",
+                    "value": None,
+                    "unit": "events/s",
+                    "vs_baseline": None,
+                    "detail": {
+                        "error": f"{type(e).__name__}: {e}",
+                        "backend_fallback": _fallback_reason,
+                    },
+                }
+            )
+        )
+        raise

@@ -96,6 +96,43 @@ def queue_length(sim: Sim, q):
     return sim.queues.size[q.id if hasattr(q, "id") else q]
 
 
+def queue_position(sim: Sim, q, item):
+    """1-based position of the first item equal to ``item`` (nearest the
+    front), 0 if absent (parity: cmb_objectqueue_position,
+    `include/cmb_objectqueue.h:199`; the reference matches object pointers,
+    this matches the f64 payload)."""
+    qid = q.id if hasattr(q, "id") else q
+    items = sim.queues.items[qid]
+    cap = items.shape[0]
+    j = jnp.arange(cap)
+    idx = (sim.queues.head[qid] + j) % cap
+    hit = (j < sim.queues.size[qid]) & (items[idx] == jnp.asarray(item, _R))
+    return jnp.where(jnp.any(hit), jnp.argmax(hit) + 1, 0).astype(_I)
+
+
+def pqueue_position(sim: Sim, q, item):
+    """1-based position in dequeue order (priority desc, FIFO within equal
+    priority) of the first item equal to ``item``, 0 if absent (parity:
+    cmb_priorityqueue_position, `include/cmb_priorityqueue.h:140`; the
+    reference locates by put-handle — here puts return no handle, so the
+    payload is the lookup key and the earliest-dequeuing match wins)."""
+    qid = q.id if hasattr(q, "id") else q
+    live = sim.pqueues.live[qid]
+    prio = sim.pqueues.prio[qid]
+    seq = sim.pqueues.seq[qid]
+    match = live & (sim.pqueues.items[qid] == jnp.asarray(item, _R))
+    # the match that dequeues first: max priority, then min seq
+    neg_inf = jnp.asarray(-jnp.inf, _R)
+    big = jnp.iinfo(jnp.int32).max
+    p_best = jnp.max(jnp.where(match, prio, neg_inf))
+    s_best = jnp.min(jnp.where(match & (prio == p_best), seq, big))
+    ahead = live & (
+        (prio > p_best) | ((prio == p_best) & (seq < s_best))
+    )
+    pos = jnp.sum(ahead.astype(_I)) + 1
+    return jnp.where(jnp.any(match), pos, 0).astype(_I)
+
+
 def resource_holder(sim: Sim, r):
     """Holding pid of a resource, -1 if free."""
     return sim.resources.holder[r.id if hasattr(r, "id") else r]
@@ -145,11 +182,22 @@ def timer_add(sim: Sim, p, dur, sig):
     return _loop.timer_add(sim, p, dur, jnp.asarray(sig, _I))
 
 
-def timer_cancel(sim: Sim, handle):
-    """(sim, existed) — parity: cmb_process_timer_cancel."""
+def timer_cancel(sim: Sim, handle, spec=None):
+    """(sim, existed) — parity: cmb_process_timer_cancel.  Pass the model
+    ``spec`` so processes waiting on this handle (cmd.wait_event) wake with
+    CANCELLED immediately rather than at the next dispatch."""
     from cimba_tpu.core import loop as _loop
 
-    return _loop.timer_cancel(sim, handle)
+    return _loop.timer_cancel(sim, handle, spec)
+
+
+def event_cancel(sim: Sim, handle, spec=None):
+    """(sim, existed): cancel any scheduled event by handle (parity:
+    cmb_event_cancel); wait_event waiters wake with CANCELLED (immediately
+    when ``spec`` is passed, else at the next dispatch)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.timer_cancel(sim, handle, spec)
 
 
 def timers_clear(sim: Sim, p) -> Sim:
@@ -181,12 +229,17 @@ def proc_status(sim: Sim, p):
     return sim.procs.status[p]
 
 
-def schedule(sim: Sim, t, prio, handler, subj=0, arg=0) -> Sim:
-    """Schedule a user event (parity: cmb_event_schedule with an arbitrary
-    action); ``handler`` is a function registered with Model.handler."""
+def schedule(sim: Sim, t, prio, handler, subj=0, arg=0):
+    """(sim, handle): schedule a user event (parity: cmb_event_schedule
+    with an arbitrary action); ``handler`` is a function registered with
+    Model.handler.  The handle supports event_cancel / cmd.wait_event
+    (NULL_HANDLE = -1 if the event table was full; the replication is then
+    already marked failed)."""
+    from cimba_tpu.core import eventset as _ev
     from cimba_tpu.core import loop as _loop
 
     kind = handler.kind if hasattr(handler, "kind") else handler
-    return _loop._schedule_if(
-        sim, True, t, prio, kind, subj, arg
-    )
+    es2, handle = _ev.schedule(sim.events, t, prio, kind, subj, arg)
+    sim = sim._replace(events=es2)
+    sim = _loop._set_err(sim, es2.overflow, _loop.ERR_EVENT_OVERFLOW)
+    return sim, handle

@@ -67,7 +67,8 @@ class Event(NamedTuple):
     kind: jnp.ndarray
     subj: jnp.ndarray
     arg: jnp.ndarray
-    found: jnp.ndarray  # bool: False if the set was empty
+    found: jnp.ndarray   # bool: False if the set was empty
+    handle: jnp.ndarray  # the event's (pre-pop) handle; NULL_HANDLE if none
 
 
 def create(capacity: int) -> EventSet:
@@ -201,6 +202,9 @@ def peek(es: EventSet) -> Event:
         subj=es.subj[slot],
         arg=es.arg[slot],
         found=found,
+        handle=jnp.where(
+            found, _handle(slot, es.gen[slot]), NULL_HANDLE
+        ).astype(_I),
     )
 
 
@@ -214,6 +218,9 @@ def pop(es: EventSet):
         subj=es.subj[slot],
         arg=es.arg[slot],
         found=found,
+        handle=jnp.where(
+            found, _handle(slot, es.gen[slot]), NULL_HANDLE
+        ).astype(_I),
     )
     es2 = es._replace(
         time=es.time.at[slot].set(jnp.where(found, NEVER, es.time[slot])),

@@ -110,6 +110,7 @@ class Sim(NamedTuple):
     """One replication's full state."""
 
     clock: jnp.ndarray
+    rep: jnp.ndarray       # i32 replication index (logger trial context)
     rng: rb.RandomState
     events: ev.EventSet
     procs: pr.Procs
@@ -126,7 +127,14 @@ class Sim(NamedTuple):
 
 
 def _tree_select(pred, a, b):
-    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+    # leaves untouched by either branch are the *same object* (branches are
+    # built with _replace from a shared base) — pass them through instead of
+    # emitting a select, so an event that modifies three arrays doesn't
+    # rewrite every leaf of the Sim (full-state HBM traffic per event was
+    # the dominant dispatch cost before this)
+    return jax.tree.map(
+        lambda x, y: x if x is y else jnp.where(pred, x, y), a, b
+    )
 
 
 def _batched(tree, n):
@@ -165,6 +173,7 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
     buf_init = jnp.asarray([b.initial for b in spec.buffers] or [0.0], _R)
     return Sim(
         clock=t0,
+        rep=jnp.asarray(replication, _I),
         rng=rb.initialize(seed, replication),
         events=events,
         procs=procs,
@@ -360,8 +369,60 @@ def _unwait(sim: Sim, p) -> Sim:
     sim = _clear_pend(sim, p)
     sim = _cancel_wake(sim, p)
     return sim._replace(
-        procs=sim.procs._replace(await_pid=sim.procs.await_pid.at[p].set(-1))
+        procs=sim.procs._replace(
+            await_pid=sim.procs.await_pid.at[p].set(-1),
+            await_evt=sim.procs.await_evt.at[p].set(-1),
+        )
     )
+
+
+def _scan_evt_waiters(sim: Sim, decide) -> Sim:
+    """Shared waiter scan: for each process awaiting an event handle,
+    ``decide(sim, handle) -> (wake, sig)``; woken waiters get a scheduled
+    resume and their await cleared."""
+
+    def body(i, sim):
+        h = sim.procs.await_evt[i]
+        awaiting = (h >= 0) & (sim.procs.status[i] == pr.RUNNING)
+        wake, sig = decide(sim, h)
+        wake = wake & awaiting
+        sim = _schedule_wake(sim, wake, i, sig)
+        return sim._replace(
+            procs=sim.procs._replace(
+                await_evt=sim.procs.await_evt.at[i].set(
+                    jnp.where(wake, -1, h)
+                )
+            )
+        )
+
+    return lax.fori_loop(0, sim.procs.await_evt.shape[0], body, sim)
+
+
+def _dispatch_evt_wakes(sim: Sim, handle, found) -> Sim:
+    """Wake processes waiting on the just-popped event with SUCCESS —
+    before its action runs, like the reference (`src/cmb_event.c:312-314`)
+    — and, as the lazy arm of the cancel protocol, any waiter whose awaited
+    handle has died (pattern-cancelled timers etc.) with CANCELLED."""
+
+    def decide(sim, h):
+        fired = found & (h == handle)
+        stale = ~fired & ~ev._valid(sim.events, h)
+        return fired | stale, jnp.where(fired, pr.SUCCESS, pr.CANCELLED).astype(_I)
+
+    return _scan_evt_waiters(sim, decide)
+
+
+def _cancel_evt_wakes(sim: Sim, handle, pred) -> Sim:
+    """Wake waiters of a just-cancelled event with CANCELLED immediately
+    (the eager arm; parity: the reference wakes waiter lists at cancel)."""
+
+    def decide(sim, h):
+        return (
+            jnp.asarray(pred) & (h == handle),
+            jnp.asarray(pr.CANCELLED, _I),
+        )
+
+    return _scan_evt_waiters(sim, decide)
 
 
 def _wake_waiters(sim: Sim, target, sig) -> Sim:
@@ -546,11 +607,19 @@ def timer_add(sim: Sim, p, dur, sig):
     return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW), handle
 
 
-def timer_cancel(sim: Sim, handle):
-    """Cancel a timer by handle (parity: cmb_process_timer_cancel);
-    returns (sim, existed)."""
+def timer_cancel(sim: Sim, handle, spec: Optional[ModelSpec] = None):
+    """Cancel a timer (or any event) by handle (parity:
+    cmb_process_timer_cancel / cmb_event_cancel); returns (sim, existed).
+
+    When ``spec`` is passed and the model can wait on events, processes
+    waiting on this handle wake with CANCELLED immediately (without it
+    they still wake, lazily, at the next dispatch — see
+    _dispatch_evt_wakes)."""
     es2, ok = ev.cancel(sim.events, handle)
-    return sim._replace(events=es2), ok
+    sim = sim._replace(events=es2)
+    if spec is not None and _may_wait_events(spec, sim):
+        sim = _cancel_evt_wakes(sim, handle, ok)
+    return sim, ok
 
 
 def timers_clear(sim: Sim, p) -> Sim:
@@ -616,7 +685,44 @@ def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
 # --- command handlers ---------------------------------------------------------
 
 
-def _make_apply(spec: ModelSpec):
+def _infer_used_tags(spec: ModelSpec, sim: Sim):
+    """The set of command tags this model's blocks can emit, collected by
+    abstractly tracing every block once (constructors register their tag —
+    see process._tag_collector).  Pended retries re-apply a tag a block
+    emitted, so the set is closed under the dispatch protocol.  Returns
+    None (= trace the full table) if any block resists abstract evaluation.
+    """
+    if pr._tag_collector is not None:
+        return None  # nested inference (a block queried it): be conservative
+    tags: set = set()
+    pr._tag_collector = tags
+    try:
+        p0 = jnp.zeros((), _I)
+        for blk in spec.blocks:
+            jax.eval_shape(blk, sim, p0, p0)
+    except Exception:
+        return None
+    finally:
+        pr._tag_collector = None
+    return frozenset(tags)
+
+
+def _used_tags_for(spec: ModelSpec, sim: Sim):
+    """Memoized on the spec object itself (an id()-keyed dict would hand a
+    recycled id a stale tag set after the old spec is collected)."""
+    if not hasattr(spec, "_used_tags_memo"):
+        spec._used_tags_memo = _infer_used_tags(spec, sim)
+    return spec._used_tags_memo
+
+
+def _may_wait_events(spec: ModelSpec, sim: Sim) -> bool:
+    """Static: can this model issue C_WAIT_EVT?  Gates the per-dispatch
+    waiter scan (an O(P) fori) out of models that never wait on events."""
+    used = _used_tags_for(spec, sim)
+    return used is None or pr.C_WAIT_EVT in used
+
+
+def _make_apply(spec: ModelSpec, used_tags=None):
     q_cap = jnp.asarray([q.capacity for q in spec.queues] or [1], _I)
     q_front = jnp.asarray([q.front_guard for q in spec.queues] or [0], _I)
     q_rear = jnp.asarray([q.rear_guard for q in spec.queues] or [0], _I)
@@ -1052,6 +1158,28 @@ def _make_apply(spec: ModelSpec):
         )
         return _tree_select(finished, done_sim, wait_sim), jnp.asarray(True)
 
+    def h_wait_evt(sim: Sim, p, cmd: pr.Command, is_retry):
+        """Wait for event handle cmd.i to be dispatched (parity:
+        cmb_process_wait_event, `include/cmb_process.h:374`).  A dead
+        handle (already fired or cancelled) delivers CANCELLED through an
+        immediate wakeup, mirroring wait_process's already-finished path."""
+        h = cmd.i
+        valid = ev._valid(sim.events, h)
+        dead_sim = _schedule_wake(
+            set_pc(sim, p, cmd.next_pc), ~valid, p,
+            jnp.asarray(pr.CANCELLED, _I),
+        )
+        wait_sim = set_pc(
+            sim._replace(
+                procs=sim.procs._replace(
+                    await_evt=sim.procs.await_evt.at[p].set(h)
+                )
+            ),
+            p,
+            cmd.next_pc,
+        )
+        return _tree_select(valid, wait_sim, dead_sim), jnp.asarray(True)
+
     def h_invalid(sim: Sim, p, cmd: pr.Command, is_retry):
         """Stub for commands whose component type the model never declared
         — keeps the traced handler table small (compile time scales with
@@ -1081,12 +1209,34 @@ def _make_apply(spec: ModelSpec):
         gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
         h_wait_proc,                             # C_WAIT_PROC
         gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE
+        h_wait_evt,                              # C_WAIT_EVT
     ]
 
+    if used_tags is None:
+        def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
+            return lax.switch(
+                jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p,
+                cmd, jnp.asarray(is_retry),
+            )
+        return apply_command
+
+    # Specialized table: trace only the handlers this model's blocks can
+    # emit (every traced lax.switch branch *executes* for every lane under
+    # vmap — dead handlers are pure hot-loop cost).  Unknown tags land on
+    # h_invalid -> ERR_USER, a contained failure, never corruption.
+    used = sorted(t for t in used_tags if 0 <= t < pr.N_COMMANDS)
+    table = [handlers[t] for t in used] + [h_invalid]
+    import numpy as _np
+
+    lut = _np.full((pr.N_COMMANDS,), len(used), _np.int32)
+    for j, t in enumerate(used):
+        lut[t] = j
+    lut = jnp.asarray(lut)
+
     def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
+        idx = lut[jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1)]
         return lax.switch(
-            jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p, cmd,
-            jnp.asarray(is_retry),
+            idx, table, sim, p, cmd, jnp.asarray(is_retry),
         )
 
     return apply_command
@@ -1097,8 +1247,18 @@ def _make_apply(spec: ModelSpec):
 
 def make_step(spec: ModelSpec):
     """Build ``step(sim) -> sim`` dispatching exactly one event."""
-    apply_command = _make_apply(spec)
     blocks = list(spec.blocks)
+
+    # The handler table is specialized to the tags the model's blocks can
+    # emit, which requires a Sim to trace them against — so it is built
+    # lazily at the first (tracing) call and cached.  Static per spec:
+    # retraces at other batch shapes reuse it.
+    _cache: dict = {}
+
+    def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
+        if "apply" not in _cache:
+            _cache["apply"] = _make_apply(spec, _used_tags_for(spec, sim))
+        return _cache["apply"](sim, p, cmd, is_retry)
 
     def run_block(sim: Sim, p, sig):
         return lax.switch(
@@ -1114,6 +1274,17 @@ def make_step(spec: ModelSpec):
         command, then chain blocks until something yields."""
         # any remaining wake event is stale once we are resumed
         sim = _cancel_wake(sim, p)
+        # ANY delivery ends a wait-on-process / wait-on-event: a direct
+        # user-timer wake bypasses _abort_wait, and a surviving await_pid/
+        # await_evt would spuriously re-resume this process when the target
+        # later finishes/fires (parity: cmi_process_cancel_awaiteds runs on
+        # every signal delivery, `src/cmb_process.c:694-748`)
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                await_pid=sim.procs.await_pid.at[p].set(-1),
+                await_evt=sim.procs.await_evt.at[p].set(-1),
+            )
+        )
 
         pend = pr.Command(
             sim.procs.pend_tag[p],
@@ -1200,8 +1371,20 @@ def make_step(spec: ModelSpec):
             clock=jnp.where(event.found, event.time, sim.clock),
             n_events=sim.n_events
             + jnp.where(event.found, 1, 0).astype(jnp.int64),
-            done=sim.done | ~event.found,
         )
+        if _may_wait_events(spec, sim):
+            # wake event-waiters before the action runs (reference order,
+            # `src/cmb_event.c:312-314`); statically absent from models
+            # that never issue wait_event.  The stale-handle arm can
+            # schedule wakes even on an empty pop, so "out of events" is
+            # judged AFTER the scan (else a cancel that drains the set
+            # would strand its waiter forever).
+            sim = _dispatch_evt_wakes(sim, event.handle, event.found)
+            sim = sim._replace(
+                done=sim.done | (~event.found & ev.is_empty(sim.events))
+            )
+        else:
+            sim = sim._replace(done=sim.done | ~event.found)
         dispatched = lax.switch(
             jnp.clip(event.kind, 0, len(dispatch_fns) - 1),
             dispatch_fns,
@@ -1222,10 +1405,22 @@ def make_run(spec: ModelSpec, t_end: Optional[float] = None):
     step = make_step(spec)
 
     def cond(sim: Sim):
-        live = ~sim.done & (sim.err == 0) & ~ev.is_empty(sim.events)
+        empty = ev.is_empty(sim.events)
+        if _may_wait_events(spec, sim):
+            # an event-waiter whose handle died with the set (a cancel was
+            # the run's last activity) still needs one more step: the
+            # stale-handle scan there schedules its CANCELLED wake
+            stranded = jnp.any(
+                (sim.procs.await_evt >= 0)
+                & (sim.procs.status == pr.RUNNING)
+            )
+            out_of_work = empty & ~stranded
+        else:
+            out_of_work = empty
+        live = ~sim.done & (sim.err == 0) & ~out_of_work
         if t_end is not None:
             nxt = jnp.min(sim.events.time)
-            live = live & (nxt <= t_end)
+            live = live & ((nxt <= t_end) | (empty & ~out_of_work))
         return live
 
     def run(sim: Sim) -> Sim:

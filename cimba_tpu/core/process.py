@@ -63,7 +63,8 @@ C_PQ_GET = 13    # blocking get from priority queue i
 C_COND_WAIT = 14 # wait on condition i until signaled & predicate true
 C_WAIT_PROC = 15 # wait for process i to finish
 C_POOL_PRE = 16  # greedy pool acquire that may mug lower-priority holders
-N_COMMANDS = 17
+C_WAIT_EVT = 17  # wait for event handle i to be dispatched
+N_COMMANDS = 18
 
 
 class Command(NamedTuple):
@@ -76,7 +77,18 @@ class Command(NamedTuple):
     next_pc: jnp.ndarray  # i32 block to continue at
 
 
+# When set (by core.loop's used-tag inference pass), every constructed
+# command registers its tag here.  Tags reach _cmd as Python int constants,
+# so collection works under abstract (eval_shape) tracing — the dispatcher
+# uses the collected set to trace only the handlers a model can invoke
+# (vmapped lax.switch executes *every* traced branch for every lane, so an
+# unused handler is pure hot-loop cost).
+_tag_collector = None
+
+
 def _cmd(tag, f=0.0, f2=0.0, i=0, next_pc=0) -> Command:
+    if _tag_collector is not None:
+        _tag_collector.add(int(tag))
     return Command(
         jnp.asarray(tag, _I),
         jnp.asarray(f, _R),
@@ -185,6 +197,16 @@ def wait_process(pid, next_pc) -> Command:
     return _cmd(C_WAIT_PROC, i=pid, next_pc=next_pc)
 
 
+def wait_event(handle, next_pc) -> Command:
+    """Wait for an arbitrary scheduled event to occur (parity:
+    cmb_process_wait_event, `include/cmb_process.h:374`): the continuation
+    receives SUCCESS when the event is dispatched (waiters wake before the
+    event's action runs, `src/cmb_event.c:312-314`), CANCELLED if the event
+    was cancelled (or the handle was already dead), or the interrupting
+    signal if this process is interrupted while waiting."""
+    return _cmd(C_WAIT_EVT, i=handle, next_pc=next_pc)
+
+
 def select(pred, a: Command, b: Command) -> Command:
     """Branch-free choice between two commands (pred ? a : b)."""
     return Command(*[jnp.where(pred, x, y) for x, y in zip(a, b)])
@@ -209,6 +231,7 @@ class Procs(NamedTuple):
     pend_guard: jnp.ndarray  # i32 guard the process waits on, -1 if none
     pend_seq: jnp.ndarray  # i32 guard FIFO position (kept across retries)
     await_pid: jnp.ndarray  # i32 process this one waits for (-1 none)
+    await_evt: jnp.ndarray  # i32 event handle this one waits for (-1 none)
     exit_sig: jnp.ndarray  # i32 signal delivered to waiters (SUCCESS/STOPPED)
     got: jnp.ndarray       # f64 result register (last GET item, ...)
     locals_f: jnp.ndarray  # [P, NF] f64 user locals
@@ -231,6 +254,7 @@ def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
         pend_guard=jnp.full((p,), -1, _I),
         pend_seq=jnp.full((p,), -1, _I),
         await_pid=jnp.full((p,), -1, _I),
+        await_evt=jnp.full((p,), -1, _I),
         exit_sig=jnp.full((p,), SUCCESS, _I),
         got=jnp.zeros((p,), _R),
         locals_f=jnp.zeros((p, max(n_flocals, 1)), _R),

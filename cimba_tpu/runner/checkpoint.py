@@ -7,8 +7,11 @@ state is one pytree (including the counter-based RNG position), so
 ``save``/``restore`` round-trips the whole batch and ``make_run`` simply
 continues — resumed runs are bit-identical to uninterrupted ones (tested).
 
-Uses orbax when available, with a numpy .npz fallback (pure pytree of
-arrays either way).
+Format: a flat numpy ``.npz`` of the pytree leaves with an atomic rename —
+deliberately dependency-free (the state is a modest pytree of dense arrays;
+an async/sharded checkpoint stack like orbax buys nothing at this size and
+would be the only non-jax dependency in the hot path).  Structure changes
+are rejected at restore by leaf-count mismatch.
 """
 
 from __future__ import annotations

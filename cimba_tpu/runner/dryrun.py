@@ -1,0 +1,47 @@
+"""In-process body of the multi-chip dry run (see ``__graft_entry__``).
+
+This module is imported by a *subprocess* whose environment already forces
+the CPU backend with ``--xla_force_host_platform_device_count=N`` — the
+dry run is a correctness check of the sharded program on a virtual mesh,
+and must stay green regardless of real-accelerator/tunnel state.  Keep jax
+imports inside the function so importing this module never touches a
+backend.
+"""
+
+from __future__ import annotations
+
+
+def run_dryrun(n_devices: int) -> None:
+    """Full experiment step over an ``n_devices`` mesh: replications shard
+    over the 'rep' axis (the DES analog of data parallelism — a discrete-
+    event simulator has no tensor/pipeline dims; its scale axes are
+    replications across chips and, later, intra-trial agents across lanes),
+    with per-shard Pébay statistics merged via all_gather and scalar
+    counters via psum over the mesh.  One step on tiny shapes.
+    """
+    import jax
+
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    mesh = ex.make_mesh(n_devices)
+    spec, _ = mm1.build()
+    fn = ex.make_sharded_experiment(spec, 2 * n_devices, mesh)
+    pooled, n_failed, events = jax.block_until_ready(
+        fn(mm1.params(20), seed=1)
+    )
+    assert int(n_failed) == 0, f"dryrun had failed replications: {n_failed}"
+    assert int(pooled.n) == 2 * n_devices * 20, int(pooled.n)
+    assert float(sm.mean(pooled)) > 0.0
+    print(
+        f"dryrun_multichip OK: {n_devices} devices, "
+        f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    run_dryrun(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

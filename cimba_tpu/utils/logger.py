@@ -31,6 +31,11 @@ USER = 1 << 4
 
 _mask = FATAL | ERROR | WARNING  # INFO off by default, like release builds
 
+# settable time formatter (parity: cmb_logger_timeformatter_set,
+# `src/cmb_logger.c:94-112`): a host-side ``fn(float) -> str``; None = the
+# default fixed-width rendering
+_timeformatter = None
+
 
 def flags_on(bits: int) -> None:
     """Enable levels (parity: cmb_logger_flags_on)."""
@@ -48,16 +53,64 @@ def flags() -> int:
     return _mask
 
 
+def timeformatter_set(fn) -> None:
+    """Replace the time rendering on every subsequently *traced* log call
+    (parity: cmb_logger_timeformatter_set; the reference swaps a function
+    pointer at runtime — here, as with flags, it binds at trace time).
+    ``fn(t: float) -> str`` runs host-side; pass None to restore the
+    default."""
+    global _timeformatter
+    _timeformatter = fn
+
+
+def _stream_id(sim):
+    """Reproduction context (parity: the seed printed on warning+ lines,
+    `src/cmb_logger.c:149-227`): the counter-based RNG means (key, ctr)
+    replays the stream exactly — stronger than the reference's curseed."""
+    import jax.numpy as jnp
+
+    key = (jnp.asarray(sim.rng.key1, jnp.uint64) << jnp.uint64(32)) | (
+        jnp.asarray(sim.rng.key0, jnp.uint64)
+    )
+    return key, sim.rng.n_draws
+
+
 def _emit(level_name, sim, p, fmt, *args, **kwargs):
-    jax.debug.print(
-        "[{level}] t={t:.6f} p={p} err={e} | " + fmt,
-        level=level_name,
-        t=sim.clock,
-        p=p,
-        e=sim.err,
-        *args,
-        **kwargs,
-        ordered=False,
+    rep = getattr(sim, "rep", -1)
+    if _timeformatter is None:
+        jax.debug.print(
+            "[{level}] r={r} t={t:.6f} p={p} err={e} | " + fmt,
+            level=level_name,
+            r=rep,
+            t=sim.clock,
+            p=p,
+            e=sim.err,
+            *args,
+            **kwargs,
+            ordered=False,
+        )
+    else:
+        tff = _timeformatter
+
+        def host(r, t, p_, e, *a, **kw):
+            print(
+                f"[{level_name}] r={r} t={tff(float(t))} p={p_} err={e} | "
+                + fmt.format(*a, **kw),
+                flush=True,
+            )
+
+        jax.debug.callback(host, rep, sim.clock, p, sim.err, *args, **kwargs)
+
+
+def _emit_with_seed(level_name, sim, p, fmt, *args, **kwargs):
+    """warning+ lines carry the stream id for reproduction (parity:
+    `src/cmb_logger.c:214-218`): rebuild the failing replication's RNG with
+    RandomState(key0, key1, ctr) and replay."""
+    key, ctr = _stream_id(sim)
+    _emit(
+        level_name, sim, p,
+        fmt + "  [replay: key=0x{_key:016x} ctr={_ctr}]",
+        *args, _key=key, _ctr=ctr, **kwargs,
     )
 
 
@@ -70,7 +123,7 @@ def info(sim, p, fmt: str, *args, **kwargs):
 
 def warning(sim, p, fmt: str, *args, **kwargs):
     if _mask & WARNING:
-        _emit("warn", sim, p, fmt, *args, **kwargs)
+        _emit_with_seed("warn", sim, p, fmt, *args, **kwargs)
     return sim
 
 
@@ -88,5 +141,5 @@ def error(sim, p, fmt: str, *args, **kwargs):
     from cimba_tpu.core import api
 
     if _mask & ERROR:
-        _emit("error", sim, p, fmt, *args, **kwargs)
+        _emit_with_seed("error", sim, p, fmt, *args, **kwargs)
     return api.fail(sim)

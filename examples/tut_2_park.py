@@ -1,0 +1,151 @@
+"""Tutorial 2 — interrupt and preempt interactions (reference:
+`tutorial/tut_2_1.c`: mice, rats and a cat fight over a cheese pool).
+
+What it demonstrates, in reference order:
+
+*   ``pool_acquire`` returning SUCCESS vs being mugged: a rat uses
+    ``pool_preempt`` — victims lose their ENTIRE holding and their next
+    signal is PREEMPTED (`src/cmb_resourcepool.c:362-533` semantics).
+*   signal-driven control flow: each mouse tracks how much cheese it
+    believes it holds and reconciles that belief against every signal it
+    receives — the tutorial's core lesson that *any* yield can end with
+    PREEMPTED/INTERRUPTED instead of SUCCESS.
+*   a scheduled end event stopping every process (`end_sim_evt`).
+
+Every belief is asserted against the pool's actual `held` books at the
+end, which is exactly the `cmb_assert_debug` the reference sprinkles
+through `mousefunc`.
+
+Run:  python examples/tut_2_park.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+N_MICE = 5
+N_RATS = 2
+CHEESE = 20.0
+T_END = 50.0
+
+L_HELD = 0      # flocal: how much cheese this animal believes it holds
+LI_PREEMPTED = 0  # ilocal: times this animal was mugged
+
+
+def build():
+    m = Model("park", n_flocals=1, n_ilocals=1, event_cap=64, guard_cap=16)
+    cheese = m.resourcepool("cheese", capacity=CHEESE, record=False)
+    spec_box = []
+
+    # ---- the end-of-game event stops everyone (end_sim_evt) ----------
+    @m.handler
+    def end_sim(sim, subj, arg):
+        for pid in range(N_MICE + N_RATS):
+            sim = api.stop_process(sim, spec_box[0], pid)
+        return sim
+
+    def want_amount(sim, p):
+        sim, u = api.draw(sim, cr.dice, 1, 3)
+        return sim, u.astype(jnp.float64)
+
+    # ---- mice: polite acquires ---------------------------------------
+    @m.block
+    def mouse_acquire(sim, p, sig):
+        sim, amt = want_amount(sim, p)
+        sim = api.set_local_f(sim, p, L_HELD,
+                              api.local_f(sim, p, L_HELD) + amt)
+        return sim, cmd.pool_acquire(cheese.id, amt, next_pc=mouse_hold.pc)
+
+    @m.block
+    def mouse_hold(sim, p, sig):
+        # reconcile belief with what the signal says actually happened
+        mugged = sig == pr.PREEMPTED
+        sim = api.set_local_f(
+            sim, p, L_HELD,
+            jnp.where(mugged, 0.0, api.local_f(sim, p, L_HELD)),
+        )
+        sim = api.add_local_i(
+            sim, p, LI_PREEMPTED, jnp.where(mugged, 1, 0)
+        )
+        sim, dt = api.draw(sim, cr.exponential, 1.0)
+        return sim, cmd.hold(dt, next_pc=mouse_drop.pc)
+
+    @m.block
+    def mouse_drop(sim, p, sig):
+        mugged = sig == pr.PREEMPTED
+        held = jnp.where(mugged, 0.0, api.local_f(sim, p, L_HELD))
+        sim = api.add_local_i(sim, p, LI_PREEMPTED, jnp.where(mugged, 1, 0))
+        give = jnp.minimum(1.0, held)  # drop one unit if it has any
+        sim = api.set_local_f(sim, p, L_HELD, held - give)
+        return sim, cmd.pool_release(cheese.id, give, next_pc=mouse_acquire.pc)
+
+    # ---- rats: preempting acquires (muggers) -------------------------
+    @m.block
+    def rat_grab(sim, p, sig):
+        sim, amt = want_amount(sim, p)
+        sim = api.set_local_f(sim, p, L_HELD,
+                              api.local_f(sim, p, L_HELD) + amt)
+        return sim, cmd.pool_preempt(cheese.id, amt, next_pc=rat_hold.pc)
+
+    @m.block
+    def rat_hold(sim, p, sig):
+        mugged = sig == pr.PREEMPTED  # a higher-priority rat can mug a rat
+        sim = api.set_local_f(
+            sim, p, L_HELD,
+            jnp.where(mugged, 0.0, api.local_f(sim, p, L_HELD)),
+        )
+        sim = api.add_local_i(sim, p, LI_PREEMPTED, jnp.where(mugged, 1, 0))
+        sim, dt = api.draw(sim, cr.exponential, 2.0)
+        return sim, cmd.hold(dt, next_pc=rat_drop.pc)
+
+    @m.block
+    def rat_drop(sim, p, sig):
+        mugged = sig == pr.PREEMPTED
+        held = jnp.where(mugged, 0.0, api.local_f(sim, p, L_HELD))
+        sim = api.add_local_i(sim, p, LI_PREEMPTED, jnp.where(mugged, 1, 0))
+        sim = api.set_local_f(sim, p, L_HELD, 0.0)
+        return sim, cmd.pool_release(cheese.id, held, next_pc=rat_grab.pc)
+
+    # ---- a starter process schedules the end event -------------------
+    @m.block
+    def god_start(sim, p, sig):
+        sim, _h = api.schedule(sim, T_END, 10, end_sim)
+        return sim, cmd.exit_()
+
+    m.process("mouse", entry=mouse_acquire, prio=0, count=N_MICE)
+    m.process("rat", entry=rat_grab, prio=5, count=N_RATS)
+    m.process("god", entry=god_start, prio=10)
+    spec = m.build()
+    spec_box.append(spec)
+    return spec, cheese
+
+
+def main():
+    spec, cheese = build()
+    run = cl.make_run(spec)
+
+    def one(rep):
+        return run(cl.init_sim(spec, seed=7, replication=rep))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    assert int(jnp.sum(sims.err != 0)) == 0, "replications failed"
+
+    # belief == books: every animal's believed holding must match the
+    # pool's ledger after stop-cleanup returned everything
+    assert float(jnp.max(jnp.abs(sims.pools.held))) == 0.0
+    assert float(jnp.max(jnp.abs(sims.pools.level - CHEESE))) < 1e-9
+
+    muggings = int(jnp.sum(sims.procs.locals_i[:, :N_MICE + N_RATS, 0]))
+    print(f"16 replications x {T_END:.0f}h in the park")
+    print(f"preemptions survived (belief reconciled): {muggings}")
+    assert muggings > 0, "rats never mugged anyone — preempt path untested"
+    return muggings
+
+
+if __name__ == "__main__":
+    main()

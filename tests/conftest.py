@@ -8,6 +8,9 @@ jax initializes its backends, hence before any cimba_tpu import.
 
 import os
 
+# wedge-protection (re-exec with the axon plugin disabled) lives in the
+# ROOT conftest.py, which loads first for every invocation style
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

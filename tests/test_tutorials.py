@@ -1,0 +1,32 @@
+"""The tutorial progression runs and self-verifies (reference:
+`tutorial/tut_1_1.c` … `tut_4_2.c`; SURVEY.md §7 names the tut_1
+progression the UX bar for the state-machine API).  Each example asserts
+its own expected output; these tests just drive them.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from examples import tut_1_mm1, tut_2_park, tut_3_balking, tut_4_harbor  # noqa: E402
+
+
+def test_tut_1_mm1_matches_theory():
+    mean, half = tut_1_mm1.main()
+    assert mean > 0
+
+
+def test_tut_2_park_preemption_reconciles():
+    muggings = tut_2_park.main()
+    assert muggings > 0
+
+
+def test_tut_3_balking_reneging_jockeying():
+    visits, balked, reneged = tut_3_balking.main()
+    assert visits > 0
+
+
+def test_tut_4_harbor_all_ships_sail():
+    sailed = tut_4_harbor.main()
+    assert sailed > 0

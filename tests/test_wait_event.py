@@ -1,0 +1,314 @@
+"""wait_event: waiting on an arbitrary scheduled event (parity:
+cmb_process_wait_event, `include/cmb_process.h:374`; waiters wake at
+dispatch before the action runs, `src/cmb_event.c:312-314`; cancellation
+delivers CANCELLED).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+
+def run1(m, params=None, t_end=None):
+    spec = m.build()
+    run = cl.make_run(spec, t_end=t_end)
+    sim = cl.init_sim(spec, 0, 0, params)
+    out = jax.jit(run)(sim)
+    assert int(out.err) == 0, f"replication failed: err={int(out.err)}"
+    return out, spec
+
+
+def _waiter_blocks(m, get_handle):
+    """Standard waiter: wait on get_handle(sim), record (clock, sig)."""
+
+    @m.block
+    def w_wait(sim, p, sig):
+        return sim, cmd.wait_event(get_handle(sim), next_pc=w_done.pc)
+
+    @m.block
+    def w_done(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_i(sim, p, 0, sig)
+        return sim, cmd.exit_()
+
+    return w_wait
+
+
+def test_wait_event_wakes_at_dispatch_with_success():
+    """Waiter on a user event resumes at its fire time with SUCCESS; the
+    event's own action still runs."""
+    m = Model("wev", n_flocals=1, n_ilocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32),
+                "fired_t": jnp.asarray(-1.0, jnp.float64)}
+
+    @m.handler
+    def on_fire(sim, subj, arg):
+        return api.set_user(sim, {**sim.user, "fired_t": api.clock(sim)})
+
+    @m.block
+    def s_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 5.0, 0, on_fire)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("scheduler", entry=s_sched, prio=1)  # runs first at t=0
+    m.process("waiter", entry=w_wait, prio=0)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 5.0
+    assert int(out.procs.locals_i[1, 0]) == pr.SUCCESS
+    assert float(out.user["fired_t"]) == 5.0
+
+
+def test_wait_event_on_timer_both_delivered():
+    """Waiting on a timer aimed at another process: the subject gets the
+    timer signal, the waiter gets SUCCESS, both at the fire time."""
+    m = Model("wtimer", n_flocals=1, n_ilocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32)}
+
+    @m.block
+    def t_arm(sim, p, sig):
+        sim, h = api.timer_add(sim, p, 3.0, 7)  # app-defined signal 7
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.hold(100.0, next_pc=t_got.pc)
+
+    @m.block
+    def t_got(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_i(sim, p, 0, sig)
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("subject", entry=t_arm, prio=1)
+    m.process("waiter", entry=w_wait, prio=0)
+    out, _ = run1(m)
+    # subject interrupted out of its hold by the timer's signal at t=3
+    assert float(out.procs.locals_f[0, 0]) == 3.0
+    assert int(out.procs.locals_i[0, 0]) == 7
+    # waiter woken by the same dispatch with SUCCESS
+    assert float(out.procs.locals_f[1, 0]) == 3.0
+    assert int(out.procs.locals_i[1, 0]) == pr.SUCCESS
+
+
+def test_wait_event_cancel_delivers_cancelled():
+    """Eager arm: cancelling the awaited event (spec passed) wakes the
+    waiter with CANCELLED at the cancel time."""
+    m = Model("wcancel", n_flocals=1, n_ilocals=1, event_cap=16)
+    spec_box = []
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32)}
+
+    @m.handler
+    def never(sim, subj, arg):
+        return api.fail(sim)  # must not run
+
+    @m.block
+    def c_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 50.0, 0, never)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.hold(2.0, next_pc=c_cancel.pc)
+
+    @m.block
+    def c_cancel(sim, p, sig):
+        sim, existed = api.event_cancel(
+            sim, sim.user["h"], spec_box[0] if spec_box else None
+        )
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("canceller", entry=c_sched, prio=1)
+    m.process("waiter", entry=w_wait, prio=0)
+    spec = m.build()
+    spec_box.append(spec)
+    run = cl.make_run(spec)
+    out = jax.jit(run)(cl.init_sim(spec, 0, 0, None))
+    assert int(out.err) == 0
+    assert float(out.procs.locals_f[1, 0]) == 2.0
+    assert int(out.procs.locals_i[1, 0]) == pr.CANCELLED
+    assert float(out.clock) == 2.0  # the t=50 event is gone
+
+
+def test_wait_event_lazy_cancel_wakes_at_next_dispatch():
+    """Lazy arm: cancel without spec — the waiter still wakes with
+    CANCELLED, at the next event dispatch after the cancel."""
+    m = Model("wlazy", n_flocals=1, n_ilocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32)}
+
+    @m.handler
+    def never(sim, subj, arg):
+        return api.fail(sim)
+
+    @m.block
+    def c_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 50.0, 0, never)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.hold(2.0, next_pc=c_cancel.pc)
+
+    @m.block
+    def c_cancel(sim, p, sig):
+        sim, existed = api.event_cancel(sim, sim.user["h"])  # no spec
+        return sim, cmd.hold(1.0, next_pc=c_exit.pc)  # next dispatch: t=3
+
+    @m.block
+    def c_exit(sim, p, sig):
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("canceller", entry=c_sched, prio=1)
+    m.process("waiter", entry=w_wait, prio=0)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 3.0
+    assert int(out.procs.locals_i[1, 0]) == pr.CANCELLED
+
+
+def test_wait_event_dead_handle_immediate_cancelled():
+    """Waiting on an already-dead handle delivers CANCELLED at once."""
+    m = Model("wdead", n_flocals=1, n_ilocals=1, event_cap=16)
+    w_wait = _waiter_blocks(m, lambda sim: jnp.asarray(-1, jnp.int32))
+    m.process("waiter", entry=w_wait)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[0, 0]) == 0.0
+    assert int(out.procs.locals_i[0, 0]) == pr.CANCELLED
+
+
+def test_wait_event_timer_wake_clears_await():
+    """A direct user-timer wake ends the event wait (parity: awaiteds are
+    cancelled on every signal delivery); the event's later dispatch must
+    NOT spuriously re-resume the former waiter."""
+    m = Model("wtwake", n_flocals=2, n_ilocals=2, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32),
+                "fired_t": jnp.asarray(-1.0, jnp.float64)}
+
+    @m.handler
+    def on_fire(sim, subj, arg):
+        return api.set_user(sim, {**sim.user, "fired_t": api.clock(sim)})
+
+    @m.block
+    def s_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 5.0, 0, on_fire)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.exit_()
+
+    @m.block
+    def w_arm(sim, p, sig):
+        sim, _ = api.timer_add(sim, p, 2.0, 9)  # fires mid-wait
+        return sim, cmd.wait_event(sim.user["h"], next_pc=w_first.pc)
+
+    @m.block
+    def w_first(sim, p, sig):
+        # the timer won the race: record it, then hold past the event
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_i(sim, p, 0, sig)
+        return sim, cmd.hold(10.0, next_pc=w_second.pc)
+
+    @m.block
+    def w_second(sim, p, sig):
+        # must be reached at t=12 by the hold expiring with SUCCESS — a
+        # stale await_evt would deliver a spurious wake at t=5 instead
+        sim = api.set_local_f(sim, p, 1, api.clock(sim))
+        sim = api.set_local_i(sim, p, 1, sig)
+        return sim, cmd.exit_()
+
+    m.process("scheduler", entry=s_sched, prio=1)
+    m.process("waiter", entry=w_arm, prio=0)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 2.0
+    assert int(out.procs.locals_i[1, 0]) == 9
+    assert float(out.procs.locals_f[1, 1]) == 12.0
+    assert int(out.procs.locals_i[1, 1]) == pr.SUCCESS
+    assert float(out.user["fired_t"]) == 5.0  # the event itself still ran
+
+
+def test_wait_event_cancel_draining_event_set_still_wakes():
+    """Lazy-arm edge: the cancel is the run's LAST activity (event set
+    drains); the stranded waiter must still get CANCELLED, not be dropped
+    as the loop exits."""
+    m = Model("wdrain", n_flocals=1, n_ilocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32)}
+
+    @m.handler
+    def never(sim, subj, arg):
+        return api.fail(sim)
+
+    @m.block
+    def c_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 50.0, 0, never)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.hold(2.0, next_pc=c_last.pc)
+
+    @m.block
+    def c_last(sim, p, sig):
+        # cancel without spec (lazy) and exit — nothing else is scheduled
+        sim, _ = api.event_cancel(sim, sim.user["h"])
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("canceller", entry=c_sched, prio=1)
+    m.process("waiter", entry=w_wait, prio=0)
+    out, _ = run1(m)
+    assert float(out.procs.locals_f[1, 0]) == 2.0
+    assert int(out.procs.locals_i[1, 0]) == pr.CANCELLED
+
+
+def test_wait_event_interrupt_during_wait():
+    """An interrupt aborts the event wait: the signal reaches the waiter's
+    continuation, and the event's later dispatch does not double-wake."""
+    m = Model("wintr", n_flocals=1, n_ilocals=1, event_cap=16)
+    spec_box = []
+
+    @m.user_state
+    def init(params):
+        return {"h": jnp.asarray(-1, jnp.int32),
+                "fired_t": jnp.asarray(-1.0, jnp.float64)}
+
+    @m.handler
+    def on_fire(sim, subj, arg):
+        return api.set_user(sim, {**sim.user, "fired_t": api.clock(sim)})
+
+    @m.block
+    def i_sched(sim, p, sig):
+        sim, h = api.schedule(sim, 5.0, 0, on_fire)
+        sim = api.set_user(sim, {**sim.user, "h": h})
+        return sim, cmd.hold(2.0, next_pc=i_intr.pc)
+
+    @m.block
+    def i_intr(sim, p, sig):
+        sim = api.interrupt(sim, spec_box[0], 1, 42)
+        return sim, cmd.exit_()
+
+    w_wait = _waiter_blocks(m, lambda sim: sim.user["h"])
+    m.process("interrupter", entry=i_sched, prio=1)
+    m.process("waiter", entry=w_wait, prio=0)
+    spec = m.build()
+    spec_box.append(spec)
+    run = cl.make_run(spec)
+    out = jax.jit(run)(cl.init_sim(spec, 0, 0, None))
+    assert int(out.err) == 0
+    # waiter got 42 at t=2, not SUCCESS at t=5
+    assert float(out.procs.locals_f[1, 0]) == 2.0
+    assert int(out.procs.locals_i[1, 0]) == 42
+    # the event itself still fired
+    assert float(out.user["fired_t"]) == 5.0
+    assert int(out.procs.await_evt[1]) == -1

@@ -1,0 +1,70 @@
+#!/bin/bash
+# CI matrix (parity: the reference's debug/release x sanitizer matrix,
+# `.github/workflows/ci.yml:12-158`, transposed to trace-time tiers):
+#   tests x {default, CIMBA_NDEBUG=1, CIMBA_NASSERT=1} x {1, 8 virtual devs}
+# plus the golden seed-pinned suite and a perf smoke threshold.
+#
+# Usage: tools/ci.sh [quick]
+#   quick = the default+8dev cell, golden suite, perf smoke only (PR gate);
+#   full  = all six cells (nightly).
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+
+fail=0
+run_cell() {
+  local label="$1"; shift
+  echo "=== $label ==="
+  if ! "$@"; then
+    echo "=== $label FAILED ==="
+    fail=1
+  fi
+}
+
+devs1="--xla_force_host_platform_device_count=1"
+devs8="--xla_force_host_platform_device_count=8"
+
+if [ "${1:-full}" = "quick" ]; then
+  run_cell "tests default/8dev" env XLA_FLAGS="$devs8" \
+    python -m pytest tests/ -x -q
+else
+  for tier in "default:" "ndebug:CIMBA_NDEBUG=1" "nassert:CIMBA_NASSERT=1"; do
+    name="${tier%%:*}"; envkv="${tier#*:}"
+    for devs in "1:$devs1" "8:$devs8"; do
+      n="${devs%%:*}"; flags="${devs#*:}"
+      if [ -n "$envkv" ]; then
+        run_cell "tests $name/${n}dev" env "$envkv" XLA_FLAGS="$flags" \
+          python -m pytest tests/ -x -q
+      else
+        run_cell "tests $name/${n}dev" env XLA_FLAGS="$flags" \
+          python -m pytest tests/ -x -q
+      fi
+    done
+  done
+fi
+
+run_cell "golden suite" env XLA_FLAGS="$devs8" \
+  python -m pytest tests/test_golden.py -q
+
+# perf smoke: the CPU proxy must clear a floor (catches a 5x stepper or
+# sampler regression; the real perf tracking runs on TPU via bench.py)
+run_cell "perf smoke" python - <<'EOF'
+import json, os, subprocess, sys
+env = dict(os.environ)
+env["CIMBA_BENCH_FORCE_CPU"] = "1"
+env["CIMBA_BENCH_R"] = "64"
+env["CIMBA_BENCH_OBJECTS"] = "500"
+out = subprocess.run(
+    [sys.executable, "bench.py"], env=env, capture_output=True, text=True,
+    timeout=900,
+).stdout.strip().splitlines()[-1]
+rate = json.loads(out)["value"]
+floor = float(os.environ.get("CIMBA_PERF_FLOOR", "30000"))
+print(f"cpu smoke rate {rate:.0f} ev/s (floor {floor:.0f})")
+sys.exit(0 if rate >= floor else 1)
+EOF
+
+run_cell "multichip dryrun" python __graft_entry__.py 8
+
+exit $fail
