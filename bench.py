@@ -86,9 +86,16 @@ from cimba_tpu.models import mm1  # noqa: E402
 
 def _default_scale():
     """Backend-sized defaults: wide batches for accelerators, small ones
-    for a CPU smoke run (matters on 1-core CI boxes)."""
+    for a CPU smoke run (matters on 1-core CI boxes).
+
+    TPU note (measured, v5e, round 2): the rate saturates at R~1024 and the
+    device program's wall time grows linearly with R*N beyond that; a
+    single while_loop running >~3 min trips the runtime watchdog
+    (UNAVAILABLE "kernel fault").  R=4096 x N=500 is ~25 s of device time —
+    the same saturated rate with a wide safety margin.  See BENCH_NOTES.md
+    for the full scaling curve."""
     if jax.default_backend() != "cpu":
-        return 8192, 2000
+        return 4096, 500
     return 256, 500
 
 

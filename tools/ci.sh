@@ -2,7 +2,8 @@
 # CI matrix (parity: the reference's debug/release x sanitizer matrix,
 # `.github/workflows/ci.yml:12-158`, transposed to trace-time tiers):
 #   tests x {default, CIMBA_NDEBUG=1, CIMBA_NASSERT=1} x {1, 8 virtual devs}
-# plus the golden seed-pinned suite and a perf smoke threshold.
+# (each cell includes the golden seed-pinned suite) plus a perf smoke
+# threshold.
 #
 # Usage: tools/ci.sh [quick]
 #   quick = the default+8dev cell, golden suite, perf smoke only (PR gate);
@@ -44,8 +45,7 @@ else
   done
 fi
 
-run_cell "golden suite" env XLA_FLAGS="$devs8" \
-  python -m pytest tests/test_golden.py -q
+# (the golden suite runs inside every `pytest tests/` cell above)
 
 # perf smoke: the CPU proxy must clear a floor (catches a 5x stepper or
 # sampler regression; the real perf tracking runs on TPU via bench.py)
