@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+from cimba_tpu import config
+from cimba_tpu.core import dyn
+from cimba_tpu.config import INDEX_DTYPE
 from cimba_tpu.core.loop import ERR_USER, Sim
 
 _I = INDEX_DTYPE
-_R = REAL_DTYPE
+_R = config.REAL
 
 
 def clock(sim: Sim):
@@ -31,29 +33,35 @@ def draw(sim: Sim, dist, *params):
 
 def got(sim: Sim, p):
     """Result register: the item produced by this process's last GET."""
-    return sim.procs.got[p]
+    return dyn.dget(sim.procs.got, p)
 
 
 def local_f(sim: Sim, p, k: int):
-    return sim.procs.locals_f[p, k]
+    return dyn.dget(sim.procs.locals_f[:, k], p)
 
 
 def set_local_f(sim: Sim, p, k: int, v) -> Sim:
     return sim._replace(
         procs=sim.procs._replace(
-            locals_f=sim.procs.locals_f.at[p, k].set(jnp.asarray(v, _R))
+            locals_f=dyn.set_col(
+                sim.procs.locals_f, k,
+                dyn.dset(sim.procs.locals_f[:, k], p, jnp.asarray(v, _R)),
+            )
         )
     )
 
 
 def local_i(sim: Sim, p, k: int):
-    return sim.procs.locals_i[p, k]
+    return dyn.dget(sim.procs.locals_i[:, k], p)
 
 
 def set_local_i(sim: Sim, p, k: int, v) -> Sim:
     return sim._replace(
         procs=sim.procs._replace(
-            locals_i=sim.procs.locals_i.at[p, k].set(jnp.asarray(v, _I))
+            locals_i=dyn.set_col(
+                sim.procs.locals_i, k,
+                dyn.dset(sim.procs.locals_i[:, k], p, jnp.asarray(v, _I)),
+            )
         )
     )
 
@@ -61,7 +69,10 @@ def set_local_i(sim: Sim, p, k: int, v) -> Sim:
 def add_local_i(sim: Sim, p, k: int, dv=1) -> Sim:
     return sim._replace(
         procs=sim.procs._replace(
-            locals_i=sim.procs.locals_i.at[p, k].add(jnp.asarray(dv, _I))
+            locals_i=dyn.set_col(
+                sim.procs.locals_i, k,
+                dyn.dadd(sim.procs.locals_i[:, k], p, jnp.asarray(dv, _I)),
+            )
         )
     )
 
@@ -104,10 +115,14 @@ def queue_position(sim: Sim, q, item):
     qid = q.id if hasattr(q, "id") else q
     items = sim.queues.items[qid]
     cap = items.shape[0]
-    j = jnp.arange(cap)
-    idx = (sim.queues.head[qid] + j) % cap
-    hit = (j < sim.queues.size[qid]) & (items[idx] == jnp.asarray(item, _R))
-    return jnp.where(jnp.any(hit), jnp.argmax(hit) + 1, 0).astype(_I)
+    # gather-free: for each physical slot c, its queue position is
+    # (c - head) mod cap; a slot is occupied if that position < size.
+    # (A permutation gather over the ring would not lower in Mosaic.)
+    c = jnp.arange(cap)
+    pos = (c - sim.queues.head[qid]) % cap
+    hit = (pos < sim.queues.size[qid]) & (items == jnp.asarray(item, _R))
+    best = jnp.min(jnp.where(hit, pos, cap))
+    return jnp.where(jnp.any(hit), best + 1, 0).astype(_I)
 
 
 def pqueue_position(sim: Sim, q, item):
@@ -226,7 +241,7 @@ def cond_signal(sim: Sim, spec, condition) -> Sim:
 
 def proc_status(sim: Sim, p):
     """CREATED/RUNNING/FINISHED (parity: cmb_process_status)."""
-    return sim.procs.status[p]
+    return dyn.dget(sim.procs.status, p)
 
 
 def schedule(sim: Sim, t, prio, handler, subj=0, arg=0):

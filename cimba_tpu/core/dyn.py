@@ -1,0 +1,153 @@
+"""Dynamic indexing as one-hot select/reduce — the Mosaic-safe idiom.
+
+Written scalar-style (one replication) like the rest of the engine and
+batched by vmap.  ``arr[i]`` / ``arr.at[i].set(v)`` with a traced index
+lower to ``gather`` / ``scatter`` HLOs once vmapped, and Mosaic supports
+almost none of that (only full same-shape ``take_along_axis``).  A one-hot
+compare + select + reduce over the small component axes (event slots,
+processes, guard slots — all <= a few hundred) expresses the same thing
+with ops every backend vectorizes; under vmap the lane dimension rides
+along untouched.  On the VPU this is also *faster* than a gather for these
+sizes: a handful of full-width vector ops, no serialized address math.
+
+All helpers accept an optional ``pred``: ``dset(a, i, v, pred)`` is
+``a.at[i].set(jnp.where(pred, v, a[i]))`` fused into the mask — the
+dominant call pattern in the engine's handlers.
+
+Out-of-range semantics differ from jnp deliberately: a negative or too-big
+index matches no slot, so reads return the dtype's zero and writes are
+no-ops.  Every engine call site either pre-clips or guards with ``pred``;
+"no match -> no effect" is the *safer* default for the -1 sentinel handles
+threaded through the loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_I32 = jnp.int32
+
+
+def bwhere(pred, x, y):
+    """``jnp.where`` with a lower-rank bool ``pred``, Mosaic-safe.
+
+    Broadcasting a bool against a higher-rank operand inserts a minor
+    dim on an i1 vector, which Mosaic only supports for 32-bit types;
+    routing the rank expansion through int32 sidesteps it.  Semantically
+    identical to ``jnp.where(pred, x, y)``.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    p = jnp.asarray(pred)
+    rank = max(x.ndim, y.ndim)
+    extra = rank - p.ndim
+    if x.dtype == jnp.bool_ and y.dtype == jnp.bool_:
+        # bool select via logic: Mosaic's select_n on i1 payloads needs an
+        # i32->i1 truncation it does not support
+        shape = jnp.broadcast_shapes(x.shape, y.shape, p.shape + (1,) * max(extra, 0))
+        pf = _expand_mask(p, shape, max(extra, 0))
+        return (pf & jnp.broadcast_to(x, shape)) | (
+            ~pf & jnp.broadcast_to(y, shape)
+        )
+    if extra <= 0 or p.dtype != jnp.bool_:
+        return jnp.where(p, x, y)
+    shape = jnp.broadcast_shapes(x.shape, y.shape)
+    pi = p.astype(_I32).reshape(p.shape + (1,) * extra)
+    return jnp.where(jnp.broadcast_to(pi, shape) != 0, x, y)
+
+
+def _expand_mask(mask, shape, extra: int):
+    """bool mask -> bool of ``shape`` without i1 rank-expansion."""
+    if extra == 0:
+        return jnp.broadcast_to(mask, shape)
+    mi = mask.astype(_I32).reshape(mask.shape + (1,) * extra)
+    return jnp.broadcast_to(mi, shape) != 0
+
+
+def _oh1(n: int, i):
+    """One-hot bool mask [n] for scalar index i (batched by vmap)."""
+    return lax.broadcasted_iota(_I32, (n,), 0) == jnp.asarray(i, _I32)
+
+
+def _oh2(n0: int, n1: int, i0, i1):
+    """One-hot bool mask [n0, n1] for a 2-D index."""
+    m0 = lax.broadcasted_iota(_I32, (n0, n1), 0) == jnp.asarray(i0, _I32)
+    m1 = lax.broadcasted_iota(_I32, (n0, n1), 1) == jnp.asarray(i1, _I32)
+    return m0 & m1
+
+
+def _reduce_pick(mask, arr):
+    """Sum-reduce ``arr`` where ``mask``, over the mask's dims.
+
+    With a one-hot (or empty) mask this *is* the indexed read; zero when
+    nothing matches.  Bool arrays reduce with any() to stay bool.
+    """
+    k = mask.ndim
+    m = _expand_mask(mask, arr.shape, arr.ndim - k)
+    if arr.dtype == jnp.bool_:
+        return jnp.any(m & arr, axis=tuple(range(k)))
+    # dtype pinned: under x64, jnp.sum would promote i32 -> i64
+    return jnp.sum(jnp.where(m, arr, jnp.zeros((), arr.dtype)),
+                   axis=tuple(range(k)), dtype=arr.dtype)
+
+
+def dget(arr, i):
+    """``arr[i]`` (scalar if arr is 1-D, row if 2-D+) for a traced index."""
+    return _reduce_pick(_oh1(arr.shape[0], i), arr)
+
+
+def dget2(arr, i0, i1):
+    """``arr[i0, i1]`` for traced indices."""
+    return _reduce_pick(_oh2(arr.shape[0], arr.shape[1], i0, i1), arr)
+
+
+def _masked_write(arr, mask, v, pred):
+    if pred is not True:
+        mask = mask & pred
+    m = _expand_mask(mask, arr.shape, arr.ndim - mask.ndim)
+    v = jnp.asarray(v, arr.dtype)
+    if arr.dtype == jnp.bool_:
+        # i1 select_n needs a truncation Mosaic lacks; use logic
+        return (m & jnp.broadcast_to(v, arr.shape)) | (~m & arr)
+    return jnp.where(m, v, arr)
+
+
+def dset(arr, i, v, pred=True):
+    """``arr.at[i].set(v)``, gated by ``pred`` (no-op where false)."""
+    return _masked_write(arr, _oh1(arr.shape[0], i), v, pred)
+
+
+def dset2(arr, i0, i1, v, pred=True):
+    """``arr.at[i0, i1].set(v)``, gated by ``pred``."""
+    return _masked_write(
+        arr, _oh2(arr.shape[0], arr.shape[1], i0, i1), v, pred
+    )
+
+
+def dadd(arr, i, v, pred=True):
+    """``arr.at[i].add(v)``, gated by ``pred``."""
+    mask = _oh1(arr.shape[0], i)
+    if pred is not True:
+        mask = mask & pred
+    m = _expand_mask(mask, arr.shape, arr.ndim - mask.ndim)
+    v = jnp.asarray(v, arr.dtype)
+    return arr + jnp.where(m, v, jnp.zeros((), arr.dtype))
+
+
+def set_col(arr, k: int, col):
+    """``arr.at[:, k].set(col)`` for a *static* column index — expressed as
+    a select over a constant column mask (``.at[:, k]`` lowers to a scatter,
+    which Mosaic has no rule for)."""
+    m = lax.broadcasted_iota(_I32, (1, arr.shape[1]), 1) == k
+    return jnp.where(m, col[:, None].astype(arr.dtype), arr)
+
+
+def dadd2(arr, i0, i1, v, pred=True):
+    """``arr.at[i0, i1].add(v)``, gated by ``pred``."""
+    mask = _oh2(arr.shape[0], arr.shape[1], i0, i1)
+    if pred is not True:
+        mask = mask & pred
+    m = _expand_mask(mask, arr.shape, arr.ndim - mask.ndim)
+    v = jnp.asarray(v, arr.dtype)
+    return arr + jnp.where(m, v, jnp.zeros((), arr.dtype))

@@ -30,10 +30,14 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+from jax import lax
 
-from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE, TIME_DTYPE
+from cimba_tpu import config
+from cimba_tpu.core import dyn
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.config import argmax32 as _argmax32, argmin32 as _argmin32
 
-_T = TIME_DTYPE
+_T = config.TIME
 _I = INDEX_DTYPE
 
 #: slot value meaning "no event here"
@@ -100,11 +104,11 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
     """
     t = jnp.asarray(t, _T)
     free = jnp.isinf(es.time)
-    slot = jnp.argmax(free).astype(_I)  # first free slot
-    ok = free[slot] & jnp.isfinite(t)
+    slot = _argmax32(free).astype(_I)  # first free slot
+    ok = jnp.any(free) & jnp.isfinite(t)
 
     def put(a, v):
-        return a.at[slot].set(jnp.where(ok, v, a[slot]))
+        return dyn.dset(a, slot, v, ok)
 
     es2 = EventSet(
         time=put(es.time, t),
@@ -117,7 +121,7 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
         next_seq=es.next_seq + jnp.where(ok, 1, 0).astype(_I),
         overflow=es.overflow | ~ok,
     )
-    handle = jnp.where(ok, _handle(slot, es.gen[slot]), NULL_HANDLE)
+    handle = jnp.where(ok, _handle(slot, dyn.dget(es.gen, slot)), NULL_HANDLE)
     return es2, handle.astype(_I)
 
 
@@ -133,8 +137,8 @@ def _valid(es: EventSet, handle):
     slot = _slot_of(handle)
     return (
         (handle >= 0)
-        & jnp.isfinite(es.time[slot])
-        & (es.gen[slot] == _gen_of(handle))
+        & jnp.isfinite(dyn.dget(es.time, slot))
+        & (dyn.dget(es.gen, slot) == _gen_of(handle))
     )
 
 
@@ -145,8 +149,8 @@ def cancel(es: EventSet, handle):
     ok = _valid(es, handle)
     return (
         es._replace(
-            time=es.time.at[slot].set(jnp.where(ok, NEVER, es.time[slot])),
-            gen=es.gen.at[slot].add(jnp.where(ok, 1, 0).astype(_I)),
+            time=dyn.dset(es.time, slot, NEVER, ok),
+            gen=dyn.dadd(es.gen, slot, 1, ok),
         ),
         ok,
     )
@@ -159,9 +163,7 @@ def reschedule(es: EventSet, handle, new_t):
     ok = _valid(es, handle) & jnp.isfinite(jnp.asarray(new_t, _T))
     return (
         es._replace(
-            time=es.time.at[slot].set(
-                jnp.where(ok, jnp.asarray(new_t, _T), es.time[slot])
-            )
+            time=dyn.dset(es.time, slot, jnp.asarray(new_t, _T), ok)
         ),
         ok,
     )
@@ -173,9 +175,7 @@ def reprioritize(es: EventSet, handle, new_prio):
     ok = _valid(es, handle)
     return (
         es._replace(
-            prio=es.prio.at[slot].set(
-                jnp.where(ok, jnp.asarray(new_prio, _I), es.prio[slot])
-            )
+            prio=dyn.dset(es.prio, slot, jnp.asarray(new_prio, _I), ok)
         ),
         ok,
     )
@@ -190,41 +190,49 @@ def _argnext(es: EventSet):
     m2 = m1 & (es.prio == p_max)
     s_min = jnp.min(jnp.where(m2, es.seq, jnp.iinfo(jnp.int32).max))
     m3 = m2 & (es.seq == s_min)
-    return jnp.argmax(m3).astype(_I), jnp.isfinite(t_min)
+    # exactly one slot set when found; the mask doubles as the one-hot
+    # for the field reads in peek/pop (dyn._reduce_pick)
+    first = _argmax32(m3).astype(_I)
+    m3 = m3 & (
+        lax.broadcasted_iota(jnp.int32, m3.shape, 0) == first
+    )
+    return first, m3, jnp.isfinite(t_min)
 
 
 def peek(es: EventSet) -> Event:
-    slot, found = _argnext(es)
+    slot, m, found = _argnext(es)
     return Event(
-        time=es.time[slot],
-        prio=es.prio[slot],
-        kind=es.kind[slot],
-        subj=es.subj[slot],
-        arg=es.arg[slot],
+        time=dyn._reduce_pick(m, es.time),
+        prio=dyn._reduce_pick(m, es.prio),
+        kind=dyn._reduce_pick(m, es.kind),
+        subj=dyn._reduce_pick(m, es.subj),
+        arg=dyn._reduce_pick(m, es.arg),
         found=found,
         handle=jnp.where(
-            found, _handle(slot, es.gen[slot]), NULL_HANDLE
+            found, _handle(slot, dyn._reduce_pick(m, es.gen)), NULL_HANDLE
         ).astype(_I),
     )
 
 
 def pop(es: EventSet):
     """Remove and return the next event; (es, Event)."""
-    slot, found = _argnext(es)
+    slot, m, found = _argnext(es)
     ev = Event(
-        time=es.time[slot],
-        prio=es.prio[slot],
-        kind=es.kind[slot],
-        subj=es.subj[slot],
-        arg=es.arg[slot],
+        time=dyn._reduce_pick(m, es.time),
+        prio=dyn._reduce_pick(m, es.prio),
+        kind=dyn._reduce_pick(m, es.kind),
+        subj=dyn._reduce_pick(m, es.subj),
+        arg=dyn._reduce_pick(m, es.arg),
         found=found,
         handle=jnp.where(
-            found, _handle(slot, es.gen[slot]), NULL_HANDLE
+            found, _handle(slot, dyn._reduce_pick(m, es.gen)), NULL_HANDLE
         ).astype(_I),
     )
+    # found is per-lane scalar under vmap: combine with the slot mask in
+    # int32 (an i1 rank-expansion would not compile in Mosaic)
     es2 = es._replace(
-        time=es.time.at[slot].set(jnp.where(found, NEVER, es.time[slot])),
-        gen=es.gen.at[slot].add(jnp.where(found, 1, 0).astype(_I)),
+        time=dyn.bwhere(found, jnp.where(m, _T(NEVER), es.time), es.time),
+        gen=es.gen + m.astype(_I) * found.astype(_I),
     )
     return es2, ev
 
@@ -272,6 +280,8 @@ def pattern_find(es: EventSet, kind=WILDCARD, subj=WILDCARD):
     """Handle of the soonest matching event, else NULL_HANDLE."""
     m = _match(es, kind, subj)
     t = jnp.where(m, es.time, NEVER)
-    slot = jnp.argmin(t).astype(_I)
-    found = jnp.isfinite(t[slot])
-    return jnp.where(found, _handle(slot, es.gen[slot]), NULL_HANDLE).astype(_I)
+    slot = _argmin32(t).astype(_I)
+    found = jnp.isfinite(jnp.min(t))
+    return jnp.where(
+        found, _handle(slot, dyn.dget(es.gen, slot)), NULL_HANDLE
+    ).astype(_I)

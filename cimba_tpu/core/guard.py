@@ -24,6 +24,9 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from cimba_tpu.core import dyn
+from cimba_tpu.config import argmax32 as _argmax32
+
 from cimba_tpu.config import INDEX_DTYPE
 
 _I = INDEX_DTYPE
@@ -58,11 +61,11 @@ def enqueue(g: Guards, gid, pid, prio, seq_override=None):
     number: a woken waiter whose retry failed keeps its FIFO position
     (parity with the reference, where the front waiter is never dequeued
     on an unsatisfied signal and so cannot lose its place)."""
-    row_pid = g.pid[gid]
+    row_pid = dyn.dget(g.pid, gid)
     free = row_pid == NO_PID
-    slot = jnp.argmax(free).astype(_I)
-    ok = free[slot]
-    fresh = g.next_seq[gid]
+    slot = _argmax32(free).astype(_I)
+    ok = jnp.any(free)
+    fresh = dyn.dget(g.next_seq, gid)
     if seq_override is None:
         seq = fresh
     else:
@@ -70,14 +73,14 @@ def enqueue(g: Guards, gid, pid, prio, seq_override=None):
         seq = jnp.where(so >= 0, so, fresh)
 
     def put(a, v):
-        return a.at[gid, slot].set(jnp.where(ok, v, a[gid, slot]))
+        return dyn.dset2(a, gid, slot, v, ok)
 
     g2 = Guards(
         pid=put(g.pid, jnp.asarray(pid, _I)),
         prio=put(g.prio, jnp.asarray(prio, _I)),
         seq=put(g.seq, seq),
-        next_seq=g.next_seq.at[gid].add(
-            jnp.where(ok & (seq == fresh), 1, 0).astype(_I)
+        next_seq=dyn.dadd(
+            g.next_seq, gid, 1, ok & (seq == fresh)
         ),
         overflow=g.overflow | ~ok,
     )
@@ -87,51 +90,54 @@ def enqueue(g: Guards, gid, pid, prio, seq_override=None):
 def _argbest(g: Guards, gid):
     """Best waiter: highest priority, then earliest entry (parity with the
     reference's priority -> entry-time -> seq ordering)."""
-    row_pid = g.pid[gid]
+    row_pid = dyn.dget(g.pid, gid)
+    row_prio = dyn.dget(g.prio, gid)
+    row_seq = dyn.dget(g.seq, gid)
     live = row_pid != NO_PID
-    p_max = jnp.max(jnp.where(live, g.prio[gid], jnp.iinfo(jnp.int32).min))
-    m = live & (g.prio[gid] == p_max)
-    s_min = jnp.min(jnp.where(m, g.seq[gid], jnp.iinfo(jnp.int32).max))
-    m2 = m & (g.seq[gid] == s_min)
-    return jnp.argmax(m2).astype(_I), jnp.any(live)
+    p_max = jnp.max(jnp.where(live, row_prio, jnp.iinfo(jnp.int32).min))
+    m = live & (row_prio == p_max)
+    s_min = jnp.min(jnp.where(m, row_seq, jnp.iinfo(jnp.int32).max))
+    m2 = m & (row_seq == s_min)
+    return _argmax32(m2).astype(_I), jnp.any(live)
 
 
 def pop_best(g: Guards, gid):
     """Dequeue the best waiter; returns (g, pid) with pid == NO_PID if the
     guard is empty."""
     slot, found = _argbest(g, gid)
-    pid = jnp.where(found, g.pid[gid, slot], NO_PID)
-    g2 = g._replace(
-        pid=g.pid.at[gid, slot].set(jnp.where(found, NO_PID, g.pid[gid, slot]))
-    )
+    pid = jnp.where(found, dyn.dget2(g.pid, gid, slot), NO_PID)
+    g2 = g._replace(pid=dyn.dset2(g.pid, gid, slot, NO_PID, found))
     return g2, pid
 
 
 def remove(g: Guards, gid, pid):
     """Remove a specific process (parity: ``cmb_resourceguard_remove``, used
     when a waiting process is interrupted/killed); returns (g, existed)."""
-    row = g.pid[gid]
+    row = dyn.dget(g.pid, gid)
     m = row == jnp.asarray(pid, _I)
     existed = jnp.any(m)
-    return g._replace(pid=g.pid.at[gid].set(jnp.where(m, NO_PID, row))), existed
+    return g._replace(
+        pid=dyn.dset(g.pid, gid, jnp.where(m, NO_PID, row))
+    ), existed
 
 
 def is_empty(g: Guards, gid):
-    return ~jnp.any(g.pid[gid] != NO_PID)
+    return ~jnp.any(dyn.dget(g.pid, gid) != NO_PID)
 
 
 def length(g: Guards, gid):
-    return jnp.sum((g.pid[gid] != NO_PID).astype(_I))
+    return jnp.sum((dyn.dget(g.pid, gid) != NO_PID).astype(_I))
 
 
 def reprioritize(g: Guards, gid, pid, new_prio):
     """Update a waiter's priority in place (parity: the reprio hooks that
     reshuffle guard queues when a process's priority changes,
     `src/cmb_process.c:170-220`)."""
-    row = g.pid[gid]
+    row = dyn.dget(g.pid, gid)
     m = row == jnp.asarray(pid, _I)
     return g._replace(
-        prio=g.prio.at[gid].set(
-            jnp.where(m, jnp.asarray(new_prio, _I), g.prio[gid])
+        prio=dyn.dset(
+            g.prio, gid,
+            jnp.where(m, jnp.asarray(new_prio, _I), dyn.dget(g.prio, gid)),
         )
     )

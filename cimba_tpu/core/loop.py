@@ -43,7 +43,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE, TIME_DTYPE
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.config import argmax32 as _argmax32, argmin32 as _argmin32
+from cimba_tpu.core import dyn
 from cimba_tpu.core import eventset as ev
 from cimba_tpu.core import guard as gd
 from cimba_tpu.core import process as pr
@@ -52,8 +55,8 @@ from cimba_tpu.random import bits as rb
 from cimba_tpu.stats import timeseries as ts
 
 _I = INDEX_DTYPE
-_R = REAL_DTYPE
-_T = TIME_DTYPE
+_R = config.REAL
+_T = config.TIME
 
 K_PROC = 0   # resume process `subj` with signal `arg`
 K_TIMER = 1  # same dispatch; separate kind so timers_clear can pattern-cancel
@@ -133,7 +136,7 @@ def _tree_select(pred, a, b):
     # rewrite every leaf of the Sim (full-state HBM traffic per event was
     # the dominant dispatch cost before this)
     return jax.tree.map(
-        lambda x, y: x if x is y else jnp.where(pred, x, y), a, b
+        lambda x, y: x if x is y else dyn.bwhere(pred, x, y), a, b
     )
 
 
@@ -241,11 +244,85 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
             jnp.asarray(ERR_EVENT_OVERFLOW, _I),
             jnp.zeros((), _I),
         ),
-        n_events=jnp.zeros((), jnp.int64),
+        n_events=jnp.zeros((), config.COUNT),
     )
 
 
 # --- micro-ops on Sim --------------------------------------------------------
+
+
+class _ConstTable:
+    """Tiny static per-component table indexed by a traced id.
+
+    Emits a select chain over scalar literals instead of materializing an
+    array constant: Pallas kernels cannot capture array constants, and for
+    the 1–8 entries these tables have, a literal select chain is also
+    cheaper than a dynamic-slice gather on the VPU.  Behaves like the
+    1-D array it replaces for ``tab[idx]`` with an int or traced index.
+    """
+
+    def __init__(self, values, dtype):
+        self._values = list(values)
+        self._dtype = dtype
+
+    def __len__(self):
+        return len(self._values)
+
+    def __getitem__(self, idx):
+        vals = self._values
+        if isinstance(idx, int):
+            return jnp.asarray(vals[idx], self._dtype)
+        out = jnp.asarray(vals[0], self._dtype)
+        for j in range(1, len(vals)):
+            out = jnp.where(
+                idx == j, jnp.asarray(vals[j], self._dtype), out
+            )
+        return out
+
+
+def _bounded_while(cond, body, init, bound: int):
+    """``lax.while_loop`` that degrades to a masked ``fori_loop`` in kernel
+    mode.  A vmapped while's condition is a vector, which Mosaic cannot
+    lower (`scf.condition` needs a scalar); the masked fori runs ``bound``
+    iterations with no-op steps once ``cond`` goes false — equivalent as
+    long as ``bound`` covers the longest real chain, which the callers
+    guarantee (and check, via their runaway error codes)."""
+    if not config.KERNEL_MODE:
+        return lax.while_loop(cond, body, init)
+
+    def fbody(_, c):
+        live = cond(c)
+        c2 = body(c)
+        return jax.tree.map(
+            lambda x, y: x if x is y else dyn.bwhere(live, x, y), c2, c
+        )
+
+    return lax.fori_loop(0, bound, fbody, init)
+
+
+def _vswitch(idx, branches, *args):
+    """``lax.switch`` for the vmapped interpreter: evaluate every branch and
+    fold with binary tree-selects.  Under vmap a lax.switch executes every
+    traced branch anyway, but lowers to an N-ary ``select_n`` which Mosaic
+    rejects (only 2-way selects); the explicit fold emits the same work as
+    2-way selects and costs nothing extra because ``_tree_select`` passes
+    untouched leaves through.  Outside kernel mode the real lax.switch is
+    kept: an *unbatched* run then executes only the selected branch
+    (side effects like debug callbacks fire once, and scalar oracle runs
+    stay cheap)."""
+    if not config.KERNEL_MODE:
+        return lax.switch(idx, branches, *args)
+    outs = [b(*args) for b in branches]
+    idx = jnp.asarray(idx, _I)
+    result = outs[0]
+    for j in range(1, len(outs)):
+        sel = idx == j
+        result = jax.tree.map(
+            lambda x, y: x if x is y else dyn.bwhere(sel, x, y),
+            outs[j],
+            result,
+        )
+    return result
 
 
 def _set_err(sim: Sim, pred, code) -> Sim:
@@ -266,14 +343,14 @@ def _schedule_wake(sim: Sim, pred, p, sig) -> Sim:
     wake_handle so _unwait can cancel it (an untracked wake would double-
     resume a process that gets interrupted/stopped at the same timestamp)."""
     es2, handle = ev.schedule(
-        sim.events, sim.clock, sim.procs.prio[p], K_PROC, p, sig
+        sim.events, sim.clock, dyn.dget(sim.procs.prio, p), K_PROC, p, sig
     )
     es2 = _tree_select(pred, es2, sim.events)
-    handle = jnp.where(pred, handle, sim.procs.wake_handle[p])
+    handle = jnp.where(pred, handle, dyn.dget(sim.procs.wake_handle, p))
     sim = sim._replace(
         events=es2,
         procs=sim.procs._replace(
-            wake_handle=sim.procs.wake_handle.at[p].set(handle)
+            wake_handle=dyn.dset(sim.procs.wake_handle, p, handle)
         ),
     )
     return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
@@ -298,20 +375,20 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False) -> Sim:
     woken-but-unsatisfied waiter keeps its place (no starvation; parity
     with the reference's evaluate-the-front-without-dequeuing signals)."""
     seq_override = jnp.where(
-        jnp.asarray(is_retry), sim.procs.pend_seq[p], jnp.asarray(-1, _I)
+        jnp.asarray(is_retry), dyn.dget(sim.procs.pend_seq, p), jnp.asarray(-1, _I)
     )
     g2, ok, seq = gd.enqueue(
-        sim.guards, gid, p, sim.procs.prio[p], seq_override=seq_override
+        sim.guards, gid, p, dyn.dget(sim.procs.prio, p), seq_override=seq_override
     )
     procs = sim.procs._replace(
-        pend_tag=sim.procs.pend_tag.at[p].set(cmd.tag),
-        pend_f=sim.procs.pend_f.at[p].set(cmd.f),
-        pend_f2=sim.procs.pend_f2.at[p].set(cmd.f2),
-        pend_i=sim.procs.pend_i.at[p].set(cmd.i),
-        pend_pc=sim.procs.pend_pc.at[p].set(cmd.next_pc),
-        pend_guard=sim.procs.pend_guard.at[p].set(jnp.asarray(gid, _I)),
-        pend_seq=sim.procs.pend_seq.at[p].set(seq),
-        pc=sim.procs.pc.at[p].set(cmd.next_pc),
+        pend_tag=dyn.dset(sim.procs.pend_tag, p, cmd.tag),
+        pend_f=dyn.dset(sim.procs.pend_f, p, cmd.f),
+        pend_f2=dyn.dset(sim.procs.pend_f2, p, cmd.f2),
+        pend_i=dyn.dset(sim.procs.pend_i, p, cmd.i),
+        pend_pc=dyn.dset(sim.procs.pend_pc, p, cmd.next_pc),
+        pend_guard=dyn.dset(sim.procs.pend_guard, p, jnp.asarray(gid, _I)),
+        pend_seq=dyn.dset(sim.procs.pend_seq, p, seq),
+        pc=dyn.dset(sim.procs.pc, p, cmd.next_pc),
     )
     sim = sim._replace(procs=procs, guards=g2)
     return _set_err(sim, ~ok, ERR_GUARD_OVERFLOW)
@@ -320,17 +397,17 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False) -> Sim:
 def _clear_pend(sim: Sim, p) -> Sim:
     return sim._replace(
         procs=sim.procs._replace(
-            pend_tag=sim.procs.pend_tag.at[p].set(pr.NO_PEND),
-            pend_guard=sim.procs.pend_guard.at[p].set(-1),
+            pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND),
+            pend_guard=dyn.dset(sim.procs.pend_guard, p, -1),
         )
     )
 
 
 def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
     """step_record on one row of a batched StepAccum."""
-    one = jax.tree.map(lambda x: x[row], acc)
+    one = jax.tree.map(lambda x: dyn.dget(x, row), acc)
     upd = ts.step_record(one, t, v)
-    return jax.tree.map(lambda a, u: a.at[row].set(u), acc, upd)
+    return jax.tree.map(lambda a, u: dyn.dset(a, row, u), acc, upd)
 
 
 def _record_row_if(flags, acc, row, t, v):
@@ -343,7 +420,9 @@ def _record_row_if(flags, acc, row, t, v):
     rec = _record_row(acc, row, t, v)
     if all(flags):
         return rec
-    mask = jnp.asarray(flags)[row]
+    # int table compared != 0: a bool _ConstTable would emit i1 select
+    # chains, which Mosaic cannot lower in kernel mode
+    mask = _ConstTable([int(bool(f)) for f in flags], jnp.int32)[row] != 0
     return _tree_select(mask, rec, acc)
 
 
@@ -351,10 +430,10 @@ def _cancel_wake(sim: Sim, p) -> Sim:
     """Cancel p's outstanding wake event (generation-safe: a no-op if the
     event already fired).  The analog of cancelling a stale hold timer
     (`src/cmb_process.c:344-349`)."""
-    es2, _ = ev.cancel(sim.events, sim.procs.wake_handle[p])
+    es2, _ = ev.cancel(sim.events, dyn.dget(sim.procs.wake_handle, p))
     return sim._replace(
         events=es2,
-        procs=sim.procs._replace(wake_handle=sim.procs.wake_handle.at[p].set(-1)),
+        procs=sim.procs._replace(wake_handle=dyn.dset(sim.procs.wake_handle, p, -1)),
     )
 
 
@@ -362,7 +441,7 @@ def _unwait(sim: Sim, p) -> Sim:
     """Detach p from whatever it waits on: guard entry, pending command,
     wake event (parity: cmi_process_cancel_awaiteds,
     `src/cmb_process.c:694-748`)."""
-    gid = sim.procs.pend_guard[p]
+    gid = dyn.dget(sim.procs.pend_guard, p)
     has_guard = gid >= 0
     g2, _ = gd.remove(sim.guards, jnp.maximum(gid, 0), p)
     sim = sim._replace(guards=_tree_select(has_guard, g2, sim.guards))
@@ -370,8 +449,8 @@ def _unwait(sim: Sim, p) -> Sim:
     sim = _cancel_wake(sim, p)
     return sim._replace(
         procs=sim.procs._replace(
-            await_pid=sim.procs.await_pid.at[p].set(-1),
-            await_evt=sim.procs.await_evt.at[p].set(-1),
+            await_pid=dyn.dset(sim.procs.await_pid, p, -1),
+            await_evt=dyn.dset(sim.procs.await_evt, p, -1),
         )
     )
 
@@ -382,14 +461,14 @@ def _scan_evt_waiters(sim: Sim, decide) -> Sim:
     resume and their await cleared."""
 
     def body(i, sim):
-        h = sim.procs.await_evt[i]
-        awaiting = (h >= 0) & (sim.procs.status[i] == pr.RUNNING)
+        h = dyn.dget(sim.procs.await_evt, i)
+        awaiting = (h >= 0) & (dyn.dget(sim.procs.status, i) == pr.RUNNING)
         wake, sig = decide(sim, h)
         wake = wake & awaiting
         sim = _schedule_wake(sim, wake, i, sig)
         return sim._replace(
             procs=sim.procs._replace(
-                await_evt=sim.procs.await_evt.at[i].set(
+                await_evt=dyn.dset(sim.procs.await_evt, i, 
                     jnp.where(wake, -1, h)
                 )
             )
@@ -430,14 +509,14 @@ def _wake_waiters(sim: Sim, target, sig) -> Sim:
     n_procs = sim.procs.await_pid.shape[0]
 
     def body(i, sim):
-        waiting = (sim.procs.await_pid[i] == target) & (
-            sim.procs.status[i] == pr.RUNNING
+        waiting = (dyn.dget(sim.procs.await_pid, i) == target) & (
+            dyn.dget(sim.procs.status, i) == pr.RUNNING
         )
         sim = _schedule_wake(sim, waiting, i, sig)
         return sim._replace(
             procs=sim.procs._replace(
-                await_pid=sim.procs.await_pid.at[i].set(
-                    jnp.where(waiting, -1, sim.procs.await_pid[i])
+                await_pid=dyn.dset(sim.procs.await_pid, i, 
+                    jnp.where(waiting, -1, dyn.dget(sim.procs.await_pid, i))
                 )
             )
         )
@@ -457,20 +536,20 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
       contract, `src/cmb_buffer.c:194-346`)."""
     sig = jnp.asarray(sig, _I)
     if spec.pools:
-        p_guard_c = jnp.asarray([pl.guard for pl in spec.pools], _I)
+        p_guard_c = _ConstTable([pl.guard for pl in spec.pools], _I)
         p_rec_c = [pl.record for pl in spec.pools]
-        p_cap_c = jnp.asarray([pl.capacity for pl in spec.pools], _R)
+        p_cap_c = _ConstTable([pl.capacity for pl in spec.pools], _R)
         k = jnp.clip(pend.i, 0, len(spec.pools) - 1)
         is_pool = (pend.tag == pr.C_POOL_ACQ) | (pend.tag == pr.C_POOL_PRE)
         do_rb = is_pool & (sig != pr.PREEMPTED)
-        excess = jnp.maximum(sim.pools.held[k, p] - pend.f2, 0.0)
+        excess = jnp.maximum(dyn.dget2(sim.pools.held, k, p) - pend.f2, 0.0)
         rb = sim._replace(
             pools=sim.pools._replace(
-                level=sim.pools.level.at[k].add(excess),
-                held=sim.pools.held.at[k, p].add(-excess),
+                level=dyn.dadd(sim.pools.level, k, excess),
+                held=dyn.dadd2(sim.pools.held, k, p, -excess),
                 acc=_record_row_if(
                     p_rec_c, sim.pools.acc, k, sim.clock,
-                    p_cap_c[k] - (sim.pools.level[k] + excess),
+                    p_cap_c[k] - (dyn.dget(sim.pools.level, k) + excess),
                 ),
             )
         )
@@ -481,8 +560,8 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
         obtained = pend.f2 - pend.f
         sim = sim._replace(
             procs=sim.procs._replace(
-                got=sim.procs.got.at[p].set(
-                    jnp.where(is_buf, obtained, sim.procs.got[p])
+                got=dyn.dset(sim.procs.got, p, 
+                    jnp.where(is_buf, obtained, dyn.dget(sim.procs.got, p))
                 )
             )
         )
@@ -496,11 +575,11 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig) -> Sim:
     stop — must come through here; clearing the pend without the cleanup
     silently breaks the rollback/partial-fulfillment contracts."""
     pend = pr.Command(
-        sim.procs.pend_tag[p],
-        sim.procs.pend_f[p],
-        sim.procs.pend_f2[p],
-        sim.procs.pend_i[p],
-        sim.procs.pend_pc[p],
+        dyn.dget(sim.procs.pend_tag, p),
+        dyn.dget(sim.procs.pend_f, p),
+        dyn.dget(sim.procs.pend_f2, p),
+        dyn.dget(sim.procs.pend_i, p),
+        dyn.dget(sim.procs.pend_pc, p),
     )
     # _abort_cleanup self-gates on pend.tag, so NO_PEND is a clean no-op
     return _abort_cleanup(spec, _unwait(sim, p), p, pend, sig)
@@ -510,9 +589,9 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     """Terminate process p: status, waiter wakeup, resource cleanup
     (parity: kill semantics — drop resources, cancel awaits, wake waiters,
     `src/cmb_process.c:776-828`)."""
-    r_guard = jnp.asarray([r.guard for r in spec.resources] or [0], _I)
-    p_guard = jnp.asarray([pl.guard for pl in spec.pools] or [0], _I)
-    p_cap = jnp.asarray([pl.capacity for pl in spec.pools] or [0.0], _R)
+    r_guard = _ConstTable([r.guard for r in spec.resources] or [0], _I)
+    p_guard = _ConstTable([pl.guard for pl in spec.pools] or [0], _I)
+    p_cap = _ConstTable([pl.capacity for pl in spec.pools] or [0.0], _R)
 
     r_rec = [r.record for r in spec.resources]
     p_rec = [pl.record for pl in spec.pools]
@@ -523,18 +602,18 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     sim = sim._replace(events=es2)
     sim = sim._replace(
         procs=sim.procs._replace(
-            status=sim.procs.status.at[p].set(pr.FINISHED),
-            exit_sig=sim.procs.exit_sig.at[p].set(jnp.asarray(exit_sig, _I)),
+            status=dyn.dset(sim.procs.status, p, pr.FINISHED),
+            exit_sig=dyn.dset(sim.procs.exit_sig, p, jnp.asarray(exit_sig, _I)),
         )
     )
     sim = _wake_waiters(sim, p, exit_sig)
 
     # drop binary resources held by p (holdable drop protocol)
     def drop_res(rid, sim):
-        held = sim.resources.holder[rid] == p
+        held = dyn.dget(sim.resources.holder, rid) == p
         r2 = Resources(
-            holder=sim.resources.holder.at[rid].set(
-                jnp.where(held, -1, sim.resources.holder[rid])
+            holder=dyn.dset(sim.resources.holder, rid, 
+                jnp.where(held, -1, dyn.dget(sim.resources.holder, rid))
             ),
             acc=_tree_select(
                 held,
@@ -548,16 +627,16 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
 
     # pool units held by p return to the pool
     def drop_pool(k, sim):
-        amt = sim.pools.held[k, p]
+        amt = dyn.dget2(sim.pools.held, k, p)
         has = amt > 0.0
         p2 = sim.pools._replace(
-            level=sim.pools.level.at[k].add(jnp.where(has, amt, 0.0)),
-            held=sim.pools.held.at[k, p].set(0.0),
+            level=dyn.dadd(sim.pools.level, k, jnp.where(has, amt, 0.0)),
+            held=dyn.dset2(sim.pools.held, k, p, 0.0),
             acc=_tree_select(
                 has,
                 _record_row_if(
                     p_rec, sim.pools.acc, k, sim.clock,
-                    p_cap[k] - (sim.pools.level[k] + amt),
+                    p_cap[k] - (dyn.dget(sim.pools.level, k) + amt),
                 ),
                 sim.pools.acc,
             ),
@@ -580,7 +659,7 @@ def interrupt(spec: ModelSpec, sim: Sim, target, sig) -> Sim:
     """Deliver ``sig`` to a waiting process NOW, aborting whatever it waits
     on (parity: cmb_process_interrupt, `include/cmb_process.h:406`)."""
     target = jnp.asarray(target, _I)
-    alive = sim.procs.status[target] == pr.RUNNING
+    alive = dyn.dget(sim.procs.status, target) == pr.RUNNING
     intr = _abort_wait(spec, sim, target, sig)
     intr = _schedule_wake(intr, alive, target, jnp.asarray(sig, _I))
     return _tree_select(alive, intr, sim)
@@ -591,7 +670,7 @@ def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
     drops its resources, cancels its waits/timers, wakes its waiters with
     STOPPED."""
     target = jnp.asarray(target, _I)
-    alive = sim.procs.status[target] == pr.RUNNING
+    alive = dyn.dget(sim.procs.status, target) == pr.RUNNING
     stopped = finish_process(spec, sim, target, pr.STOPPED)
     return _tree_select(alive, stopped, sim)
 
@@ -601,7 +680,7 @@ def timer_add(sim: Sim, p, dur, sig):
     cmb_process_timer_add); returns (sim, handle)."""
     es2, handle = ev.schedule(
         sim.events, sim.clock + jnp.maximum(jnp.asarray(dur, _T), 0.0),
-        sim.procs.prio[p], K_TIMER, p, sig,
+        dyn.dget(sim.procs.prio, p), K_TIMER, p, sig,
     )
     sim = sim._replace(events=es2)
     return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW), handle
@@ -632,14 +711,14 @@ def priority_set(sim: Sim, p, new_prio) -> Sim:
     """Change a process's priority, reshuffling its wake event and guard
     entry (parity: cmb_process_priority_set, `src/cmb_process.c:170-220`)."""
     new_prio = jnp.asarray(new_prio, _I)
-    es2, _ = ev.reprioritize(sim.events, sim.procs.wake_handle[p], new_prio)
-    gid = sim.procs.pend_guard[p]
+    es2, _ = ev.reprioritize(sim.events, dyn.dget(sim.procs.wake_handle, p), new_prio)
+    gid = dyn.dget(sim.procs.pend_guard, p)
     g2 = gd.reprioritize(sim.guards, jnp.maximum(gid, 0), p, new_prio)
     g2 = _tree_select(gid >= 0, g2, sim.guards)
     return sim._replace(
         events=es2,
         guards=g2,
-        procs=sim.procs._replace(prio=sim.procs.prio.at[p].set(new_prio)),
+        procs=sim.procs._replace(prio=dyn.dset(sim.procs.prio, p, new_prio)),
     )
 
 
@@ -651,7 +730,7 @@ def _cond_satisfied(spec: ModelSpec, sim: Sim, cid, pid):
         (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
         for c in spec.conditions
     ]
-    return lax.switch(
+    return _vswitch(
         jnp.clip(jnp.asarray(cid, _I), 0, len(pred_fns) - 1), pred_fns, sim,
         pid,
     )
@@ -664,12 +743,12 @@ def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
     wakeups re-wait inside the framework)."""
     if not spec.conditions:
         return sim
-    c_guard = jnp.asarray([c.guard for c in spec.conditions], _I)
+    c_guard = _ConstTable([c.guard for c in spec.conditions], _I)
     cid = jnp.asarray(cid, _I)
     gid = c_guard[cid]
 
     def visit(slot, sim):
-        pid = sim.guards.pid[gid, slot]
+        pid = dyn.dget2(sim.guards.pid, gid, slot)
         live = pid != gd.NO_PID
         q = jnp.maximum(pid, 0)
         satisfied = _cond_satisfied(spec, sim, cid, q)
@@ -723,19 +802,19 @@ def _may_wait_events(spec: ModelSpec, sim: Sim) -> bool:
 
 
 def _make_apply(spec: ModelSpec, used_tags=None):
-    q_cap = jnp.asarray([q.capacity for q in spec.queues] or [1], _I)
-    q_front = jnp.asarray([q.front_guard for q in spec.queues] or [0], _I)
-    q_rear = jnp.asarray([q.rear_guard for q in spec.queues] or [0], _I)
-    r_guard = jnp.asarray([r.guard for r in spec.resources] or [0], _I)
-    p_guard = jnp.asarray([p.guard for p in spec.pools] or [0], _I)
-    p_cap = jnp.asarray([p.capacity for p in spec.pools] or [0.0], _R)
-    b_cap = jnp.asarray([b.capacity for b in spec.buffers] or [0.0], _R)
-    b_front = jnp.asarray([b.front_guard for b in spec.buffers] or [0], _I)
-    b_rear = jnp.asarray([b.rear_guard for b in spec.buffers] or [0], _I)
-    pq_cap = jnp.asarray([q.capacity for q in spec.pqueues] or [1], _I)
-    pq_front = jnp.asarray([q.front_guard for q in spec.pqueues] or [0], _I)
-    pq_rear = jnp.asarray([q.rear_guard for q in spec.pqueues] or [0], _I)
-    c_guard = jnp.asarray([c.guard for c in spec.conditions] or [0], _I)
+    q_cap = _ConstTable([q.capacity for q in spec.queues] or [1], _I)
+    q_front = _ConstTable([q.front_guard for q in spec.queues] or [0], _I)
+    q_rear = _ConstTable([q.rear_guard for q in spec.queues] or [0], _I)
+    r_guard = _ConstTable([r.guard for r in spec.resources] or [0], _I)
+    p_guard = _ConstTable([p.guard for p in spec.pools] or [0], _I)
+    p_cap = _ConstTable([p.capacity for p in spec.pools] or [0.0], _R)
+    b_cap = _ConstTable([b.capacity for b in spec.buffers] or [0.0], _R)
+    b_front = _ConstTable([b.front_guard for b in spec.buffers] or [0], _I)
+    b_rear = _ConstTable([b.rear_guard for b in spec.buffers] or [0], _I)
+    pq_cap = _ConstTable([q.capacity for q in spec.pqueues] or [1], _I)
+    pq_front = _ConstTable([q.front_guard for q in spec.pqueues] or [0], _I)
+    pq_rear = _ConstTable([q.rear_guard for q in spec.pqueues] or [0], _I)
+    c_guard = _ConstTable([c.guard for c in spec.conditions] or [0], _I)
     q_rec = [q.record for q in spec.queues]
     r_rec = [r.record for r in spec.resources]
     p_rec = [pl.record for pl in spec.pools]
@@ -744,20 +823,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def set_pc(sim, p, pc):
         return sim._replace(
-            procs=sim.procs._replace(pc=sim.procs.pc.at[p].set(pc))
+            procs=sim.procs._replace(pc=dyn.dset(sim.procs.pc, p, pc))
         )
 
     def h_hold(sim: Sim, p, cmd: pr.Command, is_retry):
         dur = jnp.maximum(cmd.f, 0.0)
         es2, handle = ev.schedule(
-            sim.events, sim.clock + dur, sim.procs.prio[p], K_PROC, p,
+            sim.events, sim.clock + dur, dyn.dget(sim.procs.prio, p), K_PROC, p,
             pr.SUCCESS,
         )
         sim = sim._replace(
             events=es2,
             procs=sim.procs._replace(
-                wake_handle=sim.procs.wake_handle.at[p].set(handle),
-                pc=sim.procs.pc.at[p].set(cmd.next_pc),
+                wake_handle=dyn.dset(sim.procs.wake_handle, p, handle),
+                pc=dyn.dset(sim.procs.pc, p, cmd.next_pc),
             ),
         )
         sim = _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
@@ -771,7 +850,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_put(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
-        size = sim.queues.size[qid]
+        size = dyn.dget(sim.queues.size, qid)
         cap = q_cap[qid]
         # no-jump-ahead fairness (parity: src/cmb_resource.c:202-233): a
         # fresh caller must queue behind existing waiters; a woken caller
@@ -779,11 +858,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         may = is_retry | gd.is_empty(sim.guards, q_rear[qid])
         full = (size >= cap) | ~may
 
-        col = (sim.queues.head[qid] + size) % cap
+        col = (dyn.dget(sim.queues.head, qid) + size) % cap
         q2 = Queues(
-            items=sim.queues.items.at[qid, col].set(cmd.f),
+            items=dyn.dset2(sim.queues.items, qid, col, cmd.f),
             head=sim.queues.head,
-            size=sim.queues.size.at[qid].add(1),
+            size=dyn.dadd(sim.queues.size, qid, 1),
             acc=_record_row_if(
                 q_rec, sim.queues.acc, qid, sim.clock, (size + 1).astype(_R)
             ),
@@ -799,24 +878,24 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_get(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
-        size = sim.queues.size[qid]
+        size = dyn.dget(sim.queues.size, qid)
         may = is_retry | gd.is_empty(sim.guards, q_front[qid])
         empty = (size <= 0) | ~may
         cap = q_cap[qid]
 
-        head = sim.queues.head[qid]
-        item = sim.queues.items[qid, head]
+        head = dyn.dget(sim.queues.head, qid)
+        item = dyn.dget2(sim.queues.items, qid, head)
         q2 = Queues(
             items=sim.queues.items,
-            head=sim.queues.head.at[qid].set((head + 1) % cap),
-            size=sim.queues.size.at[qid].add(-1),
+            head=dyn.dset(sim.queues.head, qid, (head + 1) % cap),
+            size=dyn.dadd(sim.queues.size, qid, -1),
             acc=_record_row_if(
                 q_rec, sim.queues.acc, qid, sim.clock, (size - 1).astype(_R)
             ),
         )
         ok_sim = sim._replace(
             queues=q2,
-            procs=sim.procs._replace(got=sim.procs.got.at[p].set(item)),
+            procs=sim.procs._replace(got=dyn.dset(sim.procs.got, p, item)),
         )
         ok_sim = _guard_signal(ok_sim, q_rear[qid])   # space for putters
         ok_sim = _guard_signal(ok_sim, q_front[qid])  # leftover items cascade
@@ -827,7 +906,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def _grab_resource(sim, p, rid):
         r2 = Resources(
-            holder=sim.resources.holder.at[rid].set(p),
+            holder=dyn.dset(sim.resources.holder, rid, p),
             acc=_record_row_if(
                 r_rec, sim.resources.acc, rid, sim.clock, 1.0
             ),
@@ -836,7 +915,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
         rid = cmd.i
-        free = sim.resources.holder[rid] < 0
+        free = dyn.dget(sim.resources.holder, rid) < 0
         may_grab = is_retry | gd.is_empty(sim.guards, r_guard[rid])
         ok = free & may_grab
 
@@ -849,10 +928,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         grab if free; kick a holder of <= priority (it resumes with
         PREEMPTED, its pending waits cancelled); else wait like acquire."""
         rid = cmd.i
-        holder = sim.resources.holder[rid]
+        holder = dyn.dget(sim.resources.holder, rid)
         free = holder < 0
         victim = jnp.maximum(holder, 0)
-        can_kick = ~free & (sim.procs.prio[p] >= sim.procs.prio[victim])
+        can_kick = ~free & (dyn.dget(sim.procs.prio, p) >= dyn.dget(sim.procs.prio, victim))
 
         # kick path: cancel victim's awaits (incl. pool rollback /
         # buffer partial report if it was waiting on one), deliver PREEMPTED
@@ -861,7 +940,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # holder switch: no utilization record needed (still in use)
         kick_sim = kick_sim._replace(
             resources=kick_sim.resources._replace(
-                holder=kick_sim.resources.holder.at[rid].set(p)
+                holder=dyn.dset(kick_sim.resources.holder, rid, p)
             )
         )
         kick_sim = set_pc(kick_sim, p, cmd.next_pc)
@@ -876,9 +955,9 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_release(sim: Sim, p, cmd: pr.Command, is_retry):
         rid = cmd.i
-        owner_ok = sim.resources.holder[rid] == p
+        owner_ok = dyn.dget(sim.resources.holder, rid) == p
         r2 = Resources(
-            holder=sim.resources.holder.at[rid].set(-1),
+            holder=dyn.dset(sim.resources.holder, rid, -1),
             acc=_record_row_if(
                 r_rec, sim.resources.acc, rid, sim.clock, 0.0
             ),
@@ -891,12 +970,12 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def _pool_stamp(sim, k, q):
         """Stamp q's grab order on its first units (LIFO victim order)."""
-        fresh = sim.pools.held[k, q] <= 0.0
+        fresh = dyn.dget2(sim.pools.held, k, q) <= 0.0
         pools = sim.pools._replace(
-            held_seq=sim.pools.held_seq.at[k, q].set(
-                jnp.where(fresh, sim.pools.next_seq[k], sim.pools.held_seq[k, q])
+            held_seq=dyn.dset2(sim.pools.held_seq, k, q, 
+                jnp.where(fresh, dyn.dget(sim.pools.next_seq, k), dyn.dget2(sim.pools.held_seq, k, q))
             ),
-            next_seq=sim.pools.next_seq.at[k].add(
+            next_seq=dyn.dadd(sim.pools.next_seq, k, 
                 jnp.where(fresh, 1, 0).astype(_I)
             ),
         )
@@ -911,17 +990,17 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         k = cmd.i
         rem = cmd.f
         init_held = jnp.where(
-            is_retry, sim.procs.pend_f2[p], sim.pools.held[k, p]
+            is_retry, dyn.dget(sim.procs.pend_f2, p), dyn.dget2(sim.pools.held, k, p)
         )
 
         # greedy grab (the reference pool has no no-jump-ahead gate: new
         # callers race for available units; FIFO applies to the wait line)
-        take = jnp.clip(rem, 0.0, sim.pools.level[k])
+        take = jnp.clip(rem, 0.0, dyn.dget(sim.pools.level, k))
         sim = _pool_stamp(sim, k, p)
         sim = sim._replace(
             pools=sim.pools._replace(
-                level=sim.pools.level.at[k].add(-take),
-                held=sim.pools.held.at[k, p].add(take),
+                level=dyn.dadd(sim.pools.level, k, -take),
+                held=dyn.dadd2(sim.pools.held, k, p, take),
             )
         )
         rem = rem - take
@@ -933,8 +1012,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             def can_mug(carry):
                 sim, rem = carry
                 vmask = (
-                    (sim.pools.held[k] > 0.0)
-                    & (sim.procs.prio < sim.procs.prio[p])
+                    (dyn.dget(sim.pools.held, k) > 0.0)
+                    & (sim.procs.prio < dyn.dget(sim.procs.prio, p))
                     & (pididx != p)
                 )
                 return (rem > 0.0) & jnp.any(vmask)
@@ -942,8 +1021,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             def mug_one(carry):
                 sim, rem = carry
                 vmask = (
-                    (sim.pools.held[k] > 0.0)
-                    & (sim.procs.prio < sim.procs.prio[p])
+                    (dyn.dget(sim.pools.held, k) > 0.0)
+                    & (sim.procs.prio < dyn.dget(sim.procs.prio, p))
                     & (pididx != p)
                 )
                 # lowest priority first, then LIFO (latest grab first)
@@ -951,18 +1030,17 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                     jnp.where(vmask, sim.procs.prio, jnp.iinfo(jnp.int32).max)
                 )
                 m2 = vmask & (sim.procs.prio == vprio)
-                vseq = jnp.max(jnp.where(m2, sim.pools.held_seq[k], -1))
-                v = jnp.argmax(m2 & (sim.pools.held_seq[k] == vseq)).astype(_I)
-                loot = sim.pools.held[k, v]
+                vseq = jnp.max(jnp.where(m2, dyn.dget(sim.pools.held_seq, k), -1))
+                v = _argmax32(m2 & (dyn.dget(sim.pools.held_seq, k) == vseq)).astype(_I)
+                loot = dyn.dget2(sim.pools.held, k, v)
                 used = jnp.minimum(loot, rem)
                 surplus = loot - used
                 sim = sim._replace(
                     pools=sim.pools._replace(
-                        held=sim.pools.held.at[k, v]
-                        .set(0.0)
-                        .at[k, p]
-                        .add(used),
-                        level=sim.pools.level.at[k].add(surplus),
+                        held=dyn.dadd2(
+                            dyn.dset2(sim.pools.held, k, v, 0.0), k, p, used
+                        ),
+                        level=dyn.dadd(sim.pools.level, k, surplus),
                     )
                 )
                 # victim loses everything and resumes with PREEMPTED
@@ -970,10 +1048,12 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 sim = _schedule_wake(sim, True, v, pr.PREEMPTED)
                 return sim, rem - used
 
-            sim, rem = lax.while_loop(can_mug, mug_one, (sim, rem))
+            sim, rem = _bounded_while(
+                can_mug, mug_one, (sim, rem), spec.n_procs
+            )
 
         done = rem <= 0.0
-        in_use = p_cap[k] - sim.pools.level[k]
+        in_use = p_cap[k] - dyn.dget(sim.pools.level, k)
         sim = sim._replace(
             pools=sim.pools._replace(
                 acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use)
@@ -1002,12 +1082,12 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
         k = cmd.i
-        amt = jnp.minimum(cmd.f, sim.pools.held[k, p])  # partial ok
-        owner_ok = sim.pools.held[k, p] >= cmd.f - 1e-12
-        in_use = p_cap[k] - (sim.pools.level[k] + amt)
+        amt = jnp.minimum(cmd.f, dyn.dget2(sim.pools.held, k, p))  # partial ok
+        owner_ok = dyn.dget2(sim.pools.held, k, p) >= cmd.f - 1e-12
+        in_use = p_cap[k] - (dyn.dget(sim.pools.level, k) + amt)
         p2 = sim.pools._replace(
-            level=sim.pools.level.at[k].add(amt),
-            held=sim.pools.held.at[k, p].add(-amt),
+            level=dyn.dadd(sim.pools.level, k, amt),
+            held=dyn.dadd2(sim.pools.held, k, p, -amt),
             acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
         )
         sim2 = sim._replace(pools=p2)
@@ -1029,17 +1109,17 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         would ping-pong wakes between starved waiters forever)."""
         b = cmd.i
         rem = cmd.f
-        total = jnp.where(is_retry, sim.procs.pend_f2[p], cmd.f)
-        room = sim.buffers.level[b] if getting else b_cap[b] - sim.buffers.level[b]
+        total = jnp.where(is_retry, dyn.dget(sim.procs.pend_f2, p), cmd.f)
+        room = dyn.dget(sim.buffers.level, b) if getting else b_cap[b] - dyn.dget(sim.buffers.level, b)
         moved = jnp.clip(rem, 0.0, room)
-        level2 = sim.buffers.level[b] + jnp.where(getting, -moved, moved)
+        level2 = dyn.dget(sim.buffers.level, b) + jnp.where(getting, -moved, moved)
         rem2 = rem - moved
         done = rem2 <= 0.0
         my_guard = b_front[b] if getting else b_rear[b]
         other_guard = b_rear[b] if getting else b_front[b]
         sim = sim._replace(
             buffers=Buffers(
-                level=sim.buffers.level.at[b].set(level2),
+                level=dyn.dset(sim.buffers.level, b, level2),
                 acc=_record_row_if(
                     b_rec, sim.buffers.acc, b, sim.clock, level2
                 ),
@@ -1051,7 +1131,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         ok_sim = set_pc(
             ok_sim._replace(
                 procs=ok_sim.procs._replace(
-                    got=ok_sim.procs.got.at[p].set(total)
+                    got=dyn.dset(ok_sim.procs.got, p, total)
                 )
             ),
             p,
@@ -1070,18 +1150,18 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
-        n_live = jnp.sum(sim.pqueues.live[qid].astype(_I))
+        n_live = jnp.sum(dyn.dget(sim.pqueues.live, qid).astype(_I))
         may = is_retry | gd.is_empty(sim.guards, pq_rear[qid])
         full = (n_live >= pq_cap[qid]) | ~may
-        free_col = jnp.argmax(~sim.pqueues.live[qid]).astype(_I)
+        free_col = _argmax32(~dyn.dget(sim.pqueues.live, qid)).astype(_I)
         pq2 = PQueues(
-            items=sim.pqueues.items.at[qid, free_col].set(cmd.f),
-            prio=sim.pqueues.prio.at[qid, free_col].set(cmd.f2),
-            seq=sim.pqueues.seq.at[qid, free_col].set(
-                sim.pqueues.next_seq[qid]
+            items=dyn.dset2(sim.pqueues.items, qid, free_col, cmd.f),
+            prio=dyn.dset2(sim.pqueues.prio, qid, free_col, cmd.f2),
+            seq=dyn.dset2(sim.pqueues.seq, qid, free_col, 
+                dyn.dget(sim.pqueues.next_seq, qid)
             ),
-            live=sim.pqueues.live.at[qid, free_col].set(True),
-            next_seq=sim.pqueues.next_seq.at[qid].add(1),
+            live=dyn.dset2(sim.pqueues.live, qid, free_col, True),
+            next_seq=dyn.dadd(sim.pqueues.next_seq, qid, 1),
             acc=_record_row_if(
                 pq_rec, sim.pqueues.acc, qid, sim.clock,
                 (n_live + 1).astype(_R),
@@ -1096,21 +1176,21 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
-        live = sim.pqueues.live[qid]
+        live = dyn.dget(sim.pqueues.live, qid)
         may = is_retry | gd.is_empty(sim.guards, pq_front[qid])
         empty = ~jnp.any(live) | ~may
         n_live = jnp.sum(live.astype(_I))
         # highest priority, then FIFO
         neg_inf = jnp.asarray(-jnp.inf, _R)
-        p_best = jnp.max(jnp.where(live, sim.pqueues.prio[qid], neg_inf))
-        m = live & (sim.pqueues.prio[qid] == p_best)
+        p_best = jnp.max(jnp.where(live, dyn.dget(sim.pqueues.prio, qid), neg_inf))
+        m = live & (dyn.dget(sim.pqueues.prio, qid) == p_best)
         s_min = jnp.min(
-            jnp.where(m, sim.pqueues.seq[qid], jnp.iinfo(jnp.int32).max)
+            jnp.where(m, dyn.dget(sim.pqueues.seq, qid), jnp.iinfo(jnp.int32).max)
         )
-        col = jnp.argmax(m & (sim.pqueues.seq[qid] == s_min)).astype(_I)
-        item = sim.pqueues.items[qid, col]
+        col = _argmax32(m & (dyn.dget(sim.pqueues.seq, qid) == s_min)).astype(_I)
+        item = dyn.dget2(sim.pqueues.items, qid, col)
         pq2 = sim.pqueues._replace(
-            live=sim.pqueues.live.at[qid, col].set(False),
+            live=dyn.dset2(sim.pqueues.live, qid, col, False),
             acc=_record_row_if(
                 pq_rec, sim.pqueues.acc, qid, sim.clock,
                 (n_live - 1).astype(_R),
@@ -1118,7 +1198,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         ok_sim = sim._replace(
             pqueues=pq2,
-            procs=sim.procs._replace(got=sim.procs.got.at[p].set(item)),
+            procs=sim.procs._replace(got=dyn.dset(sim.procs.got, p, item)),
         )
         ok_sim = _guard_signal(ok_sim, pq_rear[qid])
         ok_sim = _guard_signal(ok_sim, pq_front[qid])
@@ -1140,17 +1220,17 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry):
         tgt = cmd.i
-        finished = sim.procs.status[tgt] == pr.FINISHED
+        finished = dyn.dget(sim.procs.status, tgt) == pr.FINISHED
         # already finished: yield anyway and deliver the target's exit
         # signal (SUCCESS or STOPPED) through an immediate wakeup, so the
         # continuation sees the same signal either way
         done_sim = _schedule_wake(
-            set_pc(sim, p, cmd.next_pc), finished, p, sim.procs.exit_sig[tgt]
+            set_pc(sim, p, cmd.next_pc), finished, p, dyn.dget(sim.procs.exit_sig, tgt)
         )
         wait_sim = set_pc(
             sim._replace(
                 procs=sim.procs._replace(
-                    await_pid=sim.procs.await_pid.at[p].set(tgt)
+                    await_pid=dyn.dset(sim.procs.await_pid, p, tgt)
                 )
             ),
             p,
@@ -1172,7 +1252,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         wait_sim = set_pc(
             sim._replace(
                 procs=sim.procs._replace(
-                    await_evt=sim.procs.await_evt.at[p].set(h)
+                    await_evt=dyn.dset(sim.procs.await_evt, p, h)
                 )
             ),
             p,
@@ -1214,7 +1294,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     if used_tags is None:
         def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
-            return lax.switch(
+            return _vswitch(
                 jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p,
                 cmd, jnp.asarray(is_retry),
             )
@@ -1226,16 +1306,14 @@ def _make_apply(spec: ModelSpec, used_tags=None):
     # h_invalid -> ERR_USER, a contained failure, never corruption.
     used = sorted(t for t in used_tags if 0 <= t < pr.N_COMMANDS)
     table = [handlers[t] for t in used] + [h_invalid]
-    import numpy as _np
-
-    lut = _np.full((pr.N_COMMANDS,), len(used), _np.int32)
+    lut_vals = [len(used)] * pr.N_COMMANDS
     for j, t in enumerate(used):
-        lut[t] = j
-    lut = jnp.asarray(lut)
+        lut_vals[t] = j
+    lut = _ConstTable(lut_vals, jnp.int32)
 
     def apply_command(sim: Sim, p, cmd: pr.Command, is_retry=False):
         idx = lut[jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1)]
-        return lax.switch(
+        return _vswitch(
             idx, table, sim, p, cmd, jnp.asarray(is_retry),
         )
 
@@ -1261,8 +1339,8 @@ def make_step(spec: ModelSpec):
         return _cache["apply"](sim, p, cmd, is_retry)
 
     def run_block(sim: Sim, p, sig):
-        return lax.switch(
-            jnp.clip(sim.procs.pc[p], 0, len(blocks) - 1),
+        return _vswitch(
+            jnp.clip(dyn.dget(sim.procs.pc, p), 0, len(blocks) - 1),
             blocks,
             sim,
             p,
@@ -1281,17 +1359,17 @@ def make_step(spec: ModelSpec):
         # every signal delivery, `src/cmb_process.c:694-748`)
         sim = sim._replace(
             procs=sim.procs._replace(
-                await_pid=sim.procs.await_pid.at[p].set(-1),
-                await_evt=sim.procs.await_evt.at[p].set(-1),
+                await_pid=dyn.dset(sim.procs.await_pid, p, -1),
+                await_evt=dyn.dset(sim.procs.await_evt, p, -1),
             )
         )
 
         pend = pr.Command(
-            sim.procs.pend_tag[p],
-            sim.procs.pend_f[p],
-            sim.procs.pend_f2[p],
-            sim.procs.pend_i[p],
-            sim.procs.pend_pc[p],
+            dyn.dget(sim.procs.pend_tag, p),
+            dyn.dget(sim.procs.pend_f, p),
+            dyn.dget(sim.procs.pend_f2, p),
+            dyn.dget(sim.procs.pend_i, p),
+            dyn.dget(sim.procs.pend_pc, p),
         )
         has_pend = pend.tag != pr.NO_PEND
         ok_wake = jnp.asarray(sig, _I) == pr.SUCCESS
@@ -1308,7 +1386,7 @@ def make_step(spec: ModelSpec):
         # signal), but a user timer with sig=SUCCESS can wake a pended
         # process directly — remove any surviving entry so the retry can't
         # leave a duplicate/zombie behind
-        gid = sim.procs.pend_guard[p]
+        gid = dyn.dget(sim.procs.pend_guard, p)
         g_clean, _ = gd.remove(sim.guards, jnp.maximum(gid, 0), p)
         cleaned = sim._replace(
             guards=_tree_select(gid >= 0, g_clean, sim.guards)
@@ -1320,17 +1398,29 @@ def make_step(spec: ModelSpec):
 
         def cond(carry):
             sim, sig, yielded, n, use_pend = carry
-            alive = (sim.procs.status[p] == pr.RUNNING) & (sim.err == 0)
+            alive = (dyn.dget(sim.procs.status, p) == pr.RUNNING) & (sim.err == 0)
             return ~yielded & alive & (n < MAX_CHAIN)
 
         def body(carry):
             sim, sig, _, n, use_pend = carry
-            sim2, cmd = lax.cond(
-                use_pend,
-                lambda s: (s, pend),
-                lambda s: run_block(s, p, sig),
-                sim,
-            )
+            if config.KERNEL_MODE:
+                # both arms run under vmap regardless; the explicit
+                # bwhere-fold keeps bool leaves off Mosaic's unsupported
+                # i1 select_n path
+                s_blk, c_blk = run_block(sim, p, sig)
+                sim2 = _tree_select(use_pend, sim, s_blk)
+                cmd = jax.tree.map(
+                    lambda a, b: dyn.bwhere(use_pend, a, b), pend, c_blk
+                )
+            else:
+                # scalar/XLA path keeps lax.cond: an unbatched pend-retry
+                # must not execute the block (user side effects fire once)
+                sim2, cmd = lax.cond(
+                    use_pend,
+                    lambda s: (s, pend),
+                    lambda s: run_block(s, p, sig),
+                    sim,
+                )
             sim2, yielded = apply_command(sim2, p, cmd, is_retry=use_pend)
             return (
                 sim2,
@@ -1340,7 +1430,8 @@ def make_step(spec: ModelSpec):
                 jnp.asarray(False),
             )
 
-        sim, _, yielded, n = lax.while_loop(
+        chain_bound = spec.max_chain if config.KERNEL_MODE else MAX_CHAIN
+        sim, _, yielded, n = _bounded_while(
             cond,
             body,
             (
@@ -1350,11 +1441,23 @@ def make_step(spec: ModelSpec):
                 jnp.zeros((), _I),
                 use_pend0,
             ),
+            chain_bound,
         )[:4]
-        return _set_err(sim, n >= MAX_CHAIN, ERR_CHAIN_RUNAWAY)
+        # runaway containment: in kernel mode a process still live and
+        # unyielded after spec.max_chain chained commands is flagged the
+        # same way a MAX_CHAIN overrun is on the XLA path
+        alive_end = (dyn.dget(sim.procs.status, p) == pr.RUNNING) & (
+            sim.err == 0
+        )
+        runaway = (
+            (~yielded & alive_end)
+            if config.KERNEL_MODE
+            else (n >= MAX_CHAIN)
+        )
+        return _set_err(sim, runaway, ERR_CHAIN_RUNAWAY)
 
     def on_proc(sim: Sim, subj, arg):
-        alive = sim.procs.status[subj] == pr.RUNNING
+        alive = dyn.dget(sim.procs.status, subj) == pr.RUNNING
         resumed = resume(sim, subj, arg)
         return _tree_select(alive, resumed, sim)
 
@@ -1370,7 +1473,7 @@ def make_step(spec: ModelSpec):
             events=es2,
             clock=jnp.where(event.found, event.time, sim.clock),
             n_events=sim.n_events
-            + jnp.where(event.found, 1, 0).astype(jnp.int64),
+            + jnp.where(event.found, 1, 0).astype(config.COUNT),
         )
         if _may_wait_events(spec, sim):
             # wake event-waiters before the action runs (reference order,
@@ -1385,7 +1488,7 @@ def make_step(spec: ModelSpec):
             )
         else:
             sim = sim._replace(done=sim.done | ~event.found)
-        dispatched = lax.switch(
+        dispatched = _vswitch(
             jnp.clip(event.kind, 0, len(dispatch_fns) - 1),
             dispatch_fns,
             sim,
@@ -1397,12 +1500,10 @@ def make_step(spec: ModelSpec):
     return step
 
 
-def make_run(spec: ModelSpec, t_end: Optional[float] = None):
-    """Build ``run(sim) -> sim``: dispatch events until the model stops
-    (api.stop), fails, runs out of events, or passes ``t_end``
-    (parity: cmb_event_queue_execute; t_end plays the role of the
-    user-scheduled end event)."""
-    step = make_step(spec)
+def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
+    """Build the per-replication liveness predicate ``cond(sim) -> bool``
+    used by :func:`make_run` (and by the Pallas kernel runner, which hoists
+    the while-loop out of vmap and needs the same predicate)."""
 
     def cond(sim: Sim):
         empty = ev.is_empty(sim.events)
@@ -1422,6 +1523,17 @@ def make_run(spec: ModelSpec, t_end: Optional[float] = None):
             nxt = jnp.min(sim.events.time)
             live = live & ((nxt <= t_end) | (empty & ~out_of_work))
         return live
+
+    return cond
+
+
+def make_run(spec: ModelSpec, t_end: Optional[float] = None):
+    """Build ``run(sim) -> sim``: dispatch events until the model stops
+    (api.stop), fails, runs out of events, or passes ``t_end``
+    (parity: cmb_event_queue_execute; t_end plays the role of the
+    user-scheduled end event)."""
+    step = make_step(spec)
+    cond = make_cond(spec, t_end)
 
     def run(sim: Sim) -> Sim:
         return lax.while_loop(cond, step, sim)

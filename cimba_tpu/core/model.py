@@ -124,6 +124,11 @@ class ModelSpec:
     pqueue_cap_max: int
     n_flocals: int
     n_ilocals: int
+    #: bound on non-yielding command chains for the Pallas-kernel stepper's
+    #: masked fori (the XLA path uses a dynamic while with the large
+    #: MAX_CHAIN runaway bound); raise it for models that chain many
+    #: non-blocking commands between yields
+    max_chain: int
     user_init: Optional[Callable[..., Any]]
     user_handlers: List[Callable]
 
@@ -143,12 +148,14 @@ class Model:
         n_ilocals: int = 0,
         event_cap: int = 16,
         guard_cap: int = 8,
+        max_chain: int = 16,
     ):
         self.name = name
         self.n_flocals = n_flocals
         self.n_ilocals = n_ilocals
         self.event_cap = event_cap
         self.guard_cap = guard_cap
+        self.max_chain = max_chain
         self._blocks: List[Callable] = []
         self._types: List[ProcessType] = []
         self._queues: List[QueueRef] = []
@@ -304,6 +311,7 @@ class Model:
             pqueue_cap_max=max([q.capacity for q in self._pqueues], default=1),
             n_flocals=self.n_flocals,
             n_ilocals=self.n_ilocals,
+            max_chain=self.max_chain,
             user_init=self._user_init,
             user_handlers=list(self._user_handlers),
         )

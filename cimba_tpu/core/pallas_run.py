@@ -1,0 +1,242 @@
+"""The Pallas mega-kernel event loop: whole-run stepping in VMEM.
+
+Reference parity: this is the TPU answer to the reference's hot loop —
+``cmb_event_queue_execute`` (`src/cmb_event.c:296-335`) popping from the
+hashheap (`src/cmi_hashheap.c:454-522`) at ~6M events/s/core.
+
+Why it exists: running the interpreter as a plain XLA ``lax.while_loop``
+costs ~3.5 ms of sequential fused-kernel latency *per event* plus one HBM
+round-trip of the whole batched Sim per step (measured, BENCH_NOTES.md) —
+five orders of magnitude off the reference.  Here the *entire run* executes
+inside one ``pallas_call``: every Sim leaf lives in VMEM for the duration,
+steps happen back-to-back on the VPU with no kernel-dispatch or HBM cost
+per event.
+
+Design:
+
+* **Same interpreter.**  The kernel body calls ``loop.make_step(spec)`` —
+  the exact dispatcher the XLA path runs — under ``jax.vmap``; there is no
+  second implementation of the engine semantics (the f64 XLA path stays the
+  bit-exact oracle; tests compare the two).
+* **f32 profile.**  Mosaic has no 64-bit types, so the kernel traces under
+  ``config.profile("f32")`` (f32 clock/statistics, i32 counters).  The
+  caller owns profile selection: build spec + init under f32, run here.
+* **Lane-last layout.**  A batched leaf is ``[component_dims..., L]`` with
+  the replication lane axis *last*, so lanes map onto the 128-wide VPU lane
+  dimension and small component axes (event slots, processes) land on
+  sublanes.  ``vmap(step, in_axes=-1)`` batches the interpreter; vmap's
+  while-loop batching rule turns per-lane loops into any-lane loops with
+  select masking, which Mosaic lowers fine.
+* **Chunked calls.**  One kernel invocation advances every lane by up to
+  ``chunk_steps`` events (VMEM residency bounds per-call wall time under
+  the device watchdog); an outer XLA while-loop re-invokes until every
+  lane is done.  Each re-invocation costs one HBM round-trip of the Sim —
+  amortized over ``chunk_steps`` events it is noise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cimba_tpu import config
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import ModelSpec
+
+
+def _to_lane_last(tree):
+    return jax.tree.map(lambda x: jnp.moveaxis(x, 0, -1), tree)
+
+
+def _to_lane_first(tree):
+    return jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), tree)
+
+
+def make_kernel_run(
+    spec: ModelSpec,
+    t_end: Optional[float] = None,
+    chunk_steps: int = 512,
+    max_chunks: int = 10_000,
+    interpret: bool = False,
+):
+    """Build ``run(sims) -> sims`` where ``sims`` is a lane-FIRST batched
+    Sim (the shape ``jax.vmap(init_sim)`` produces) and every lane is
+    advanced to completion inside Pallas kernels.
+
+    Must be built and called under the f32 profile
+    (``config.use_profile("f32")``); raises otherwise — Mosaic cannot
+    represent 64-bit leaves.
+    """
+    if config.active_profile() != "f32":
+        raise ValueError(
+            "make_kernel_run requires config.profile('f32') — Mosaic has "
+            "no 64-bit types; build the spec and init_sim under f32 too"
+        )
+    step = cl.make_step(spec)
+    cond = cl.make_cond(spec, t_end)
+
+    vstep = jax.vmap(step, in_axes=-1, out_axes=-1)
+    vcond_lane = jax.vmap(cond, in_axes=-1)
+
+    def batched_chunk(sim):
+        """Advance every lane by up to chunk_steps events.  The while-loop
+        is written batched by hand (scalar any-lane condition + explicit
+        per-lane masking) because a vmapped while's vector condition does
+        not lower in Mosaic; leaves are lane-last, so the [L] mask
+        broadcasts against [..., L] leaves."""
+
+        def wcond(carry):
+            sim, k = carry
+            return (k < chunk_steps) & jnp.any(vcond_lane(sim))
+
+        def wbody(carry):
+            sim, k = carry
+            live = vcond_lane(sim)
+            sim2 = vstep(sim)
+            sim = jax.tree.map(
+                lambda x, y: x if x is y else jnp.where(live, x, y),
+                sim2,
+                sim,
+            )
+            return sim, k + 1
+
+        sim, _ = lax.while_loop(
+            wcond, wbody, (sim, jnp.zeros((), jnp.int32))
+        )
+        return sim
+
+    def kernel(jaxpr, const_info, n, *refs):
+        nc = sum(1 for kind, _ in const_info if kind == "in")
+        in_refs = refs[:n]
+        const_refs = list(refs[n : n + nc])
+        out_refs = refs[n + nc :]
+        consts = []
+        for kind, payload in const_info:
+            if kind == "in":
+                shape, size = payload
+                ref = const_refs.pop(0)
+                vals = [ref[i] for i in range(size)]  # SMEM: scalar loads
+                c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
+                consts.append(c)
+            else:
+                consts.append(payload)
+        args = [r[...] for r in in_refs]
+        outs = jax.core.eval_jaxpr(jaxpr, consts, *args)
+        for r, leaf in zip(out_refs, outs):
+            r[...] = leaf
+
+    vcond = vcond_lane
+
+    def run(sims):
+        # Host-level driver, NOT for use under an outer jit.  The whole
+        # kernel path — tracing, Mosaic lowering AND compilation — must
+        # happen with x64 off: under x64, fori_loop counters, weak
+        # Python-int literals and iinfo bounds materialize as int64
+        # (Mosaic's 64->32 convert rule recurses forever), and Mosaic's
+        # own lower_fun helpers re-trace reduction identities as f64.
+        # Lowering runs at first call of the inner jit, so the first chunk
+        # invocation sits inside this scope too.  Init (u64 seed mixing)
+        # stays outside, under the session's x64 setting.
+        with jax.enable_x64(False):
+            return _run(sims)
+
+    def _run(sims):
+        sims = _to_lane_last(sims)
+        leaves, treedef = jax.tree.flatten(sims)
+        n = len(leaves)
+        # Pallas kernels cannot capture array constants (the handler LUT,
+        # per-process entry/priority tables the interpreter closes over) —
+        # and jax.closure_convert hoists only float consts.  Hoist by hand:
+        # trace the chunk to a jaxpr, ship its array consts as SMEM inputs,
+        # and eval the jaxpr inside the kernel.
+        config.KERNEL_MODE = True
+        try:
+            flat_chunk = jax.make_jaxpr(
+                lambda *ls: jax.tree.leaves(
+                    batched_chunk(jax.tree.unflatten(treedef, ls))
+                )
+            )(*leaves)
+        finally:
+            config.KERNEL_MODE = False
+        if __import__("os").environ.get("CIMBA_KERNEL_DEBUG"):
+            seen = set()
+
+            def _walk(jaxpr):
+                for eqn in jaxpr.eqns:
+                    for v in list(eqn.invars) + list(eqn.outvars):
+                        aval = getattr(v, "aval", None)
+                        if (
+                            aval is not None
+                            and hasattr(aval, "dtype")
+                            and aval.dtype.itemsize == 8
+                        ):
+                            src = jax._src.source_info_util.summarize(
+                                eqn.source_info
+                            )
+                            key = (str(eqn.primitive), str(aval.dtype), src)
+                            if key not in seen:
+                                seen.add(key)
+                                print("KERNEL64:", key)
+                    for val in eqn.params.values():
+                        vals = (
+                            val if isinstance(val, (list, tuple)) else [val]
+                        )
+                        for v2 in vals:
+                            j2 = getattr(v2, "jaxpr", None)
+                            if j2 is not None:
+                                _walk(j2 if hasattr(j2, "eqns") else j2.jaxpr)
+
+            _walk(flat_chunk.jaxpr)
+
+        const_info = []  # ("in", shape) for shipped arrays, ("lit", value)
+        consts_in = []
+        import numpy as _np
+
+        for c in flat_chunk.consts:
+            if isinstance(c, (jax.Array, _np.ndarray)):
+                const_info.append(("in", (jnp.shape(c), jnp.size(c))))
+                # integer tables ride in SMEM; rank>=1 at the boundary
+                consts_in.append(jnp.reshape(c, (-1,)))
+            else:
+                const_info.append(("lit", c))
+        chunk_call = pl.pallas_call(
+            partial(kernel, flat_chunk.jaxpr, const_info, n),
+            out_shape=[jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves],
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n
+            + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(consts_in),
+            out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n,
+            input_output_aliases={i: i for i in range(n)},
+            interpret=interpret,
+        )
+
+        # Chunks are dispatched from the host: each call is bounded device
+        # time (well under the runtime watchdog), the any-lane-live check
+        # costs one tiny jitted reduction between chunks, and — decisive —
+        # compilation of the chunk happens on its first call, still inside
+        # the x64-off scope above.
+        chunk_jit = jax.jit(
+            lambda *ls: chunk_call(*ls, *consts_in)
+        )
+        alive_jit = jax.jit(
+            lambda *ls: jnp.any(vcond(jax.tree.unflatten(treedef, ls)))
+        )
+        it = 0
+        while bool(alive_jit(*leaves)) and it < max_chunks:
+            leaves = chunk_jit(*leaves)
+            it += 1
+        if it >= max_chunks and bool(alive_jit(*leaves)):
+            raise RuntimeError(
+                f"make_kernel_run: lanes still live after max_chunks="
+                f"{max_chunks} x chunk_steps={chunk_steps} events — raise "
+                "one of them (a silent partial run would corrupt statistics)"
+            )
+        sims = jax.tree.unflatten(treedef, leaves)
+        return _to_lane_first(sims)
+
+    return run

@@ -27,10 +27,11 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
 
 _I = INDEX_DTYPE
-_R = REAL_DTYPE
+_R = config.REAL
 
 # --- signal protocol (parity: include/cmb_process.h:59-99) -------------------
 SUCCESS = 0

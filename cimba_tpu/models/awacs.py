@@ -26,12 +26,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 import cimba_tpu.random as cr
-from cimba_tpu.config import INDEX_DTYPE, REAL_DTYPE
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
 from cimba_tpu.core import api, cmd
 from cimba_tpu.core.model import Model
 from cimba_tpu.stats import summary as sm
 
-_R = REAL_DTYPE
+_R = config.REAL
 _I = INDEX_DTYPE
 
 ARENA = 100.0          # square arena half-size

@@ -62,6 +62,15 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_double,
         ctypes.POINTER(ctypes.c_double),
     ]
+    lib.cimba_oracle_mmc.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_double),
+    ]
     _lib = lib
     return lib
 
@@ -98,6 +107,30 @@ def oracle_mm1(
     assert lib is not None
     out = (ctypes.c_double * 7)()
     lib.cimba_oracle_mm1(seed, rep, n_objects, arr_mean, srv_mean, out)
+    return {
+        "clock": out[0],
+        "n": out[1],
+        "mean": out[2],
+        "m2": out[3],
+        "min": out[4],
+        "max": out[5],
+        "events": int(out[6]),
+    }
+
+
+def oracle_mmc(
+    seed: int,
+    rep: int,
+    n_objects: int,
+    arr_mean: float,
+    srv_mean: float,
+    c: int,
+) -> dict:
+    """Run the scalar C++ M/M/c oracle; returns the summary dict."""
+    lib = load()
+    assert lib is not None
+    out = (ctypes.c_double * 7)()
+    lib.cimba_oracle_mmc(seed, rep, n_objects, arr_mean, srv_mean, c, out)
     return {
         "clock": out[0],
         "n": out[1],

@@ -13,7 +13,7 @@ from typing import NamedTuple
 import numpy as np
 import jax.numpy as jnp
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.random.bits import RandomState, next_bits64
 
 
@@ -48,7 +48,7 @@ def alias_create(weights) -> AliasTable:
     for i in large + small:  # numerical leftovers are certain columns
         prob[i] = 1.0
         alias[i] = i
-    return AliasTable(jnp.asarray(prob, REAL_DTYPE), jnp.asarray(alias, jnp.int32))
+    return AliasTable(jnp.asarray(prob, config.REAL), jnp.asarray(alias, jnp.int32))
 
 
 def alias_sample(st: RandomState, table: AliasTable):
@@ -58,6 +58,13 @@ def alias_sample(st: RandomState, table: AliasTable):
     n = table.prob.shape[0]
     st, b0, b1 = next_bits64(st)
     col = (b0 % jnp.uint32(n)).astype(jnp.int32)
-    u = b1.astype(REAL_DTYPE) * REAL_DTYPE(2.0**-32)
+    if config.REAL.dtype.itemsize == 4:
+        # f32 profile: 24-bit coin (full-width u32->f32 rounds to 1.0 and
+        # hits Mosaic's recursing u32->f32 convert; see uniform01)
+        u = (b1 >> jnp.uint32(8)).astype(jnp.int32).astype(
+            config.REAL
+        ) * config.REAL(2.0**-24)
+    else:
+        u = b1.astype(config.REAL) * config.REAL(2.0**-32)
     take_alias = u >= table.prob[col]
-    return st, jnp.where(take_alias, table.alias[col], col).astype(jnp.int64)
+    return st, jnp.where(take_alias, table.alias[col], col).astype(config.COUNT)

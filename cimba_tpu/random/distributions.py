@@ -30,10 +30,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.random.bits import RandomState, next_bits64, to_u64
 
-_R = REAL_DTYPE
+_R = config.REAL
 _INV_2_53 = 1.0 / 9007199254740992.0  # 2**-53
 
 
@@ -52,7 +52,15 @@ def uniform01(st: RandomState):
     ``b0`` is reserved for samplers that need extra bits.
     """
     st, _, b1 = next_bits64(st)
-    u = b1.astype(_R) * _R(2.0**-32)
+    if _R.dtype.itemsize == 4:
+        # f32 profile (Pallas kernel path): a full-width u32->f32 convert
+        # rounds values near 2**32 up to exactly 1.0 (fatal for -log1p(-u));
+        # 24 bits is the widest exact significand, same one-draw contract.
+        # u32->i32 first: the value fits in 24 bits, and Mosaic's
+        # u32->f32 convert rule recurses forever (i32->f32 is native)
+        u = (b1 >> jnp.uint32(8)).astype(jnp.int32).astype(_R) * _R(2.0**-24)
+    else:
+        u = b1.astype(_R) * _R(2.0**-32)
     return st, u
 
 
@@ -68,6 +76,14 @@ def uniform01_53(st: RandomState):
     carry a tolerance contract, not a bit-identity one.
     """
     st, b0, b1 = next_bits64(st)
+    if _R.dtype.itemsize == 4:
+        # f32 profile: 24 bits IS full resolution; tail cap ~16.6 for the
+        # exponential / ~5.7 sigma for the normal (documented envelope).
+        # Consumes the same one counter tick as the f64 path so draw
+        # streams stay aligned across profiles.
+        return st, (b1 >> jnp.uint32(8)).astype(jnp.int32).astype(_R) * _R(
+            2.0**-24
+        )
     hi = b1.astype(_R) * _R(2.0**-32)
     lo = (b0 >> jnp.uint32(11)).astype(_R) * _R(_INV_2_53)
     return st, hi + lo
@@ -107,9 +123,12 @@ def std_normal(st):
     """Standard normal via inverse-CDF: sqrt(2) * erfinv(2u - 1) (1 draw,
     53-bit uniform so the practical tail support reaches ~8.2 sigma)."""
     st, u = uniform01_53(st)
-    # map u in [0,1) to (-1, 1); u==0 gives -1 -> erfinv(-1) = -inf, so nudge.
+    # map u in [0,1) to (-1, 1); u==0 gives -1 -> erfinv(-1) = -inf, so
+    # nudge by one representable step of the active profile's dtype (a
+    # fixed 1e-16 would round to exactly -1 in f32 and leak -inf samples)
+    tiny = float(jnp.finfo(_R.dtype).eps) / 2.0
     x = 2.0 * u - 1.0
-    x = jnp.clip(x, -1.0 + 1e-16, 1.0 - 1e-16)
+    x = jnp.clip(x, -1.0 + tiny, 1.0 - tiny)
     return st, jnp.sqrt(_R(2.0)) * lax.erf_inv(x)
 
 

@@ -37,10 +37,10 @@ from jax.experimental import pallas as pl
 
 import numpy as np
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.random import _ziggurat_tables as _zt
 
-_R = REAL_DTYPE
+_R = config.REAL
 
 # numpy scalar, not jnp: a module-level jnp array would be captured as a
 # constant by the pallas kernel closure, which pallas_call rejects

@@ -24,18 +24,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.random import _ziggurat_tables as _t
 from cimba_tpu.random.bits import RandomState, next_bits64
 from cimba_tpu.random.distributions import std_exponential as _inv_exp
 from cimba_tpu.random.distributions import uniform01, uniform01_53
 
-_R = REAL_DTYPE
+_R = config.REAL
 
-_X_EXP = jnp.asarray(_t.X_EXP, _R)
-_Y_EXP = jnp.asarray(_t.Y_EXP, _R)
-_X_NOR = jnp.asarray(_t.X_NOR, _R)
-_Y_NOR = jnp.asarray(_t.Y_NOR, _R)
+def _tables():
+    """Trace-time table construction: the profile's dtype must be read at
+    trace time, not import time, or use_profile('f32') would silently mix
+    f64 tables into the computation."""
+    return (
+        jnp.asarray(_t.X_EXP, _R),
+        jnp.asarray(_t.Y_EXP, _R),
+        jnp.asarray(_t.X_NOR, _R),
+        jnp.asarray(_t.Y_NOR, _R),
+    )
 
 
 def _zig_draw(st, xtab, ytab, r, v, f, tail_sample):
@@ -94,10 +100,11 @@ def std_exponential_zig(st: RandomState):
         st, e = _inv_exp(st)
         return st, _R(_t.R_EXP) + e
 
+    x_exp, y_exp, _, _ = _tables()
     return _zig_draw(
         st,
-        _X_EXP,
-        _Y_EXP,
+        x_exp,
+        y_exp,
         _t.R_EXP,
         _t.V_EXP,
         lambda x: jnp.exp(-x),
@@ -126,10 +133,11 @@ def std_normal_zig(st: RandomState):
         st, _, x = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
         return st, x
 
+    _, _, x_nor, y_nor = _tables()
     st, x = _zig_draw(
         st,
-        _X_NOR,
-        _Y_NOR,
+        x_nor,
+        y_nor,
         _t.R_NOR,
         _t.V_NOR,
         lambda x: jnp.exp(-0.5 * x * x),

@@ -20,10 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.stats import summary as _sm
 
-_R = REAL_DTYPE
+_R = config.REAL
 
 
 class Dataset(NamedTuple):

@@ -27,9 +27,9 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 
-_R = REAL_DTYPE
+_R = config.REAL
 
 
 class Summary(NamedTuple):

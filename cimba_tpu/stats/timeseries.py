@@ -22,10 +22,10 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from cimba_tpu.config import REAL_DTYPE
+from cimba_tpu import config
 from cimba_tpu.stats import summary as _sm
 
-_R = REAL_DTYPE
+_R = config.REAL
 
 
 class StepAccum(NamedTuple):

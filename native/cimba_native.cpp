@@ -214,6 +214,123 @@ MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
   return MM1Result{clock, sn, smean, sm2, smin, smax, events};
 }
 
+// ---------------------------------------------------------------------------
+// Scalar M/M/c oracle — c symmetric servers sharing one FIFO, with the
+// engine's exact guard protocol (parity role: src/cmb_resourceguard.c FIFO
+// wake order; engine rendition: core/guard.py + h_get/h_put in core/loop.py)
+// ---------------------------------------------------------------------------
+
+MM1Result run_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                  double arr_mean, double srv_mean, uint32_t c) {
+  Stream rng = Stream::init(seed, rep);
+  std::priority_queue<Ev, std::vector<Ev>, EvOrder> heap;
+  int32_t seq = 0;
+  // targets: 0 arrival start/hold-wake, 1 arrival put, 2 server fresh
+  // get (start or post-service), 3 service done, 4 woken guard retry
+  // (payload = kept guard seq; re-enqueue keeps FIFO position)
+  auto sched = [&](double t, int32_t target, double payload) {
+    heap.push(Ev{t, 0, seq++, target, payload});
+  };
+
+  double clock = 0.0;
+  uint64_t produced = 0, events = 0;
+  std::queue<double> fifo;
+  // waiting servers: min-heap of guard seqs (priorities all equal, so the
+  // engine's (prio DESC, seq ASC) best-waiter pick reduces to min seq)
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>>
+      guard;
+  int32_t gseq = 0;
+
+  double sn = 0, smean = 0, sm2 = 0, smin = HUGE_VAL, smax = -HUGE_VAL;
+  auto record = [&](double x) {
+    sn += 1.0;
+    const double d = x - smean;
+    smean += d / sn;
+    sm2 += d * (x - smean);
+    if (x < smin) smin = x;
+    if (x > smax) smax = x;
+  };
+
+  auto arrival_chain = [&]() {
+    const double t = rng.exponential(arr_mean);  // drawn even on exit pass
+    if (produced >= n_objects) return;
+    sched(clock + t, 1, 0.0);
+  };
+  auto signal_front = [&]() {
+    if (!guard.empty()) {
+      const int32_t woken = guard.top();
+      guard.pop();
+      sched(clock, 4, static_cast<double>(woken));
+    }
+  };
+  // successful get: take the item, cascade-signal the next waiter
+  // (engine h_get signals unconditionally — an empty-handed wake retries
+  // and re-enqueues with its kept seq), then the chain draws the service
+  // time; signal seq precedes the done-event seq, draw happens after.
+  auto service_take = [&]() {
+    const double item = fifo.front();
+    fifo.pop();
+    signal_front();
+    const double t = rng.exponential(srv_mean);
+    sched(clock + t, 3, item);
+  };
+  // fresh get: no-jump-ahead fairness — with waiters ahead, queue behind
+  // them even if items are available (engine h_get's `may` predicate)
+  auto service_fresh = [&]() {
+    if (fifo.empty() || !guard.empty()) {
+      guard.push(gseq++);
+    } else {
+      service_take();
+    }
+  };
+  auto service_retry = [&](int32_t kept_seq) {
+    if (fifo.empty()) {
+      guard.push(kept_seq);  // keeps its FIFO position
+    } else {
+      service_take();
+    }
+  };
+
+  sched(0.0, 0, 0.0);  // arrival start
+  for (uint32_t s = 0; s < c; ++s) sched(0.0, 2, 0.0);  // server starts
+
+  bool done = false;
+  while (!heap.empty() && !done) {
+    const Ev ev = heap.top();
+    heap.pop();
+    clock = ev.t;
+    ++events;
+    switch (ev.target) {
+      case 0:
+        arrival_chain();
+        break;
+      case 1:
+        ++produced;
+        fifo.push(clock);
+        // wake scheduled before the putter's chain continues (engine
+        // order: _guard_signal inside h_put, then the a_hold draw)
+        signal_front();
+        arrival_chain();
+        break;
+      case 2:
+        service_fresh();
+        break;
+      case 3:
+        record(clock - ev.payload);
+        if (static_cast<uint64_t>(sn) >= n_objects) {
+          done = true;
+        } else {
+          service_fresh();
+        }
+        break;
+      case 4:
+        service_retry(static_cast<int32_t>(ev.payload));
+        break;
+    }
+  }
+  return MM1Result{clock, sn, smean, sm2, smin, smax, events};
+}
+
 }  // namespace
 
 extern "C" {
@@ -256,6 +373,20 @@ uint64_t cimba_hwseed(void) {
 void cimba_oracle_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
                       double arr_mean, double srv_mean, double* out7) {
   const MM1Result r = run_mm1(seed, rep, n_objects, arr_mean, srv_mean);
+  out7[0] = r.clock;
+  out7[1] = r.n;
+  out7[2] = r.mean;
+  out7[3] = r.m2;
+  out7[4] = r.min;
+  out7[5] = r.max;
+  out7[6] = static_cast<double>(r.events);
+}
+
+// Scalar M/M/c oracle; same output layout as cimba_oracle_mm1.
+void cimba_oracle_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                      double arr_mean, double srv_mean, uint32_t c,
+                      double* out7) {
+  const MM1Result r = run_mmc(seed, rep, n_objects, arr_mean, srv_mean, c);
   out7[0] = r.clock;
   out7[1] = r.n;
   out7[2] = r.mean;
