@@ -1,25 +1,31 @@
-"""North-star benchmark: M/M/1 events/second (reference: benchmark/MM1_multi).
+"""Benchmark battery: one JSON line per BASELINE.json config.
 
+Headline (no args) = M/M/1 events/second (reference: benchmark/MM1_multi).
 Reference ground truth (BASELINE.md): 100 trials x 1e6 objects in 0.56 s on
 a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
+``--config {mm1,mmc,mg1,jobshop,awacs}`` runs one named config;
+``--config all`` runs the whole battery, one JSON line each (BASELINE.json
+configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
+reports a non-null vs_baseline; the others carry the published reference
+wall-clock (where any exists) in ``detail`` for context.
+
 Replications are vmapped lanes on one chip (and would shard over a mesh on
-a pod — see __graft_entry__.dryrun_multichip).  The workload per replication
-is smaller than the reference's 1e6 objects so total wall time stays
-CI-friendly, but the *rate* is the metric and is workload-size independent
-once the loop is warm.
+a pod — see __graft_entry__.dryrun_multichip).  Workloads are sized per
+backend: wide for accelerators (bounded by the ~3 min device-program
+watchdog, BENCH_NOTES.md), small for the CPU smoke path.  The *rate* is the
+metric and is workload-size independent once the loop is warm.
 
 Backend robustness: the accelerator backend is probed in a subprocess with
 a hard timeout *before* jax is imported here, because a wedged tunnel hangs
 backend init forever.  On probe failure the bench falls back to the CPU
 backend (structured, reported in the JSON detail) rather than dying with a
 traceback.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import argparse
 import json
 import os
 import subprocess
@@ -60,7 +66,7 @@ def _reexec_cpu(reason):
     env = _axon_env.cpu_env()
     env["CIMBA_BENCH_CPU_CHILD"] = "1"
     env["CIMBA_BENCH_FALLBACK_REASON"] = reason or ""
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -81,12 +87,67 @@ import jax  # noqa: E402  (after backend decision, by design)
 import jax.numpy as jnp  # noqa: E402
 
 from cimba_tpu.core import loop as cl  # noqa: E402
-from cimba_tpu.models import mm1  # noqa: E402
 
 
-def _default_scale():
-    """Backend-sized defaults: wide batches for accelerators, small ones
-    for a CPU smoke run (matters on 1-core CI boxes).
+def _accel():
+    return jax.default_backend() != "cpu"
+
+
+def _scale(r_default, n_default):
+    """Backend-sized defaults with the standard env overrides applied
+    (CIMBA_BENCH_R lanes, CIMBA_BENCH_OBJECTS per-lane workload) — every
+    config honors them, e.g. for dodging the device watchdog on slow
+    machines."""
+    return (
+        int(os.environ.get("CIMBA_BENCH_R", r_default)),
+        int(os.environ.get("CIMBA_BENCH_OBJECTS", n_default)),
+    )
+
+
+def _time_vmapped(spec, init_one, R, warm_args, real_args):
+    """jit(vmap(run ∘ init)), warm up on tiny traced workload args (same
+    shapes → one compile), then time the real workload.  Returns
+    (total_events, failed_lanes, wall_s)."""
+    run = cl.make_run(spec)
+
+    def experiment(args):
+        def one(rep):
+            return run(init_one(rep, args))
+
+        sims = jax.vmap(one)(jnp.arange(R))
+        return (
+            jnp.sum(sims.n_events),
+            jnp.sum((sims.err != 0).astype(jnp.int32)),
+        )
+
+    fn = jax.jit(experiment)
+    jax.block_until_ready(fn(warm_args))
+    t0 = time.perf_counter()
+    events, failed = jax.block_until_ready(fn(real_args))
+    wall = time.perf_counter() - t0
+    return int(events), int(failed), wall
+
+
+def _line(metric, rate, vs_baseline, detail):
+    detail["backend"] = jax.default_backend()
+    if _fallback_reason is not None:
+        detail["backend_fallback"] = _fallback_reason
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": rate,
+                "unit": "events/s",
+                "vs_baseline": vs_baseline,
+                "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_mm1():
+    """BASELINE configs[0]: M/M/1 single-server queue.
 
     TPU note (measured, v5e, round 2): the rate saturates at R~1024 and the
     device program's wall time grows linearly with R*N beyond that; a
@@ -94,64 +155,183 @@ def _default_scale():
     (UNAVAILABLE "kernel fault").  R=4096 x N=500 is ~25 s of device time —
     the same saturated rate with a wide safety margin.  See BENCH_NOTES.md
     for the full scaling curve."""
-    if jax.default_backend() != "cpu":
-        return 4096, 500
-    return 256, 500
+    from cimba_tpu.models import mm1
+
+    R, N = _scale(*((4096, 500) if _accel() else (256, 500)))
+    spec, _ = mm1.build(record=False)
+
+    def init_one(rep, n):
+        return cl.init_sim(spec, 2026, rep, mm1.params(n))
+
+    ev, failed, wall = _time_vmapped(
+        spec, init_one, R, jnp.int32(1), jnp.int32(N)
+    )
+    rate = ev / wall
+    _line(
+        "mm1_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "replications": R,
+            "objects_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+        },
+    )
+
+
+def bench_mmc():
+    """BASELINE configs[1]: M/M/c resource-pool queue (c=3, rho~0.83)."""
+    from cimba_tpu.models import mmc
+
+    c = 3
+    R, N = _scale(*((2048, 400) if _accel() else (128, 300)))
+    spec, _ = mmc.build(c)
+
+    def init_one(rep, n):
+        return cl.init_sim(spec, 2026, rep, mmc.params(n, 2.5, 1.0))
+
+    ev, failed, wall = _time_vmapped(
+        spec, init_one, R, jnp.int32(1), jnp.int32(N)
+    )
+    _line(
+        "mmc_events_per_sec",
+        ev / wall,
+        None,
+        {
+            "c": c,
+            "replications": R,
+            "objects_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+        },
+    )
+
+
+def bench_mg1():
+    """BASELINE configs[2]: the M/G/1 lognormal-service sweep — the
+    reference's 4 CVs x 5 utilizations x 10 reps experiment array
+    (README.md:283-294, ~1.5 s for 200 trials x 1e6 time units on the
+    64-core box)."""
+    from cimba_tpu.models import mg1
+
+    reps, N = _scale(*((20, 2000) if _accel() else (2, 300)))
+    spec, _ = mg1.build()
+    params, cells = mg1.sweep_params(N, reps_per_cell=reps)
+    warm, _ = mg1.sweep_params(1, reps_per_cell=reps)
+    R = len(cells)
+
+    def init_one(rep, args):
+        lane = tuple(a[rep] for a in args)
+        return cl.init_sim(spec, 2026, rep, lane)
+
+    ev, failed, wall = _time_vmapped(spec, init_one, R, warm, params)
+    _line(
+        "mg1_sweep_events_per_sec",
+        ev / wall,
+        None,
+        {
+            "cells": "4cv x 5rho",
+            "reps_per_cell": reps,
+            "replications": R,
+            "objects_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+            "reference_wall_s_200x1e6_units": 1.5,
+        },
+    )
+
+
+def bench_jobshop():
+    """BASELINE configs[3]: job-shop network — buffers + condition vars
+    (ref tut_4_2)."""
+    from cimba_tpu.models import jobshop
+
+    R, N = _scale(*((2048, 150) if _accel() else (128, 80)))
+    spec, _ = jobshop.build()
+
+    def init_one(rep, n):
+        return cl.init_sim(spec, 2026, rep, jobshop.params(n))
+
+    ev, failed, wall = _time_vmapped(
+        spec, init_one, R, jnp.int32(1), jnp.int32(N)
+    )
+    _line(
+        "jobshop_events_per_sec",
+        ev / wall,
+        None,
+        {
+            "replications": R,
+            "jobs_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+        },
+    )
+
+
+def bench_awacs():
+    """BASELINE configs[4]: AWACS — 1000 target processes + NN-scored radar
+    dwells (ref tutorial/tut_5_1.c at n=1000; reference runs 300 trials x
+    6 h simulated in 78 s on 3970X + 2x RTX 3090).  This is the flat event
+    set at reference scale: event_cap=2008, O(CAP) argmin per pop."""
+    from cimba_tpu.models import awacs
+
+    n_targets = int(os.environ.get("CIMBA_BENCH_AWACS_TARGETS", 1000))
+    R, t_end = (16, 40.0) if _accel() else (4, 10.0)
+    R = int(os.environ.get("CIMBA_BENCH_R", R))
+    spec, _ = awacs.build(n_targets)
+
+    def init_one(rep, t):
+        return cl.init_sim(spec, 2026, rep, (t,))
+
+    ev, failed, wall = _time_vmapped(
+        spec, init_one, R, jnp.asarray(0.5), jnp.asarray(t_end)
+    )
+    _line(
+        "awacs_events_per_sec",
+        ev / wall,
+        None,
+        {
+            "n_targets": n_targets,
+            "replications": R,
+            "t_end": t_end,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+            "reference_wall_s_300x6h": 78.0,
+        },
+    )
+
+
+CONFIGS = {
+    "mm1": bench_mm1,
+    "mmc": bench_mmc,
+    "mg1": bench_mg1,
+    "jobshop": bench_jobshop,
+    "awacs": bench_awacs,
+}
 
 
 def main():
-    R, N_OBJECTS = _default_scale()
-    R = int(os.environ.get("CIMBA_BENCH_R", R))
-    N_OBJECTS = int(os.environ.get("CIMBA_BENCH_OBJECTS", N_OBJECTS))
-
-    spec, _ = mm1.build(record=False)  # benchmark build, like -DNLOGINFO
-    run = cl.make_run(spec)
-
-    def experiment(n_objects):
-        def one(rep):
-            sim = cl.init_sim(
-                spec, 2026, rep, (1.0 / 0.9, 1.0, n_objects)
-            )
-            return run(sim)
-
-        sims = jax.vmap(one)(jnp.arange(R))
-        return (
-            jnp.sum(sims.n_events),
-            jnp.sum((sims.err != 0).astype(jnp.int32)),
-            sims.clock,
-        )
-
-    fn = jax.jit(experiment)
-    # warmup/compile with the same shapes (n_objects is traced data)
-    jax.block_until_ready(fn(jnp.int32(1)))
-
-    t0 = time.perf_counter()
-    events, failed, clocks = jax.block_until_ready(fn(jnp.int32(N_OBJECTS)))
-    wall = time.perf_counter() - t0
-
-    events = int(events)
-    rate = events / wall
-    detail = {
-        "replications": R,
-        "objects_per_replication": N_OBJECTS,
-        "total_events": events,
-        "wall_s": wall,
-        "failed_replications": int(failed),
-        "backend": jax.default_backend(),
-    }
-    if _fallback_reason is not None:
-        detail["backend_fallback"] = _fallback_reason
-    print(
-        json.dumps(
-            {
-                "metric": "mm1_events_per_sec",
-                "value": rate,
-                "unit": "events/s",
-                "vs_baseline": rate / BASELINE_EVENTS_PER_SEC,
-                "detail": detail,
-            }
-        )
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--config",
+        default="mm1",
+        choices=sorted(CONFIGS) + ["all"],
+        help="which BASELINE config to run (default: the mm1 headline)",
     )
+    which = ap.parse_args().config
+    names = sorted(CONFIGS) if which == "all" else [which]
+    # headline first so line 1 is always the driver's metric
+    if "mm1" in names:
+        names.remove("mm1")
+        names.insert(0, "mm1")
+    for name in names:
+        CONFIGS[name]()
 
 
 if __name__ == "__main__":
@@ -161,7 +341,7 @@ if __name__ == "__main__":
         print(
             json.dumps(
                 {
-                    "metric": "mm1_events_per_sec",
+                    "metric": "events_per_sec",
                     "value": None,
                     "unit": "events/s",
                     "vs_baseline": None,

@@ -64,6 +64,7 @@ def make_kernel_run(
     chunk_steps: int = 512,
     max_chunks: int = 10_000,
     interpret: bool = False,
+    single_step: bool = False,
 ):
     """Build ``run(sims) -> sims`` where ``sims`` is a lane-FIRST batched
     Sim (the shape ``jax.vmap(init_sim)`` produces) and every lane is
@@ -95,17 +96,33 @@ def make_kernel_run(
             sim, k = carry
             return (k < chunk_steps) & jnp.any(vcond_lane(sim))
 
+        def lane_sel(live, x, y):
+            """Mosaic-safe ``where(live, x, y)`` for lane-LAST leaves: the
+            [L] mask broadcasts across *major* dims, and the rank expansion
+            plus any bool-payload select are routed through i32 (Mosaic
+            supports neither i1 broadcasts into select_n nor i1 payloads —
+            dyn.bwhere covers the lane-first case, this the lane-last)."""
+            if x is y:
+                return x
+            m = jnp.broadcast_to(live.astype(jnp.int32), x.shape) != 0
+            if x.dtype == jnp.bool_:
+                return (m & x) | (~m & y)
+            return jnp.where(m, x, y)
+
         def wbody(carry):
             sim, k = carry
             live = vcond_lane(sim)
             sim2 = vstep(sim)
             sim = jax.tree.map(
-                lambda x, y: x if x is y else jnp.where(live, x, y),
-                sim2,
-                sim,
+                lambda x, y: lane_sel(live, x, y), sim2, sim
             )
             return sim, k + 1
 
+        if single_step:
+            # bisect aid (tools/mosaic_bisect.py): one masked step, no
+            # while loop — separates step-lowering bugs from loop-lowering
+            sim, _ = wbody((sim, jnp.zeros((), jnp.int32)))
+            return sim
         sim, _ = lax.while_loop(
             wcond, wbody, (sim, jnp.zeros((), jnp.int32))
         )
@@ -131,30 +148,13 @@ def make_kernel_run(
         for r, leaf in zip(out_refs, outs):
             r[...] = leaf
 
-    vcond = vcond_lane
-
-    def run(sims):
-        # Host-level driver, NOT for use under an outer jit.  The whole
-        # kernel path — tracing, Mosaic lowering AND compilation — must
-        # happen with x64 off: under x64, fori_loop counters, weak
-        # Python-int literals and iinfo bounds materialize as int64
-        # (Mosaic's 64->32 convert rule recurses forever), and Mosaic's
-        # own lower_fun helpers re-trace reduction identities as f64.
-        # Lowering runs at first call of the inner jit, so the first chunk
-        # invocation sits inside this scope too.  Init (u64 seed mixing)
-        # stays outside, under the session's x64 setting.
-        with jax.enable_x64(False):
-            return _run(sims)
-
-    def _run(sims):
-        sims = _to_lane_last(sims)
-        leaves, treedef = jax.tree.flatten(sims)
+    def build_chunk_call(leaves, treedef):
+        """Trace the batched chunk to a jaxpr, hoist its array constants
+        (Pallas kernels cannot capture them and jax.closure_convert hoists
+        only float consts), and wrap it in a pallas_call.  Returns
+        ``(chunk_fn, consts_in)`` where ``chunk_fn(*leaves)`` advances
+        every lane by one chunk.  Exposed for tools/mosaic_bisect.py."""
         n = len(leaves)
-        # Pallas kernels cannot capture array constants (the handler LUT,
-        # per-process entry/priority tables the interpreter closes over) —
-        # and jax.closure_convert hoists only float consts.  Hoist by hand:
-        # trace the chunk to a jaxpr, ship its array consts as SMEM inputs,
-        # and eval the jaxpr inside the kernel.
         config.KERNEL_MODE = True
         try:
             flat_chunk = jax.make_jaxpr(
@@ -164,36 +164,7 @@ def make_kernel_run(
             )(*leaves)
         finally:
             config.KERNEL_MODE = False
-        if __import__("os").environ.get("CIMBA_KERNEL_DEBUG"):
-            seen = set()
-
-            def _walk(jaxpr):
-                for eqn in jaxpr.eqns:
-                    for v in list(eqn.invars) + list(eqn.outvars):
-                        aval = getattr(v, "aval", None)
-                        if (
-                            aval is not None
-                            and hasattr(aval, "dtype")
-                            and aval.dtype.itemsize == 8
-                        ):
-                            src = jax._src.source_info_util.summarize(
-                                eqn.source_info
-                            )
-                            key = (str(eqn.primitive), str(aval.dtype), src)
-                            if key not in seen:
-                                seen.add(key)
-                                print("KERNEL64:", key)
-                    for val in eqn.params.values():
-                        vals = (
-                            val if isinstance(val, (list, tuple)) else [val]
-                        )
-                        for v2 in vals:
-                            j2 = getattr(v2, "jaxpr", None)
-                            if j2 is not None:
-                                _walk(j2 if hasattr(j2, "eqns") else j2.jaxpr)
-
-            _walk(flat_chunk.jaxpr)
-
+        _maybe_dump_64bit(flat_chunk)
         const_info = []  # ("in", shape) for shipped arrays, ("lit", value)
         consts_in = []
         import numpy as _np
@@ -214,17 +185,35 @@ def make_kernel_run(
             input_output_aliases={i: i for i in range(n)},
             interpret=interpret,
         )
+        return (lambda *ls: chunk_call(*ls, *consts_in)), consts_in
+
+    def run(sims):
+        # Host-level driver, NOT for use under an outer jit.  The whole
+        # kernel path — tracing, Mosaic lowering AND compilation — must
+        # happen with x64 off: under x64, fori_loop counters, weak
+        # Python-int literals and iinfo bounds materialize as int64
+        # (Mosaic's 64->32 convert rule recurses forever), and Mosaic's
+        # own lower_fun helpers re-trace reduction identities as f64.
+        # Lowering runs at first call of the inner jit, so the first chunk
+        # invocation sits inside this scope too.  Init (u64 seed mixing)
+        # stays outside, under the session's x64 setting.
+        with jax.enable_x64(False):
+            return _run(sims)
+
+    def _run(sims):
+        sims = _to_lane_last(sims)
+        leaves, treedef = jax.tree.flatten(sims)
+
+        chunk_fn, _ = build_chunk_call(leaves, treedef)
 
         # Chunks are dispatched from the host: each call is bounded device
         # time (well under the runtime watchdog), the any-lane-live check
         # costs one tiny jitted reduction between chunks, and — decisive —
         # compilation of the chunk happens on its first call, still inside
         # the x64-off scope above.
-        chunk_jit = jax.jit(
-            lambda *ls: chunk_call(*ls, *consts_in)
-        )
+        chunk_jit = jax.jit(chunk_fn)
         alive_jit = jax.jit(
-            lambda *ls: jnp.any(vcond(jax.tree.unflatten(treedef, ls)))
+            lambda *ls: jnp.any(vcond_lane(jax.tree.unflatten(treedef, ls)))
         )
         it = 0
         while bool(alive_jit(*leaves)) and it < max_chunks:
@@ -239,4 +228,39 @@ def make_kernel_run(
         sims = jax.tree.unflatten(treedef, leaves)
         return _to_lane_first(sims)
 
+    run.build_chunk_call = build_chunk_call
     return run
+
+
+def _maybe_dump_64bit(closed_jaxpr):
+    """CIMBA_KERNEL_DEBUG=1: print every 64-bit-typed value in the chunk
+    jaxpr with its source line (Mosaic has no 64-bit types; anything listed
+    here will fail to lower)."""
+    import os as _os
+
+    if not _os.environ.get("CIMBA_KERNEL_DEBUG"):
+        return
+    seen = set()
+
+    def _walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(v, "aval", None)
+                if (
+                    aval is not None
+                    and hasattr(aval, "dtype")
+                    and aval.dtype.itemsize == 8
+                ):
+                    src = jax._src.source_info_util.summarize(eqn.source_info)
+                    key = (str(eqn.primitive), str(aval.dtype), src)
+                    if key not in seen:
+                        seen.add(key)
+                        print("KERNEL64:", key)
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else [val]
+                for v2 in vals:
+                    j2 = getattr(v2, "jaxpr", None)
+                    if j2 is not None:
+                        _walk(j2 if hasattr(j2, "eqns") else j2.jaxpr)
+
+    _walk(closed_jaxpr.jaxpr)

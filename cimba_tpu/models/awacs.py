@@ -23,7 +23,13 @@ movement costs nothing between events, exactly like the reference storing
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 import cimba_tpu.random as cr
 from cimba_tpu import config
@@ -41,8 +47,120 @@ LEG_MEAN = 4.0         # mean straight-leg duration
 DETECT_RANGE = 40.0    # sensor detection radius
 DWELL = 0.04 * 25      # dwell interval (scaled tut_5 pattern)
 
+# --- NN detection scorer (BASELINE configs[4]: "on-device NN scoring",
+# the reference's CUDA physics hook `tutorial/tut_5_3.cu` re-imagined as a
+# Pallas matmul stack).  Weights are fixed at import (a deterministic
+# stand-in for a trained radar-SNR model): two hidden layers + a strong
+# skip connection on the range-gaussian feature so near targets dominate
+# detections, as in the threshold model.
 
-def build(n_targets: int):
+_NN_F = 8    # features per target
+_NN_H = 32   # hidden width
+
+
+def _make_nn_weights():
+    rng = np.random.default_rng(20260729)
+
+    def glorot(shape):
+        lim = np.sqrt(6.0 / (shape[0] + shape[1]))
+        return rng.uniform(-lim, lim, shape).astype(np.float32)
+
+    w1 = glorot((_NN_F, _NN_H))
+    b1 = np.zeros(_NN_H, np.float32)
+    w2 = glorot((_NN_H, _NN_H))
+    b2 = np.zeros(_NN_H, np.float32)
+    # final layer sees [h2, range_gaussian]; the fixed skip weight keeps
+    # the scorer physically sensible without training
+    w3 = np.concatenate(
+        [0.3 * glorot((_NN_H, 1)), np.full((1, 1), 8.0, np.float32)]
+    )
+    b3 = np.full(1, -2.0, np.float32)
+    return tuple(jnp.asarray(a) for a in (w1, b1, w2, b2, w3, b3))
+
+
+_NN_WEIGHTS = _make_nn_weights()
+
+
+def _nn_features(pos, vel):
+    """[N,2],[N,2] -> ([N,F] f32 features, [N] f32 range gaussian)."""
+    pos = pos.astype(jnp.float32)
+    vel = vel.astype(jnp.float32)
+    r2 = jnp.sum(pos * pos, axis=1)
+    g = jnp.exp(-r2 / jnp.float32(DETECT_RANGE**2))
+    radial = jnp.sum(pos * vel, axis=1) / jnp.float32(SPEED * DETECT_RANGE)
+    feats = jnp.stack(
+        [
+            pos[:, 0] / ARENA,
+            pos[:, 1] / ARENA,
+            r2 / jnp.float32(ARENA**2),
+            g,
+            vel[:, 0] / SPEED,
+            vel[:, 1] / SPEED,
+            radial,
+            jnp.ones_like(g),
+        ],
+        axis=1,
+    )
+    return feats, g
+
+
+def _nn_forward(feats, g, w1, b1, w2, b2, w3, b3):
+    """The matmul stack: [N,F] -> detection probability [N] (f32)."""
+    h1 = jax.nn.relu(
+        jnp.dot(feats, w1, preferred_element_type=jnp.float32) + b1
+    )
+    h2 = jax.nn.relu(
+        jnp.dot(h1, w2, preferred_element_type=jnp.float32) + b2
+    )
+    h2g = jnp.concatenate([h2, g[:, None]], axis=1)
+    logit = jnp.dot(h2g, w3, preferred_element_type=jnp.float32) + b3
+    return jax.nn.sigmoid(logit[:, 0])
+
+
+def _nn_kernel(f_ref, g_ref, w1, b1, w2, b2, w3, b3, out_ref):
+    out_ref[...] = _nn_forward(
+        f_ref[...], g_ref[...][0],
+        w1[...], b1[...][0], w2[...], b2[...][0], w3[...], b3[...][0],
+    )[None]
+
+
+def nn_scores(pos, vel, *, use_pallas=None, interpret=False):
+    """Detection probabilities [N] for all targets — the physics hook.
+
+    ``use_pallas=True`` executes the stack as one Pallas kernel (all
+    operands in VMEM, matmuls on the MXU); ``False`` is the identical
+    plain-jnp trace (the oracle for the equivalence test).  ``None``
+    auto-selects Pallas on TPU.  The kernel is always pure f32 — detection
+    scores need no f64 regardless of the active profile.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and not config.KERNEL_MODE
+    feats, g = _nn_features(pos, vel)
+    w1, b1, w2, b2, w3, b3 = _NN_WEIGHTS
+    if not use_pallas:
+        return _nn_forward(feats, g, w1, b1, w2, b2, w3, b3)
+    n = feats.shape[0]
+    npad = max(128, -(-n // 128) * 128)  # lane-width multiple; pad rows
+    feats = jnp.pad(feats, ((0, npad - n), (0, 0)))
+    g = jnp.pad(g, (0, npad - n))
+    # rank-2 at the kernel boundary (1D vectors ride as [1, k])
+    out = pl.pallas_call(
+        _nn_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, npad), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(feats, g[None], w1, b1[None], w2, b2[None], w3, b3[None])
+    return out[0, :n]
+
+
+def build(n_targets: int, scoring: str = "nn"):
+    """``scoring="nn"`` (default) runs the Pallas/MLP detection scorer —
+    the reference's GPU physics hook (`tut_5_3.cu`) as a TPU matmul stack;
+    ``"threshold"`` keeps the closed-form linear-falloff score (the
+    tut_5_1 CPU model and the legacy behavior)."""
+    if scoring not in ("nn", "threshold"):
+        raise ValueError(f"scoring must be 'nn' or 'threshold': {scoring}")
     m = Model(
         "awacs",
         event_cap=2 * n_targets + 8,
@@ -102,11 +220,14 @@ def build(n_targets: int):
         """One radar dwell: vectorized detection over ALL targets — the
         physics hook (CUDA kernel in the reference, jax/Pallas here)."""
         pos = _current_positions(sim)
-        r2 = jnp.sum(pos * pos, axis=1)
-        # detection: inside range with a smooth SNR-ish falloff, plus one
-        # uniform draw for the whole dwell (scan noise)
+        # detection scores for every target, plus one uniform draw for the
+        # whole dwell (scan noise)
         sim, noise = api.draw(sim, cr.uniform01)
-        p_det = jnp.clip(1.2 - jnp.sqrt(r2) / DETECT_RANGE, 0.0, 1.0)
+        if scoring == "nn":
+            p_det = nn_scores(pos, sim.user["vel"]).astype(_R)
+        else:
+            r2 = jnp.sum(pos * pos, axis=1)
+            p_det = jnp.clip(1.2 - jnp.sqrt(r2) / DETECT_RANGE, 0.0, 1.0)
         detected = jnp.sum((p_det > noise).astype(_R))
         u = sim.user
         sim = api.set_user(

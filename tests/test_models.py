@@ -65,3 +65,56 @@ def test_awacs_positions_stay_in_arena_neighborhood():
     pos = np.asarray(sim.user["pos"])
     # soft-bounce keeps targets within arena + one leg's travel
     assert np.linalg.norm(pos, axis=1).max() < awacs.ARENA + awacs.SPEED * 30
+
+def test_awacs_nn_scores_pallas_matches_jnp():
+    """The NN physics hook: the Pallas kernel (interpret mode here — the
+    Mosaic-compiled path runs on real TPU via bench.py --config awacs) and
+    the plain-jnp trace are the same matmul stack; results must agree to
+    f32 roundoff."""
+    rng = np.random.default_rng(7)
+    n = 137  # deliberately not a lane multiple: exercises row padding
+    pos = jnp.asarray(rng.uniform(-80, 80, (n, 2)))
+    vel = jnp.asarray(rng.normal(0, awacs.SPEED, (n, 2)))
+    ref = np.asarray(awacs.nn_scores(pos, vel, use_pallas=False))
+    ker = np.asarray(
+        awacs.nn_scores(pos, vel, use_pallas=True, interpret=True)
+    )
+    assert ref.shape == ker.shape == (n,)
+    np.testing.assert_allclose(ker, ref, rtol=1e-6, atol=1e-6)
+    # physically sensible without training: a target at the center must
+    # outscore one far outside detection range
+    center = float(awacs.nn_scores(jnp.zeros((1, 2)), jnp.zeros((1, 2)),
+                                   use_pallas=False)[0])
+    far = float(awacs.nn_scores(jnp.full((1, 2), 90.0), jnp.zeros((1, 2)),
+                                use_pallas=False)[0])
+    assert center > 0.9 and far < 0.3 and center > 2 * far
+
+
+def test_awacs_nn_and_threshold_scoring_both_run():
+    """Same model, both physics hooks; NN is the default (BASELINE
+    configs[4])."""
+    means = {}
+    for scoring in ("nn", "threshold"):
+        spec, _ = awacs.build(24, scoring=scoring)
+        run = cl.make_run(spec)
+        sim = jax.jit(run)(cl.init_sim(spec, 11, 0, awacs.params(15.0)))
+        assert int(sim.err) == 0
+        means[scoring] = float(sm.mean(sim.user["detections"]))
+    # both detect a sensible fraction of the 24 targets per dwell
+    assert 1.0 < means["nn"] <= 24.0
+    assert 1.0 < means["threshold"] <= 24.0
+
+
+def test_awacs_reference_scale_1000_targets():
+    """The reference scenario runs 1000 target coroutines
+    (`tutorial/tut_5_1.c`); this exercises the flat event set at that
+    scale — event_cap=2008, O(CAP) argmin per pop — which is exactly the
+    regime the slot-table design is worst at."""
+    spec, _ = awacs.build(1000)
+    run = cl.make_run(spec)
+    sim = jax.jit(run)(cl.init_sim(spec, 3, 0, awacs.params(2.0)))
+    assert int(sim.err) == 0
+    assert int(sim.n_events) > 1000  # every target launched + legs + dwells
+    assert int(sim.user["dwells"]) >= 2
+    # most of 1000 center-started targets are detected each dwell
+    assert float(sm.mean(sim.user["detections"])) > 500.0

@@ -80,3 +80,22 @@ def test_kernel_requires_f32_profile():
     spec, _ = mm1.build(record=False)
     with pytest.raises(ValueError, match="f32"):
         pr.make_kernel_run(spec)
+
+
+def test_kernel_matches_xla_f32_mmc(f32_profile):
+    """Kernel path on a model with pool + bool pqueue-style state (mmc):
+    exercises lane_sel's bool-leaf handling (i1 selects are rewritten as
+    logic ops — Mosaic cannot lower select_n on i1 payloads)."""
+    from cimba_tpu.models import mmc
+
+    spec, _ = mmc.build(3)
+
+    def one(rep):
+        return cl.init_sim(spec, 7, rep, mmc.params(120, 2.5, 1.0))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(32))
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    ker = pr.make_kernel_run(spec, chunk_steps=64, interpret=True)(sims)
+    assert bool((xla.n_events == ker.n_events).all())
+    assert bool((xla.clock == ker.clock).all())
+    assert int(ker.err.sum()) == 0

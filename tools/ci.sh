@@ -65,6 +65,33 @@ print(f"cpu smoke rate {rate:.0f} ev/s (floor {floor:.0f})")
 sys.exit(0 if rate >= floor else 1)
 EOF
 
+# sampler smoke: bulk draws must clear a floor (the reference ships speed
+# comparisons in its random test battery, `test/test_random.c:193-245`;
+# this is the regression tripwire, not a benchmark)
+run_cell "sampler smoke" python - <<'EOF'
+import os, time, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax, jax.numpy as jnp
+from cimba_tpu.random import bits, pallas_kernels as pk
+
+R, N = 8, 25_000  # 8 streams x 25k draws per block
+states = jax.vmap(bits.initialize, in_axes=(None, 0))(2026, jnp.arange(R))
+for name, fn in [
+    ("exponential_block", lambda s: pk.exponential_block(s, N, interpret=True)),
+    ("normal_block", lambda s: pk.normal_block(s, N, interpret=True)),
+]:
+    f = jax.jit(fn)
+    jax.block_until_ready(f(states))
+    t0 = time.perf_counter()
+    jax.block_until_ready(f(states))
+    dt = time.perf_counter() - t0
+    rate = R * N / dt
+    floor = float(os.environ.get("CIMBA_SAMPLER_FLOOR", "2e6"))
+    print(f"{name}: {rate:.2e} samples/s (floor {floor:.0e})")
+    if rate < floor:
+        sys.exit(1)
+EOF
+
 run_cell "multichip dryrun" python __graft_entry__.py 8
 
 exit $fail

@@ -1,0 +1,170 @@
+"""Bisect the Mosaic layout crash in the mega-kernel (core/pallas_run.py).
+
+Round-2 finding: compiling the full chunk kernel on TPU aborts inside the
+Mosaic compiler (`layout.h:320 Check failed: arr.size() >=
+layout_rank(implicit_dim) (1 vs 2)`) — some op in the interpreter jaxpr
+gets a rank-1 value with an implicit-dim-none layout.  This driver runs
+each stage in a SUBPROCESS (a Mosaic check failure is a SIGABRT, not an
+exception) and reports which smallest slice reproduces it.
+
+Stages build pallas_call kernels around increasing slices of the engine:
+  0 copy        — plumbing only (leaves in/out through VMEM)
+  1 pop         — eventset argmin pop
+  2 step1       — one full dispatcher step, no while loop
+  3 chunk1      — the hand-batched while loop, chunk_steps=1
+  4 chunk       — the real chunk (chunk_steps=16)
+  5 full        — make_kernel_run end-to-end (small shapes)
+
+Stage 10+n = OFFLINE variant of stage n: AOT-compile against a
+`topologies.get_topology_desc("v5e:2x2")` compile-only client on the CPU
+host — no TPU tunnel needed.  Measured round 2: the whole Mosaic pass
+pipeline (including the crashing layout pass) runs in-process this way, so
+the crash reproduces and bisects offline.
+
+Usage: python tools/mosaic_bisect.py [stage]   (no arg = drive all stages)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _stage(n):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from cimba_tpu import config
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.core import eventset as es
+    from cimba_tpu.core import pallas_run as pr
+    from cimba_tpu.models import mm1
+
+    L = 128
+
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+
+        def one(rep):
+            return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, 20))
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(L))
+
+        if n == 0:
+            lanes = pr._to_lane_last(sims)
+            leaves, treedef = jax.tree.flatten(lanes)
+
+            def kernel(*refs):
+                k = len(refs) // 2
+                for o, i in zip(refs[k:], refs[:k]):
+                    o[...] = i[...]
+
+            out = pl.pallas_call(
+                kernel,
+                out_shape=[jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves],
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(leaves),
+                out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(leaves),
+            )(*leaves)
+            jax.block_until_ready(out)
+            return
+
+        if n == 1:
+            # the eventset pop alone, vmapped lane-last like the chunk
+            def pop_lane(sim):
+                t = sim.events.time  # +inf marks a free slot already
+                slot = config.argmin32(t)
+                return slot, t[slot]
+
+            vpop = jax.vmap(pop_lane, in_axes=-1, out_axes=-1)
+            lanes = pr._to_lane_last(sims)
+            leaves, treedef = jax.tree.flatten(lanes)
+
+            def kernel(*refs):
+                ins = refs[:-2]
+                sim = jax.tree.unflatten(treedef, [r[...] for r in ins])
+                s, t = vpop(sim)
+                refs[-2][...] = s
+                refs[-1][...] = t
+
+            out = pl.pallas_call(
+                kernel,
+                out_shape=[
+                    jax.ShapeDtypeStruct((L,), jnp.int32),
+                    jax.ShapeDtypeStruct((L,), jnp.float32),
+                ],
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(leaves),
+                out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+            )(*leaves)
+            jax.block_until_ready(out)
+            return
+
+        # stages >= 2 reuse make_kernel_run plumbing with modified bodies
+        lower_only = n >= 10
+        base = n % 10
+        if base == 2:
+            krun = pr.make_kernel_run(spec, chunk_steps=0, max_chunks=1,
+                                      single_step=True)
+        elif base == 3:
+            krun = pr.make_kernel_run(spec, chunk_steps=1, max_chunks=1)
+        elif base == 4:
+            krun = pr.make_kernel_run(spec, chunk_steps=16, max_chunks=1)
+        else:
+            krun = pr.make_kernel_run(spec, chunk_steps=64)
+        if lower_only:
+            from jax.experimental import topologies
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+            sh = NamedSharding(Mesh([topo.devices[0]], "x"), P())
+            with jax.enable_x64(False):
+                lanes = pr._to_lane_last(sims)
+                leaves, treedef = jax.tree.flatten(lanes)
+                chunk_fn, _ = krun.build_chunk_call(leaves, treedef)
+                avals = [
+                    jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh)
+                    for l in leaves
+                ]
+                compiled = jax.jit(chunk_fn).lower(*avals).compile()
+                print("COMPILED", compiled.memory_analysis())
+            return
+        out = krun(sims)
+        jax.block_until_ready(jax.tree.leaves(out))
+
+
+def main():
+    if len(sys.argv) > 1:
+        _stage(int(sys.argv[1]))
+        print("STAGE_OK")
+        return
+    results = {}
+    for n in range(6):
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), str(n)],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            cwd=REPO,
+        )
+        ok = proc.returncode == 0 and "STAGE_OK" in proc.stdout
+        tail = ""
+        if not ok:
+            lines = (proc.stderr or "").strip().splitlines()
+            keep = [l for l in lines if "Check failed" in l or "Error" in l]
+            tail = (keep or lines)[-1] if (keep or lines) else ""
+        results[n] = ok
+        print(json.dumps({"stage": n, "ok": ok, "s": round(time.time() - t0, 1),
+                          "tail": tail[:300]}), flush=True)
+        if not ok and n >= 4:
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,295 @@
+"""Pinpoint the Mosaic layout crash to a single jaxpr equation.
+
+The mega-kernel chunk jaxpr is ~18k equations; the Mosaic check-failure
+(`layout.h:320`) names no op.  This tool binary-searches the smallest
+equation prefix whose compilation crashes, then recurses into nested
+jaxprs (cond branches, while bodies) when the culprit equation carries
+them.  Every probe compiles OFFLINE against the v5e compile-only topology
+client (no TPU tunnel), in a subprocess (the failure mode is SIGABRT).
+
+Usage:
+  python tools/mosaic_eqn_bisect.py            # drive the search
+  python tools/mosaic_eqn_bisect.py probe SPEC # one probe (internal)
+
+SPEC is JSON: {"path": [[eqn_idx, param, branch_idx], ...], "k": int}
+— descend into nested jaxprs along path, compile prefix eqns[:k] there.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _trace_chunk():
+    import jax
+    import jax.numpy as jnp
+
+    from cimba_tpu import config
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.core import pallas_run as pr
+    from cimba_tpu.models import mm1
+
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+
+        def one(rep):
+            return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, 20))
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(128))
+        step = cl.make_step(spec)
+        cond = cl.make_cond(spec, None)
+        vstep = jax.vmap(step, in_axes=-1, out_axes=-1)
+        vcond = jax.vmap(cond, in_axes=-1)
+        lanes = pr._to_lane_last(sims)
+        leaves, treedef = jax.tree.flatten(lanes)
+
+        def lane_sel(live, x, y):
+            # mirror pallas_run.lane_sel (Mosaic-safe lane-last select)
+            if x is y:
+                return x
+            m = jnp.broadcast_to(live.astype(jnp.int32), x.shape) != 0
+            if x.dtype == jnp.bool_:
+                return (m & x) | (~m & y)
+            return jnp.where(m, x, y)
+
+        def single(*ls):
+            sim = jax.tree.unflatten(treedef, ls)
+            live = vcond(sim)
+            sim2 = vstep(sim)
+            out = jax.tree.map(
+                lambda x, y: lane_sel(live, x, y), sim2, sim
+            )
+            return jax.tree.leaves(out)
+
+        config.KERNEL_MODE = True
+        try:
+            # x64 OFF exactly like pallas_run.run(): the real kernel jaxpr
+            # has no 64-bit values; tracing with x64 on here would bisect a
+            # different (and differently-crashing) program
+            with jax.enable_x64(False):
+                closed = jax.make_jaxpr(single)(*leaves)
+        finally:
+            config.KERNEL_MODE = False
+        return closed
+
+
+def _descend(jaxpr, path):
+    """Follow path steps [(eqn_idx, param, idx)] into nested jaxprs."""
+    for eqn_idx, param, idx in path:
+        val = jaxpr.eqns[eqn_idx].params[param]
+        if isinstance(val, (list, tuple)):
+            val = val[idx]
+        jaxpr = val.jaxpr if hasattr(val, "jaxpr") else val
+        if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+            jaxpr = jaxpr.jaxpr
+    return jaxpr
+
+
+def probe(spec_json):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as np
+    from jax._src import core as jcore
+
+    spec = json.loads(spec_json)
+    path, k = spec["path"], spec["k"]
+    closed = _trace_chunk()
+    target = _descend(closed.jaxpr, path)
+    eqns = target.eqns[:k]
+    # output vars: every real jaxpr output already defined by the prefix
+    # (defeats DCE of the final select/merge chains) plus the last eqn's
+    # outputs (keeps the newly added equation itself live)
+    defined = set()
+    for v in list(target.invars) + list(target.constvars):
+        defined.add(id(v))
+    for eqn in eqns:
+        for v in eqn.outvars:
+            defined.add(id(v))
+    outvars = [
+        v
+        for v in target.outvars
+        if type(v).__name__ == "Var" and id(v) in defined
+    ]
+    seen_ids = {id(v) for v in outvars}
+    for eqn in reversed(eqns):
+        extra = [
+            v
+            for v in eqn.outvars
+            if type(v).__name__ != "DropVar" and id(v) not in seen_ids
+        ]
+        if extra:
+            outvars = outvars + extra
+            break
+    if not outvars:
+        print("PROBE_OK (no outvars)")
+        return
+    sub = jcore.Jaxpr(
+        constvars=target.constvars,
+        invars=target.invars,
+        outvars=outvars,
+        eqns=eqns,
+        effects=target.effects,
+    )
+    # consts: only the top-level closed jaxpr carries them; nested jaxprs
+    # have empty constvars.  Ship arrays via SMEM like the real kernel.
+    consts = closed.consts if not path else []
+    const_info, consts_in = [], []
+    for c in consts:
+        if isinstance(c, (jax.Array, np.ndarray)):
+            const_info.append(("in", (jnp.shape(c), jnp.size(c))))
+            consts_in.append(jnp.reshape(jnp.asarray(c), (-1,)))
+        else:
+            const_info.append(("lit", c))
+
+    in_avals = [v.aval for v in sub.invars]
+    out_avals = [v.aval for v in sub.outvars]
+
+    def vmem_shape(aval):
+        return aval.shape if aval.shape else (1,)
+
+    def kernel(*refs):
+        n_in = len(in_avals)
+        nc = sum(1 for kind, _ in const_info if kind == "in")
+        in_refs = refs[:n_in]
+        const_refs = list(refs[n_in : n_in + nc])
+        out_refs = refs[n_in + nc :]
+        cvals = []
+        for kind, payload in const_info:
+            if kind == "in":
+                shape, size = payload
+                ref = const_refs.pop(0)
+                vals = [ref[i] for i in range(size)]
+                c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
+                cvals.append(c)
+            else:
+                cvals.append(payload)
+        args = [
+            r[...] if a.shape else r[0]
+            for r, a in zip(in_refs, in_avals)
+        ]
+        outs = jcore.eval_jaxpr(sub, cvals, *args)
+        for r, x, a in zip(out_refs, outs, out_avals):
+            r[...] = x if a.shape else jnp.reshape(x, (1,))
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    sh = NamedSharding(Mesh([topo.devices[0]], "x"), P())
+
+    def in_spec(aval):
+        return pl.BlockSpec(memory_space=pltpu.SMEM if not aval.shape
+                            else pltpu.VMEM)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(vmem_shape(a), a.dtype) for a in out_avals
+        ],
+        in_specs=[in_spec(a) for a in in_avals]
+        + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(consts_in),
+        out_specs=[in_spec(a) for a in out_avals],
+    )
+    avals = [
+        jax.ShapeDtypeStruct(vmem_shape(a), a.dtype, sharding=sh)
+        for a in in_avals
+    ] + [
+        jax.ShapeDtypeStruct(c.shape, c.dtype, sharding=sh) for c in consts_in
+    ]
+
+    def wrapper(*xs):
+        n_in = len(in_avals)
+        real = [
+            x if a.shape else x[0] for x, a in zip(xs[:n_in], in_avals)
+        ]
+        # re-box scalars to (1,) for the call
+        boxed = [
+            x if a.shape else jnp.reshape(x, (1,))
+            for x, a in zip(real, in_avals)
+        ]
+        return call(*boxed, *xs[n_in:])
+
+    with jax.enable_x64(False):
+        jax.jit(wrapper).lower(*avals).compile()
+    print("PROBE_OK")
+
+
+def run_probe(path, k):
+    spec = json.dumps({"path": path, "k": k})
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "probe", spec],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    ok = "PROBE_OK" in p.stdout
+    crash = "Check failed" in (p.stderr or "")
+    return ok, crash, (p.stderr or "").strip().splitlines()[-3:]
+
+
+def describe(closed, path, idx):
+    import jax
+
+    jaxpr = _descend(closed.jaxpr, path)
+    eqn = jaxpr.eqns[idx]
+    src = jax._src.source_info_util.summarize(eqn.source_info)
+    return eqn, src
+
+
+def drive():
+    closed = _trace_chunk()
+    path = []
+    while True:
+        jaxpr = _descend(closed.jaxpr, path)
+        n = len(jaxpr.eqns)
+        print(f"path={path} eqns={n}", flush=True)
+        # confirm the full jaxpr at this level crashes
+        ok, crash, tail = run_probe(path, n)
+        if ok:
+            print("  full prefix OK here — culprit not reachable this way",
+                  tail)
+            return
+        lo, hi = 0, n  # smallest k in (lo, hi] that crashes is hi after loop
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            ok, crash, _ = run_probe(path, mid)
+            print(f"  k={mid}: {'ok' if ok else 'CRASH'}", flush=True)
+            if ok:
+                lo = mid
+            else:
+                hi = mid
+        eqn, src = describe(closed, path, hi - 1)
+        print(f"CULPRIT idx={hi-1} primitive={eqn.primitive} src={src}")
+        print(f"  invars: {[str(v.aval) for v in eqn.invars]}")
+        print(f"  outvars: {[str(v.aval) for v in eqn.outvars]}")
+        print(f"  params: {list(eqn.params.keys())}")
+        # recurse into nested jaxprs if any
+        nested = None
+        for key, val in eqn.params.items():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for i, v in enumerate(vals):
+                if hasattr(v, "jaxpr") or hasattr(v, "eqns"):
+                    nested = (hi - 1, key, i)
+                    break
+            if nested:
+                break
+        if nested is None:
+            print("LEAF CULPRIT — done")
+            return
+        print(f"  descending into {nested}")
+        path = path + [list(nested)]
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "probe":
+        probe(sys.argv[2])
+    else:
+        drive()

@@ -1,0 +1,84 @@
+"""Probe: Mosaic-compile the Pallas mega-kernel event loop on the real
+chip and time it against the plain-XLA while-loop path.
+
+Usage: python tools/tpu_kernel_probe.py [R] [N_OBJECTS] [CHUNK]
+Prints one JSON line per phase so a wedged run still leaves evidence.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import pallas_run as pr
+from cimba_tpu.models import mm1
+from cimba_tpu.stats import summary as sm
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    N = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    CHUNK = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    log(phase="start", backend=jax.default_backend(), R=R, N=N, chunk=CHUNK)
+
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+
+        def one(rep):
+            return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, N))
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(R))
+        jax.block_until_ready(jax.tree.leaves(sims))
+        log(phase="init_done")
+
+        # XLA while-loop path (reference timing)
+        xrun = jax.jit(jax.vmap(cl.make_run(spec)))
+        t0 = time.perf_counter()
+        xout = xrun(sims)
+        jax.block_until_ready(jax.tree.leaves(xout))
+        xla_compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        xout = xrun(sims)
+        jax.block_until_ready(jax.tree.leaves(xout))
+        xla_s = time.perf_counter() - t0
+        xev = int(xout.n_events.sum())
+        log(phase="xla_done", wall_s=xla_s, compile_s=xla_compile_s,
+            events=xev, rate=xev / xla_s)
+
+        # Pallas mega-kernel path (Mosaic-compiled)
+        krun = pr.make_kernel_run(spec, chunk_steps=CHUNK)
+        t0 = time.perf_counter()
+        kout = krun(sims)
+        jax.block_until_ready(jax.tree.leaves(kout))
+        k_first_s = time.perf_counter() - t0
+        log(phase="kernel_compiled", first_call_s=k_first_s)
+        t0 = time.perf_counter()
+        kout = krun(sims)
+        jax.block_until_ready(jax.tree.leaves(kout))
+        k_s = time.perf_counter() - t0
+        kev = int(kout.n_events.sum())
+        log(phase="kernel_done", wall_s=k_s, events=kev, rate=kev / k_s,
+            speedup_vs_xla=xla_s / k_s)
+
+        # correctness cross-check on-device
+        ok_ev = bool((xout.n_events == kout.n_events).all())
+        ok_err = int(kout.err.sum()) == 0
+        mx = float(sm.mean(sm.merge_tree(xout.user["wait"])))
+        mk = float(sm.mean(sm.merge_tree(kout.user["wait"])))
+        log(phase="verify", events_match=ok_ev, no_errors=ok_err,
+            mean_xla=mx, mean_kernel=mk)
+
+
+if __name__ == "__main__":
+    main()
