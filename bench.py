@@ -282,7 +282,10 @@ def bench_awacs():
 
     n_targets = int(os.environ.get("CIMBA_BENCH_AWACS_TARGETS", 1000))
     R, t_end = (16, 40.0) if _accel() else (4, 10.0)
+    # the standard overrides: R = lanes, OBJECTS = per-lane workload (here
+    # the simulated horizon, the knob that scales events per lane)
     R = int(os.environ.get("CIMBA_BENCH_R", R))
+    t_end = float(os.environ.get("CIMBA_BENCH_OBJECTS", t_end))
     spec, _ = awacs.build(n_targets)
 
     def init_one(rep, t):

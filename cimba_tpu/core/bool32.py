@@ -1,0 +1,246 @@
+"""bool32: a jaxpr transform that eliminates i1 (bool) vector values.
+
+Why: the Mosaic TPU compiler's layout pass check-fails (`layout.h:320
+Check failed: arr.size() >= layout_rank(implicit_dim)`) on elementwise
+logic chains over i1 vectors whose operand layouts disagree — e.g. a mask
+loaded from VMEM meeting a comparison-born mask, or an `or` of two `and`
+results (measured in round 2 via tools/mosaic_eqn_bisect.py).  Comparisons
+feeding selects are the one i1 pattern Mosaic handles everywhere.
+
+What: re-interpret a jaxpr with every bool value carried as int32 (0/1):
+
+* comparisons (`eq/ne/lt/...`, `is_finite`) bind natively, then widen the
+  i1 result to i32 immediately — the i1 lives exactly one edge;
+* `and/or/xor/not` on bools become bitwise ops on the i32 carriers;
+* `select_n` with a bool pred re-derives the pred as ``carrier != 0``
+  (comparison-born, full shape) and selects over carriers;
+* `broadcast_in_dim/reshape/transpose/...`-style structural ops act on the
+  i32 carrier, so no i1 broadcasts exist at all;
+* `reduce_or/reduce_and` become max/min reductions over carriers;
+* `convert_element_type` to/from bool routes through carriers;
+* control-flow prims (`while/cond/scan/pjit`) recurse into their
+  sub-jaxprs with the same convention — except `while`'s cond output and
+  `cond`'s scalar predicate index, which jax requires as real bool/i32
+  scalars (scalars live in SREGs, not vector mask registers: safe);
+* everything else binds unchanged (a bool-typed operand to an unknown
+  primitive falls back to materializing the i1 with ``!= 0``).
+
+The function boundary also changes: bool inputs/outputs of the
+transformed jaxpr become i32.  Callers own the cast (cheap, outside the
+kernel).
+
+Used by core/pallas_run.py to make the mega-kernel chunk Mosaic-clean; it
+is generic over any jaxpr built from the primitives the engine uses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax._src import core as jcore
+
+_I32 = jnp.int32
+
+_LOGIC = {"and": lax.bitwise_and, "or": lax.bitwise_or, "xor": lax.bitwise_xor}
+_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge", "is_finite"}
+
+
+def _is_bool(aval):
+    return getattr(aval, "dtype", None) == jnp.bool_
+
+
+def _widen(pred, dtype=_I32):
+    """i1 -> 0/1 of ``dtype`` WITHOUT convert_element_type: a plain
+    i1->i32 convert on a rank-1 vector is itself a layout-pass crash
+    (measured, culprit #2 of the bisect); a select over constant operands
+    is the pattern Mosaic lowers everywhere."""
+    return lax.select_n(
+        pred,
+        jnp.zeros(jnp.shape(pred), dtype),
+        jnp.ones(jnp.shape(pred), dtype),
+    )
+
+
+def _carrier_aval(aval):
+    if _is_bool(aval):
+        return jcore.ShapedArray(aval.shape, _I32, weak_type=False)
+    return aval
+
+
+def _to_carrier(x):
+    """Concrete bool const -> i32 carrier, converted HOST-SIDE (numpy) so
+    no bool->i32 convert eqn is traced into the kernel."""
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x, np.int32))
+
+
+def _read(env, v):
+    if isinstance(v, jcore.Literal):
+        val = v.val
+        if _is_bool(v.aval):
+            return _to_carrier(val)
+        return val
+    return env[v]
+
+
+def _sub_jaxpr_fn(closed):
+    """Python callable evaluating a ClosedJaxpr under the bool32
+    convention; its signature takes/returns carriers."""
+
+    def fn(*args):
+        return eval_bool32(closed.jaxpr, closed.consts, *args)
+
+    return fn
+
+
+def eval_bool32(jaxpr, consts, *args):
+    """Evaluate ``jaxpr`` with bool values carried as i32.
+
+    ``args`` must already be carriers (i32 where the jaxpr's invars are
+    bool).  Consts with bool dtype are converted on read.  Returns carrier
+    outputs (i32 where outvars are bool).
+    """
+    env = {}
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = _to_carrier(c) if _is_bool(v.aval) else c
+    for v, a in zip(jaxpr.invars, args):
+        env[v] = a
+
+    def write(eqn, outs):
+        for v, o in zip(eqn.outvars, outs):
+            if type(v).__name__ != "DropVar":
+                env[v] = o
+
+    for eqn in jaxpr.eqns:
+        prim = str(eqn.primitive)
+        ins = [_read(env, v) for v in eqn.invars]
+        in_bool = [_is_bool(v.aval) for v in eqn.invars]
+        out_bool = [_is_bool(v.aval) for v in eqn.outvars]
+
+        if prim in _LOGIC and any(in_bool):
+            write(eqn, [_LOGIC[prim](*ins)])
+        elif prim == "not" and in_bool[0]:
+            write(eqn, [lax.bitwise_xor(ins[0], jnp.int32(1))])
+        elif prim in _COMPARISONS:
+            outs = eqn.primitive.bind(*ins, **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(eqn, [_widen(o) for o in outs])
+        elif prim == "select_n" and in_bool[0]:
+            pred = ins[0] != 0
+            cases = ins[1:]
+            write(eqn, [lax.select_n(pred, *cases)])
+        elif prim == "convert_element_type":
+            new_dtype = eqn.params["new_dtype"]
+            if in_bool[0] and new_dtype == jnp.bool_:
+                write(eqn, [ins[0]])  # carrier stays a carrier
+            elif in_bool[0]:
+                # the carrier is exactly 0/1 — a plain numeric convert
+                write(eqn, [ins[0].astype(new_dtype)])
+            elif new_dtype == jnp.bool_:
+                write(eqn, [_widen(ins[0] != 0)])
+            else:
+                write(eqn, [eqn.primitive.bind(*ins, **eqn.params)])
+        elif prim in ("reduce_or", "reduce_and") and in_bool[0]:
+            red = lax.reduce_max if prim == "reduce_or" else lax.reduce_min
+            write(eqn, [red(ins[0], axes=eqn.params["axes"])])
+        elif prim == "while":
+            write(eqn, _bind_while(eqn, ins))
+        elif prim == "cond":
+            write(eqn, _bind_cond(eqn, ins))
+        elif prim == "scan":
+            write(eqn, _bind_scan(eqn, ins))
+        elif prim in ("pjit", "jit"):
+            # inline the body (in-kernel there is nothing for pjit to do)
+            closed = eqn.params["jaxpr"]
+            write(eqn, eval_bool32(closed.jaxpr, closed.consts, *ins))
+        elif any(in_bool) or any(out_bool):
+            # unknown primitive touching bools: materialize, bind, widen
+            mats = [
+                (x != 0) if b else x for x, b in zip(ins, in_bool)
+            ]
+            outs = eqn.primitive.bind(*mats, **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(
+                eqn,
+                [
+                    _widen(o) if b else o
+                    for o, b in zip(outs, out_bool)
+                ],
+            )
+        else:
+            outs = eqn.primitive.bind(*ins, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+            write(eqn, list(outs))
+
+    return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _bind_while(eqn, ins):
+    cond_j = eqn.params["cond_jaxpr"]
+    body_j = eqn.params["body_jaxpr"]
+    cn = eqn.params["cond_nconsts"]
+    bn = eqn.params["body_nconsts"]
+    cond_consts = ins[:cn]
+    body_consts = ins[cn : cn + bn]
+    carry = ins[cn + bn :]
+
+    def cond_fn(c):
+        (out,) = eval_bool32(
+            cond_j.jaxpr, cond_j.consts, *cond_consts, *c
+        )
+        # while_loop requires a scalar bool condition
+        return out != 0 if out.dtype != jnp.bool_ else out
+
+    def body_fn(c):
+        return tuple(
+            eval_bool32(body_j.jaxpr, body_j.consts, *body_consts, *c)
+        )
+
+    return list(lax.while_loop(cond_fn, body_fn, tuple(carry)))
+
+
+def _bind_cond(eqn, ins):
+    branches = eqn.params["branches"]
+    idx = ins[0]
+    if idx.dtype == jnp.bool_:  # shouldn't happen: carriers are i32
+        idx = idx.astype(_I32)
+    ops = ins[1:]
+    fns = [_sub_jaxpr_fn(b) for b in branches]
+    return list(lax.switch(idx, fns, *ops))
+
+
+def _bind_scan(eqn, ins):
+    p = eqn.params
+    j = p["jaxpr"]
+    nc, ncarry = p["num_consts"], p["num_carry"]
+    consts = ins[:nc]
+    init = ins[nc : nc + ncarry]
+    xs = ins[nc + ncarry :]
+
+    def body(carry, x):
+        outs = eval_bool32(j.jaxpr, j.consts, *consts, *carry, *x)
+        return tuple(outs[:ncarry]), tuple(outs[ncarry:])
+
+    carry, ys = lax.scan(
+        body, tuple(init), tuple(xs), length=p["length"],
+        reverse=p["reverse"], unroll=p.get("unroll", 1),
+    )
+    return list(carry) + list(ys)
+
+
+def transform(closed_jaxpr, example_carriers):
+    """ClosedJaxpr -> ClosedJaxpr with the bool32 convention applied.
+
+    ``example_carriers``: carrier-typed abstract values (or arrays) for the
+    jaxpr's invars — bool invars as i32.
+    """
+
+    def fn(*args):
+        return eval_bool32(
+            closed_jaxpr.jaxpr, closed_jaxpr.consts, *args
+        )
+
+    return jax.make_jaxpr(fn)(*example_carriers)

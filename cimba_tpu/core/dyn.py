@@ -26,7 +26,31 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from cimba_tpu import config
+
 _I32 = jnp.int32
+
+
+def kfori(lo: int, hi, body, init):
+    """``lax.fori_loop`` outside kernel mode; a while-loop on an unbatched
+    scalar counter inside it.  jax lowers a static-trip-count fori as
+    ``lax.scan``, and scan's vmap batching rule normalizes every carry's
+    batch axis to 0 — under the lane-LAST mega-kernel layout that wraps
+    the loop in transposes of every carried leaf, which the Mosaic layout
+    pass check-fails on (measured round 2, tools/mosaic_eqn_bisect).  The
+    while form keeps carries in their batched layout: its condition reads
+    only the counter, which vmap leaves unbatched, so the lowered
+    condition is the scalar Mosaic requires."""
+    if not config.KERNEL_MODE:
+        return lax.fori_loop(lo, hi, body, init)
+
+    def wbody(carry):
+        k, c = carry
+        return k + jnp.int32(1), body(k, c)
+
+    return lax.while_loop(
+        lambda kc: kc[0] < hi, wbody, (jnp.int32(lo), init)
+    )[1]
 
 
 def bwhere(pred, x, y):
@@ -43,13 +67,21 @@ def bwhere(pred, x, y):
     rank = max(x.ndim, y.ndim)
     extra = rank - p.ndim
     if x.dtype == jnp.bool_ and y.dtype == jnp.bool_:
-        # bool select via logic: Mosaic's select_n on i1 payloads needs an
-        # i32->i1 truncation it does not support
-        shape = jnp.broadcast_shapes(x.shape, y.shape, p.shape + (1,) * max(extra, 0))
-        pf = _expand_mask(p, shape, max(extra, 0))
-        return (pf & jnp.broadcast_to(x, shape)) | (
-            ~pf & jnp.broadcast_to(y, shape)
+        # bool select entirely in i32: Mosaic's select_n on i1 payloads
+        # needs an i32->i1 truncation it does not support, and elementwise
+        # i1 and/or chains mix mask layouts the layout pass check-fails on
+        # (measured: `layout.h Check failed: arr.size() >= layout_rank`
+        # on the rank-1 `or` this used to emit) — so combine as 0/1 ints
+        # and produce i1 once, from the trailing comparison
+        shape = jnp.broadcast_shapes(
+            x.shape, y.shape, p.shape + (1,) * max(extra, 0)
         )
+        pi = jnp.broadcast_to(
+            p.astype(_I32).reshape(p.shape + (1,) * max(extra, 0)), shape
+        )
+        xi = jnp.broadcast_to(x, shape).astype(_I32)
+        yi = jnp.broadcast_to(y, shape).astype(_I32)
+        return ((pi & xi) | ((pi ^ 1) & yi)) != 0
     if extra <= 0 or p.dtype != jnp.bool_:
         return jnp.where(p, x, y)
     shape = jnp.broadcast_shapes(x.shape, y.shape)

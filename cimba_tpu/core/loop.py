@@ -280,10 +280,13 @@ class _ConstTable:
         return out
 
 
+_kfori = dyn.kfori  # scan-free counted loop (see dyn.kfori docstring)
+
+
 def _bounded_while(cond, body, init, bound: int):
-    """``lax.while_loop`` that degrades to a masked ``fori_loop`` in kernel
+    """``lax.while_loop`` that degrades to a masked counted loop in kernel
     mode.  A vmapped while's condition is a vector, which Mosaic cannot
-    lower (`scf.condition` needs a scalar); the masked fori runs ``bound``
+    lower (`scf.condition` needs a scalar); the masked loop runs ``bound``
     iterations with no-op steps once ``cond`` goes false — equivalent as
     long as ``bound`` covers the longest real chain, which the callers
     guarantee (and check, via their runaway error codes)."""
@@ -297,7 +300,7 @@ def _bounded_while(cond, body, init, bound: int):
             lambda x, y: x if x is y else dyn.bwhere(live, x, y), c2, c
         )
 
-    return lax.fori_loop(0, bound, fbody, init)
+    return _kfori(0, bound, fbody, init)
 
 
 def _vswitch(idx, branches, *args):
@@ -474,7 +477,7 @@ def _scan_evt_waiters(sim: Sim, decide) -> Sim:
             )
         )
 
-    return lax.fori_loop(0, sim.procs.await_evt.shape[0], body, sim)
+    return _kfori(0, sim.procs.await_evt.shape[0], body, sim)
 
 
 def _dispatch_evt_wakes(sim: Sim, handle, found) -> Sim:
@@ -521,7 +524,7 @@ def _wake_waiters(sim: Sim, target, sig) -> Sim:
             )
         )
 
-    return lax.fori_loop(0, n_procs, body, sim)
+    return _kfori(0, n_procs, body, sim)
 
 
 def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
@@ -646,9 +649,9 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
         return _tree_select(has, g2sim, sim)
 
     if spec.resources:
-        sim = lax.fori_loop(0, sim.resources.holder.shape[0], drop_res, sim)
+        sim = _kfori(0, sim.resources.holder.shape[0], drop_res, sim)
     if spec.pools:
-        sim = lax.fori_loop(0, sim.pools.level.shape[0], drop_pool, sim)
+        sim = _kfori(0, sim.pools.level.shape[0], drop_pool, sim)
     return sim
 
 
@@ -758,7 +761,7 @@ def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
         sim2 = _schedule_wake(sim2, wake, q, pr.SUCCESS)
         return _tree_select(wake, sim2, sim)
 
-    return lax.fori_loop(0, sim.guards.pid.shape[1], visit, sim)
+    return _kfori(0, sim.guards.pid.shape[1], visit, sim)
 
 
 # --- command handlers ---------------------------------------------------------

@@ -14,23 +14,27 @@ per event.
 
 Design:
 
-* **Same interpreter.**  The kernel body calls ``loop.make_step(spec)`` —
-  the exact dispatcher the XLA path runs — under ``jax.vmap``; there is no
-  second implementation of the engine semantics (the f64 XLA path stays the
-  bit-exact oracle; tests compare the two).
+* **Same interpreter.**  The kernel body evaluates the jaxpr of
+  ``loop.make_step(spec)`` — the exact dispatcher the XLA path runs; there
+  is no second implementation of the engine semantics (the f64 XLA path
+  stays the bit-exact oracle; tests compare the two).
 * **f32 profile.**  Mosaic has no 64-bit types, so the kernel traces under
   ``config.profile("f32")`` (f32 clock/statistics, i32 counters).  The
   caller owns profile selection: build spec + init under f32, run here.
-* **Lane-last layout.**  A batched leaf is ``[component_dims..., L]`` with
-  the replication lane axis *last*, so lanes map onto the 128-wide VPU lane
-  dimension and small component axes (event slots, processes) land on
-  sublanes.  ``vmap(step, in_axes=-1)`` batches the interpreter; vmap's
-  while-loop batching rule turns per-lane loops into any-lane loops with
-  select masking, which Mosaic lowers fine.
+* **Lane-LAST layout, hand-batched.**  In the kernel a batched leaf is
+  ``[component_dims..., L]`` with the replication lane axis last, so lanes
+  sit on the 128-wide minor dim of every Mosaic tile and per-lane scalars
+  (clock, pc — the hot values) are full native rows.  Crucially the
+  batching is NOT ``jax.vmap``: vmap's reshape/broadcast batching rules
+  normalize batch dims to axis 0 and emit minor-axis transposes that the
+  Mosaic layout pass rejects (bisected in round 2).  ``core/lanelast.py``
+  re-batches the per-lane step jaxpr with lanes pinned last;
+  ``core/bool32.py`` then rewrites every i1 vector to an i32 carrier
+  (i1 logic chains and i1<->i32 converts also crash the layout pass).
 * **Chunked calls.**  One kernel invocation advances every lane by up to
   ``chunk_steps`` events (VMEM residency bounds per-call wall time under
-  the device watchdog); an outer XLA while-loop re-invokes until every
-  lane is done.  Each re-invocation costs one HBM round-trip of the Sim —
+  the device watchdog); an outer host loop re-invokes until every lane is
+  done.  Each re-invocation costs one HBM round-trip of the Sim —
   amortized over ``chunk_steps`` events it is noise.
 """
 
@@ -46,16 +50,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cimba_tpu import config
+from cimba_tpu.core import bool32, lanelast
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core.model import ModelSpec
-
-
-def _to_lane_last(tree):
-    return jax.tree.map(lambda x: jnp.moveaxis(x, 0, -1), tree)
-
-
-def _to_lane_first(tree):
-    return jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), tree)
 
 
 def make_kernel_run(
@@ -82,89 +79,100 @@ def make_kernel_run(
     step = cl.make_step(spec)
     cond = cl.make_cond(spec, t_end)
 
-    vstep = jax.vmap(step, in_axes=-1, out_axes=-1)
-    vcond_lane = jax.vmap(cond, in_axes=-1)
-
-    def batched_chunk(sim):
-        """Advance every lane by up to chunk_steps events.  The while-loop
-        is written batched by hand (scalar any-lane condition + explicit
-        per-lane masking) because a vmapped while's vector condition does
-        not lower in Mosaic; leaves are lane-last, so the [L] mask
-        broadcasts against [..., L] leaves."""
-
-        def wcond(carry):
-            sim, k = carry
-            return (k < chunk_steps) & jnp.any(vcond_lane(sim))
-
-        def lane_sel(live, x, y):
-            """Mosaic-safe ``where(live, x, y)`` for lane-LAST leaves: the
-            [L] mask broadcasts across *major* dims, and the rank expansion
-            plus any bool-payload select are routed through i32 (Mosaic
-            supports neither i1 broadcasts into select_n nor i1 payloads —
-            dyn.bwhere covers the lane-first case, this the lane-last)."""
-            if x is y:
-                return x
-            m = jnp.broadcast_to(live.astype(jnp.int32), x.shape) != 0
-            if x.dtype == jnp.bool_:
-                return (m & x) | (~m & y)
-            return jnp.where(m, x, y)
-
-        def wbody(carry):
-            sim, k = carry
-            live = vcond_lane(sim)
-            sim2 = vstep(sim)
-            sim = jax.tree.map(
-                lambda x, y: lane_sel(live, x, y), sim2, sim
-            )
-            return sim, k + 1
-
-        if single_step:
-            # bisect aid (tools/mosaic_bisect.py): one masked step, no
-            # while loop — separates step-lowering bugs from loop-lowering
-            sim, _ = wbody((sim, jnp.zeros((), jnp.int32)))
-            return sim
-        sim, _ = lax.while_loop(
-            wcond, wbody, (sim, jnp.zeros((), jnp.int32))
-        )
-        return sim
-
-    def kernel(jaxpr, const_info, n, *refs):
-        nc = sum(1 for kind, _ in const_info if kind == "in")
-        in_refs = refs[:n]
-        const_refs = list(refs[n : n + nc])
-        out_refs = refs[n + nc :]
-        consts = []
-        for kind, payload in const_info:
-            if kind == "in":
-                shape, size = payload
-                ref = const_refs.pop(0)
-                vals = [ref[i] for i in range(size)]  # SMEM: scalar loads
-                c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
-                consts.append(c)
-            else:
-                consts.append(payload)
-        args = [r[...] for r in in_refs]
-        outs = jax.core.eval_jaxpr(jaxpr, consts, *args)
-        for r, leaf in zip(out_refs, outs):
-            r[...] = leaf
-
     def build_chunk_call(leaves, treedef):
-        """Trace the batched chunk to a jaxpr, hoist its array constants
-        (Pallas kernels cannot capture them and jax.closure_convert hoists
-        only float consts), and wrap it in a pallas_call.  Returns
-        ``(chunk_fn, consts_in)`` where ``chunk_fn(*leaves)`` advances
-        every lane by one chunk.  Exposed for tools/mosaic_bisect.py."""
+        """``leaves`` are LANE-LAST ([comp..., L]).  Trace the per-lane
+        step/cond, batch them lane-last (core/lanelast.py), assemble the
+        chunk loop, bool32-rewrite it, hoist array constants (Pallas
+        kernels cannot capture them) to SMEM inputs, and wrap the result
+        in a pallas_call.  Returns ``(chunk_fn, consts_in)`` where
+        ``chunk_fn(*leaves)`` advances every lane by one chunk."""
         n = len(leaves)
+        L = leaves[0].shape[-1]
+        per_avals = [
+            jax.ShapeDtypeStruct(l.shape[:-1], l.dtype) for l in leaves
+        ]
         config.KERNEL_MODE = True
         try:
-            flat_chunk = jax.make_jaxpr(
+            step_j = jax.make_jaxpr(
                 lambda *ls: jax.tree.leaves(
-                    batched_chunk(jax.tree.unflatten(treedef, ls))
+                    step(jax.tree.unflatten(treedef, ls))
                 )
-            )(*leaves)
+            )(*per_avals)
+            cond_j = jax.make_jaxpr(
+                lambda *ls: cond(jax.tree.unflatten(treedef, ls))
+            )(*per_avals)
         finally:
             config.KERNEL_MODE = False
-        _maybe_dump_64bit(flat_chunk)
+        _maybe_dump_64bit(step_j)
+
+        def vstep(ls):
+            outs = lanelast.eval_lanelast(
+                step_j.jaxpr,
+                step_j.consts,
+                L,
+                [lanelast._Val(x, True) for x in ls],
+            )
+            return [
+                lanelast._promote(o, v.aval, L)
+                for o, v in zip(outs, step_j.jaxpr.outvars)
+            ]
+
+        def vcond(ls):
+            (o,) = lanelast.eval_lanelast(
+                cond_j.jaxpr,
+                cond_j.consts,
+                L,
+                [lanelast._Val(x, True) for x in ls],
+            )
+            return lanelast._promote(o, cond_j.jaxpr.outvars[0].aval, L)
+
+        def batched_chunk(*ls):
+            """Advance every lane by up to chunk_steps events: a scalar
+            any-lane-live condition with per-lane select masking.  The
+            [L] mask broadcasts against [comp..., L] leaves over leading
+            dims — the one broadcast direction Mosaic always supports."""
+
+            def wcond(carry):
+                ls, k = carry
+                return (k < chunk_steps) & jnp.any(vcond(list(ls)))
+
+            def wbody(carry):
+                ls, k = carry
+                live = vcond(list(ls))
+                new = vstep(list(ls))
+                out = tuple(
+                    x if x is y else jnp.where(live, x, y)
+                    for x, y in zip(new, ls)
+                )
+                return out, k + 1
+
+            if single_step:
+                # bisect aid (tools/mosaic_bisect.py): one masked step,
+                # no loop — separates step bugs from loop bugs
+                out, _ = wbody((tuple(ls), jnp.zeros((), jnp.int32)))
+                return list(out)
+            out, _ = lax.while_loop(
+                wcond, wbody, (tuple(ls), jnp.zeros((), jnp.int32))
+            )
+            return list(out)
+
+        flat_chunk = jax.make_jaxpr(batched_chunk)(*leaves)
+
+        # eliminate i1 vectors: bool leaves become i32 carriers at the
+        # kernel boundary and every logic op inside runs bitwise on i32
+        # (core/bool32.py — the Mosaic layout pass check-fails on i1
+        # logic chains and i1<->i32 converts, bisected)
+        bool_idx = frozenset(
+            i for i, l in enumerate(leaves) if l.dtype == jnp.bool_
+        )
+        carrier_avals = [
+            jax.ShapeDtypeStruct(
+                l.shape, jnp.int32 if i in bool_idx else l.dtype
+            )
+            for i, l in enumerate(leaves)
+        ]
+        flat_chunk = bool32.transform(flat_chunk, carrier_avals)
+
         const_info = []  # ("in", shape) for shipped arrays, ("lit", value)
         consts_in = []
         import numpy as _np
@@ -177,34 +185,50 @@ def make_kernel_run(
             else:
                 const_info.append(("lit", c))
         chunk_call = pl.pallas_call(
-            partial(kernel, flat_chunk.jaxpr, const_info, n),
-            out_shape=[jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves],
+            partial(_kernel_body, flat_chunk.jaxpr, const_info, n),
+            out_shape=[
+                jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in carrier_avals
+            ],
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n
             + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(consts_in),
             out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n,
             input_output_aliases={i: i for i in range(n)},
             interpret=interpret,
         )
-        return (lambda *ls: chunk_call(*ls, *consts_in)), consts_in
+
+        def chunk_fn(*ls):
+            boxed = [
+                l.astype(jnp.int32) if i in bool_idx else l
+                for i, l in enumerate(ls)
+            ]
+            outs = chunk_call(*boxed, *consts_in)
+            return [
+                (o != 0) if i in bool_idx else o for i, o in enumerate(outs)
+            ]
+
+        return chunk_fn, consts_in
 
     def run(sims):
         # Host-level driver, NOT for use under an outer jit.  The whole
         # kernel path — tracing, Mosaic lowering AND compilation — must
-        # happen with x64 off: under x64, fori_loop counters, weak
-        # Python-int literals and iinfo bounds materialize as int64
-        # (Mosaic's 64->32 convert rule recurses forever), and Mosaic's
-        # own lower_fun helpers re-trace reduction identities as f64.
-        # Lowering runs at first call of the inner jit, so the first chunk
-        # invocation sits inside this scope too.  Init (u64 seed mixing)
-        # stays outside, under the session's x64 setting.
+        # happen with x64 off: under x64, loop counters, weak Python-int
+        # literals and iinfo bounds materialize as int64 (Mosaic's 64->32
+        # convert rule recurses forever), and Mosaic's own lower_fun
+        # helpers re-trace reduction identities as f64.  Lowering runs at
+        # first call of the inner jit, so the first chunk invocation sits
+        # inside this scope too.  Init (u64 seed mixing) stays outside,
+        # under the session's x64 setting.
         with jax.enable_x64(False):
             return _run(sims)
 
     def _run(sims):
-        sims = _to_lane_last(sims)
-        leaves, treedef = jax.tree.flatten(sims)
+        first, treedef = jax.tree.flatten(sims)
+        # kernel boundary: lane axis moves last (XLA-side moveaxis, cheap)
+        leaves = [jnp.moveaxis(l, 0, -1) for l in first]
 
         chunk_fn, _ = build_chunk_call(leaves, treedef)
+        vcond1 = jax.vmap(cond)  # lane-first, for the host-side liveness
 
         # Chunks are dispatched from the host: each call is bounded device
         # time (well under the runtime watchdog), the any-lane-live check
@@ -213,7 +237,13 @@ def make_kernel_run(
         # the x64-off scope above.
         chunk_jit = jax.jit(chunk_fn)
         alive_jit = jax.jit(
-            lambda *ls: jnp.any(vcond_lane(jax.tree.unflatten(treedef, ls)))
+            lambda *ls: jnp.any(
+                vcond1(
+                    jax.tree.unflatten(
+                        treedef, [jnp.moveaxis(l, -1, 0) for l in ls]
+                    )
+                )
+            )
         )
         it = 0
         while bool(alive_jit(*leaves)) and it < max_chunks:
@@ -225,11 +255,34 @@ def make_kernel_run(
                 f"{max_chunks} x chunk_steps={chunk_steps} events — raise "
                 "one of them (a silent partial run would corrupt statistics)"
             )
-        sims = jax.tree.unflatten(treedef, leaves)
-        return _to_lane_first(sims)
+        leaves = [jnp.moveaxis(l, -1, 0) for l in leaves]
+        return jax.tree.unflatten(treedef, leaves)
 
     run.build_chunk_call = build_chunk_call
     return run
+
+
+def _kernel_body(jaxpr, const_info, n, *refs):
+    nc = sum(1 for kind, _ in const_info if kind == "in")
+    in_refs = refs[:n]
+    const_refs = list(refs[n : n + nc])
+    out_refs = refs[n + nc :]
+    consts = []
+    for kind, payload in const_info:
+        if kind == "in":
+            shape, size = payload
+            ref = const_refs.pop(0)
+            vals = [ref[i] for i in range(size)]  # SMEM: scalar loads
+            c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
+            consts.append(c)
+        else:
+            consts.append(payload)
+    # the jaxpr is bool32-transformed: ex-bool leaves are i32 at this
+    # boundary already, and no i1 vector survives inside
+    args = [r[...] for r in in_refs]
+    outs = jax.core.eval_jaxpr(jaxpr, consts, *args)
+    for r, leaf in zip(out_refs, outs):
+        r[...] = leaf
 
 
 def _maybe_dump_64bit(closed_jaxpr):

@@ -182,7 +182,9 @@ def hypoexponential(st, means):
         st, x = std_exponential(st)
         return st, acc + means[i] * x
 
-    st, total = lax.fori_loop(0, means.shape[0], body, (st, _R(0.0)))
+    from cimba_tpu.core import dyn
+
+    st, total = dyn.kfori(0, means.shape[0], body, (st, _R(0.0)))
     return st, total
 
 

@@ -1,0 +1,64 @@
+"""Tutorial 0 — hello, simulation (reference: `tutorial/hello.c`,
+`docs/tutorial.rst` intro).
+
+The reference's hello world starts one coroutine that logs, holds one
+time unit, and logs again.  The cimba-tpu rendition: one process block
+that holds and re-enters until the clock passes 3, counting its wakeups
+in a user counter — the smallest possible model, and the shape every
+later tutorial builds on:
+
+* a ``Model`` with one ``@m.block`` and one ``m.process``
+* commands (`hold`, `exit_`) returned from the block, never called
+* ``init_sim`` + ``make_run`` to execute to completion
+* results read off the returned ``Sim`` pytree
+
+Run:  python examples/tut_0_hello.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+
+_I = config.INDEX_DTYPE
+
+
+def build():
+    m = Model("hello", event_cap=4, guard_cap=1)
+
+    @m.user_state
+    def user_init(params):
+        return {"wakeups": jnp.zeros((), _I)}
+
+    @m.block
+    def greet(sim, p, sig):
+        sim = api.set_user(
+            sim, {"wakeups": sim.user["wakeups"] + 1}
+        )
+        done = sim.clock >= 3.0
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=greet.pc)
+        )
+
+    m.process("greeter", entry=greet)
+    return m.build()
+
+
+def main():
+    spec = build()
+    sim = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 1, 0, ()))
+    wakeups = int(sim.user["wakeups"])
+    clock = float(sim.clock)
+    assert int(sim.err) == 0
+    # wakes at t=0,1,2,3 -> four greetings, exits at clock 3
+    assert wakeups == 4, wakeups
+    assert clock == 3.0, clock
+    print(f"hello, simulation: {wakeups} wakeups, clock {clock}")
+    return wakeups
+
+
+if __name__ == "__main__":
+    main()

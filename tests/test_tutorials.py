@@ -30,3 +30,15 @@ def test_tut_3_balking_reneging_jockeying():
 def test_tut_4_harbor_all_ships_sail():
     sailed = tut_4_harbor.main()
     assert sailed > 0
+
+
+def test_tut_0_hello():
+    from examples import tut_0_hello
+
+    assert tut_0_hello.main() == 4
+
+
+def test_tut_5_awacs_nn_hook():
+    from examples import tut_5_awacs
+
+    assert tut_5_awacs.main() > 0.5 * tut_5_awacs.N_TARGETS

@@ -1,0 +1,46 @@
+"""Inventory i1 (bool) elementwise ops in the mega-kernel chunk jaxpr.
+
+The Mosaic layout-pass crash class found in round 2 is elementwise logic
+on i1 vectors whose operand layouts disagree (`layout.h:320`).  This lists
+every and/or/xor/not/select eqn with bool operands, its shapes, and its
+source line — the worklist for rewriting to the i32-combine idiom.
+"""
+
+import collections
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tools.mosaic_eqn_bisect import _trace_chunk  # noqa: E402
+
+import jax  # noqa: E402
+
+LOGIC = {"and", "or", "xor", "not", "select_n"}
+
+
+def walk(jaxpr, out, depth=0):
+    for i, eqn in enumerate(jaxpr.eqns):
+        prim = str(eqn.primitive)
+        if prim in LOGIC:
+            avals = [getattr(v, "aval", None) for v in eqn.invars]
+            if any(a is not None and str(a.dtype) == "bool" for a in avals):
+                src = jax._src.source_info_util.summarize(eqn.source_info)
+                out[(prim, tuple(str(a) for a in avals), src)] += 1
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for v in vals:
+                j = getattr(v, "jaxpr", None)
+                if j is not None:
+                    walk(j if hasattr(j, "eqns") else j.jaxpr, out, depth + 1)
+
+
+def main():
+    closed = _trace_chunk()
+    out = collections.Counter()
+    walk(closed.jaxpr, out)
+    for (prim, avals, src), cnt in sorted(out.items(), key=lambda kv: -kv[1]):
+        print(f"{cnt:4d}x {prim:10s} {list(avals)} {src}")
+
+
+if __name__ == "__main__":
+    main()

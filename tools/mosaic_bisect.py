@@ -58,8 +58,7 @@ def _stage(n):
         sims = jax.jit(jax.vmap(one))(jnp.arange(L))
 
         if n == 0:
-            lanes = pr._to_lane_last(sims)
-            leaves, treedef = jax.tree.flatten(lanes)
+            leaves, treedef = jax.tree.flatten(sims)
 
             def kernel(*refs):
                 k = len(refs) // 2
@@ -83,8 +82,7 @@ def _stage(n):
                 return slot, t[slot]
 
             vpop = jax.vmap(pop_lane, in_axes=-1, out_axes=-1)
-            lanes = pr._to_lane_last(sims)
-            leaves, treedef = jax.tree.flatten(lanes)
+            leaves, treedef = jax.tree.flatten(sims)
 
             def kernel(*refs):
                 ins = refs[:-2]
@@ -109,12 +107,12 @@ def _stage(n):
         lower_only = n >= 10
         base = n % 10
         if base == 2:
-            krun = pr.make_kernel_run(spec, chunk_steps=0, max_chunks=1,
+            krun = pr.make_kernel_run(spec, chunk_steps=0,
                                       single_step=True)
         elif base == 3:
-            krun = pr.make_kernel_run(spec, chunk_steps=1, max_chunks=1)
+            krun = pr.make_kernel_run(spec, chunk_steps=1)
         elif base == 4:
-            krun = pr.make_kernel_run(spec, chunk_steps=16, max_chunks=1)
+            krun = pr.make_kernel_run(spec, chunk_steps=16)
         else:
             krun = pr.make_kernel_run(spec, chunk_steps=64)
         if lower_only:
@@ -124,8 +122,8 @@ def _stage(n):
             topo = topologies.get_topology_desc("v5e:2x2", "tpu")
             sh = NamedSharding(Mesh([topo.devices[0]], "x"), P())
             with jax.enable_x64(False):
-                lanes = pr._to_lane_last(sims)
-                leaves, treedef = jax.tree.flatten(lanes)
+                leaves, treedef = jax.tree.flatten(sims)
+                leaves = [jnp.moveaxis(l, 0, -1) for l in leaves]
                 chunk_fn, _ = krun.build_chunk_call(leaves, treedef)
                 avals = [
                     jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh)

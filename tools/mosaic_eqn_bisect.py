@@ -42,19 +42,26 @@ def _trace_chunk():
         sims = jax.jit(jax.vmap(one))(jnp.arange(128))
         step = cl.make_step(spec)
         cond = cl.make_cond(spec, None)
-        vstep = jax.vmap(step, in_axes=-1, out_axes=-1)
-        vcond = jax.vmap(cond, in_axes=-1)
-        lanes = pr._to_lane_last(sims)
-        leaves, treedef = jax.tree.flatten(lanes)
+        vstep = jax.vmap(jax.vmap(step))
+        vcond = jax.vmap(jax.vmap(cond))
+        leaves, treedef = jax.tree.flatten(sims)
+        R = leaves[0].shape[0]
+        leaves = [l.reshape((8, R // 8) + l.shape[1:]) for l in leaves]
 
         def lane_sel(live, x, y):
             # mirror pallas_run.lane_sel (Mosaic-safe lane-last select)
             if x is y:
                 return x
-            m = jnp.broadcast_to(live.astype(jnp.int32), x.shape) != 0
+            mi = jnp.broadcast_to(
+                live.astype(jnp.int32).reshape(
+                    live.shape + (1,) * (x.ndim - 2)
+                ),
+                x.shape,
+            )
             if x.dtype == jnp.bool_:
-                return (m & x) | (~m & y)
-            return jnp.where(m, x, y)
+                return ((mi & x.astype(jnp.int32))
+                        | ((mi ^ 1) & y.astype(jnp.int32))) != 0
+            return jnp.where(mi != 0, x, y)
 
         def single(*ls):
             sim = jax.tree.unflatten(treedef, ls)
@@ -72,6 +79,16 @@ def _trace_chunk():
             # different (and differently-crashing) program
             with jax.enable_x64(False):
                 closed = jax.make_jaxpr(single)(*leaves)
+                from cimba_tpu.core import bool32
+
+                carrier_avals = [
+                    jax.ShapeDtypeStruct(
+                        l.shape,
+                        jnp.int32 if l.dtype == jnp.bool_ else l.dtype,
+                    )
+                    for l in leaves
+                ]
+                closed = bool32.transform(closed, carrier_avals)
         finally:
             config.KERNEL_MODE = False
         return closed
