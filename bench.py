@@ -158,6 +158,54 @@ def bench_mm1():
     from cimba_tpu.models import mm1
 
     R, N = _scale(*((4096, 500) if _accel() else (256, 500)))
+
+    if os.environ.get("CIMBA_BENCH_KERNEL"):
+        # Pallas mega-kernel path (f32 profile): whole-run stepping in
+        # VMEM — the per-event kernel-dispatch + HBM cost of the XLA
+        # while-loop path disappears (core/pallas_run.py)
+        from cimba_tpu import config as _cfg
+        from cimba_tpu.core import pallas_run as _pr
+
+        chunk = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 512))
+        with _cfg.profile("f32"):
+            spec, _ = mm1.build(record=False)
+
+            def batch(n):
+                return jax.vmap(
+                    lambda r: cl.init_sim(spec, 2026, r, mm1.params(n))
+                )(jnp.arange(R))
+
+            krun = _pr.make_kernel_run(
+                spec, chunk_steps=chunk, interpret=not _accel()
+            )
+            jax.block_until_ready(
+                jax.tree.leaves(krun(jax.jit(batch)(1)))
+            )  # compile on a tiny workload
+            sims = jax.jit(batch)(N)
+            jax.block_until_ready(jax.tree.leaves(sims))
+            t0 = time.perf_counter()
+            out = krun(sims)
+            jax.block_until_ready(jax.tree.leaves(out))
+            wall = time.perf_counter() - t0
+            ev = int(out.n_events.sum())
+            failed = int((out.err != 0).sum())
+        rate = ev / wall
+        _line(
+            "mm1_events_per_sec",
+            rate,
+            rate / BASELINE_EVENTS_PER_SEC,
+            {
+                "path": "pallas_kernel",
+                "chunk_steps": chunk,
+                "replications": R,
+                "objects_per_replication": N,
+                "total_events": ev,
+                "wall_s": wall,
+                "failed_replications": failed,
+            },
+        )
+        return
+
     spec, _ = mm1.build(record=False)
 
     def init_one(rep, n):

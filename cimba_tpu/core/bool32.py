@@ -44,6 +44,10 @@ _I32 = jnp.int32
 
 _LOGIC = {"and": lax.bitwise_and, "or": lax.bitwise_or, "xor": lax.bitwise_xor}
 _COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge", "is_finite"}
+_STRUCTURAL = {
+    "broadcast_in_dim", "reshape", "transpose", "slice", "squeeze",
+    "concatenate", "rev", "expand_dims",
+}
 
 
 def _is_bool(aval):
@@ -155,6 +159,13 @@ def eval_bool32(jaxpr, consts, *args):
             # inline the body (in-kernel there is nothing for pjit to do)
             closed = eqn.params["jaxpr"]
             write(eqn, eval_bool32(closed.jaxpr, closed.consts, *ins))
+        elif prim in _STRUCTURAL and in_bool[0]:
+            # structural ops act on the i32 carrier directly — binding on
+            # a materialized i1 would re-emit the i1 broadcasts this
+            # transform exists to eliminate
+            outs = eqn.primitive.bind(*ins, **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(eqn, list(outs))
         elif any(in_bool) or any(out_bool):
             # unknown primitive touching bools: materialize, bind, widen
             mats = [

@@ -45,7 +45,8 @@ _ELEMENTWISE = {
     "add", "sub", "mul", "div", "rem", "max", "min", "pow",
     "and", "or", "xor", "not", "neg", "abs", "sign", "integer_pow",
     "log", "log1p", "exp", "expm1", "sqrt", "rsqrt", "floor", "ceil",
-    "round", "logistic", "tanh", "sin", "cos",
+    "round", "logistic", "tanh", "sin", "cos", "atan2", "atan", "asin",
+    "acos", "erf", "erfc", "erf_inv", "square",
     "shift_left", "shift_right_logical", "shift_right_arithmetic",
     "eq", "ne", "lt", "le", "gt", "ge", "is_finite",
     "select_n", "convert_element_type", "clamp", "nextafter",
@@ -176,6 +177,20 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             )
             out = lax.slice(x, start, limit, strides)
             write(eqn, [_Val(out, i.batched)])
+        elif prim == "concatenate":
+            d = eqn.params["dimension"]
+            if batched:
+                ops = [
+                    _align(i, tuple(v.aval.shape), L)
+                    for i, v in zip(ins, eqn.invars)
+                ]
+            else:
+                ops = [
+                    _align_unbatched(i, tuple(v.aval.shape))
+                    for i, v in zip(ins, eqn.invars)
+                ]
+            out = lax.concatenate(ops, dimension=d)
+            write(eqn, [_Val(out, batched)])
         elif prim == "iota":
             shape = tuple(eqn.params["shape"])
             dim = eqn.params["dimension"]

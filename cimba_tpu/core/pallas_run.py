@@ -79,14 +79,13 @@ def make_kernel_run(
     step = cl.make_step(spec)
     cond = cl.make_cond(spec, t_end)
 
-    def build_chunk_call(leaves, treedef):
+    def trace_chunk(leaves, treedef):
         """``leaves`` are LANE-LAST ([comp..., L]).  Trace the per-lane
         step/cond, batch them lane-last (core/lanelast.py), assemble the
-        chunk loop, bool32-rewrite it, hoist array constants (Pallas
-        kernels cannot capture them) to SMEM inputs, and wrap the result
-        in a pallas_call.  Returns ``(chunk_fn, consts_in)`` where
-        ``chunk_fn(*leaves)`` advances every lane by one chunk."""
-        n = len(leaves)
+        chunk loop, and bool32-rewrite it.  Returns ``(flat_chunk,
+        bool_idx, carrier_avals)`` — the exact program the kernel runs
+        (tools/mosaic_eqn_bisect.py bisects THIS, so tool and kernel can
+        never diverge)."""
         L = leaves[0].shape[-1]
         per_avals = [
             jax.ShapeDtypeStruct(l.shape[:-1], l.dtype) for l in leaves
@@ -172,6 +171,14 @@ def make_kernel_run(
             for i, l in enumerate(leaves)
         ]
         flat_chunk = bool32.transform(flat_chunk, carrier_avals)
+        return flat_chunk, bool_idx, carrier_avals
+
+    def build_chunk_call(leaves, treedef):
+        """trace_chunk + constant hoisting to SMEM + the pallas_call.
+        Returns ``(chunk_fn, consts_in)`` where ``chunk_fn(*leaves)``
+        advances every lane by one chunk."""
+        n = len(leaves)
+        flat_chunk, bool_idx, carrier_avals = trace_chunk(leaves, treedef)
 
         const_info = []  # ("in", shape) for shipped arrays, ("lit", value)
         consts_in = []
@@ -222,29 +229,41 @@ def make_kernel_run(
         with jax.enable_x64(False):
             return _run(sims)
 
+    _built = {}  # (treedef, leaf avals) -> (chunk_jit, alive_jit)
+
+    def _get_built(leaves, treedef):
+        key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
+        if key not in _built:
+            chunk_fn, _ = build_chunk_call(leaves, treedef)
+            vcond1 = jax.vmap(cond)  # lane-first, for host-side liveness
+            _built[key] = (
+                jax.jit(chunk_fn),
+                jax.jit(
+                    lambda *ls: jnp.any(
+                        vcond1(
+                            jax.tree.unflatten(
+                                treedef,
+                                [jnp.moveaxis(l, -1, 0) for l in ls],
+                            )
+                        )
+                    )
+                ),
+            )
+        return _built[key]
+
     def _run(sims):
         first, treedef = jax.tree.flatten(sims)
         # kernel boundary: lane axis moves last (XLA-side moveaxis, cheap)
         leaves = [jnp.moveaxis(l, 0, -1) for l in first]
 
-        chunk_fn, _ = build_chunk_call(leaves, treedef)
-        vcond1 = jax.vmap(cond)  # lane-first, for the host-side liveness
-
         # Chunks are dispatched from the host: each call is bounded device
         # time (well under the runtime watchdog), the any-lane-live check
         # costs one tiny jitted reduction between chunks, and — decisive —
         # compilation of the chunk happens on its first call, still inside
-        # the x64-off scope above.
-        chunk_jit = jax.jit(chunk_fn)
-        alive_jit = jax.jit(
-            lambda *ls: jnp.any(
-                vcond1(
-                    jax.tree.unflatten(
-                        treedef, [jnp.moveaxis(l, -1, 0) for l in ls]
-                    )
-                )
-            )
-        )
+        # the x64-off scope above.  The build (trace + lanelast + bool32 +
+        # jit wrappers) is cached per leaf-shape so repeat runs — and a
+        # warmup before a timed run — reuse the compiled chunk.
+        chunk_jit, alive_jit = _get_built(leaves, treedef)
         it = 0
         while bool(alive_jit(*leaves)) and it < max_chunks:
             leaves = chunk_jit(*leaves)
@@ -259,6 +278,7 @@ def make_kernel_run(
         return jax.tree.unflatten(treedef, leaves)
 
     run.build_chunk_call = build_chunk_call
+    run.trace_chunk = trace_chunk
     return run
 
 

@@ -34,7 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 import cimba_tpu.random as cr
 from cimba_tpu import config
 from cimba_tpu.config import INDEX_DTYPE
-from cimba_tpu.core import api, cmd
+from cimba_tpu.core import api, cmd, dyn
 from cimba_tpu.core.model import Model
 from cimba_tpu.stats import summary as sm
 
@@ -189,9 +189,11 @@ def build(n_targets: int, scoring: str = "nn"):
         # target index within the type (targets are pids 0..N-1)
         idx = p
         # fold the position forward to now, then draw a new velocity
-        pos_now = sim.user["pos"][idx] + sim.user["vel"][idx] * (
-            sim.clock - sim.user["t_mark"][idx]
-        )
+        # one-hot dynamic reads (dyn.dget): a raw traced-index gather has
+        # no Mosaic lowering for the kernel path
+        pos_now = dyn.dget(sim.user["pos"], idx) + dyn.dget(
+            sim.user["vel"], idx
+        ) * (sim.clock - dyn.dget(sim.user["t_mark"], idx))
         # soft-bounce: if outside the arena, head back toward the center
         sim, heading = api.draw(sim, cr.uniform, 0.0, 2.0 * jnp.pi)
         to_center = -pos_now
@@ -204,9 +206,9 @@ def build(n_targets: int, scoring: str = "nn"):
             sim,
             {
                 **u,
-                "pos": u["pos"].at[idx].set(pos_now),
-                "vel": u["vel"].at[idx].set(vel),
-                "t_mark": u["t_mark"].at[idx].set(sim.clock),
+                "pos": dyn.dset(u["pos"], idx, pos_now),
+                "vel": dyn.dset(u["vel"], idx, vel),
+                "t_mark": dyn.dset(u["t_mark"], idx, sim.clock),
             },
         )
         sim, leg = api.draw(sim, cr.exponential, LEG_MEAN)

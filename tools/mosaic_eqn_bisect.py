@@ -25,6 +25,9 @@ sys.path.insert(0, REPO)
 
 
 def _trace_chunk():
+    """The EXACT program the kernel compiles: pallas_run.trace_chunk
+    (per-lane trace -> lanelast batching -> bool32), so tool and kernel
+    cannot diverge."""
     import jax
     import jax.numpy as jnp
 
@@ -40,57 +43,11 @@ def _trace_chunk():
             return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, 20))
 
         sims = jax.jit(jax.vmap(one))(jnp.arange(128))
-        step = cl.make_step(spec)
-        cond = cl.make_cond(spec, None)
-        vstep = jax.vmap(jax.vmap(step))
-        vcond = jax.vmap(jax.vmap(cond))
+        krun = pr.make_kernel_run(spec, chunk_steps=16)
         leaves, treedef = jax.tree.flatten(sims)
-        R = leaves[0].shape[0]
-        leaves = [l.reshape((8, R // 8) + l.shape[1:]) for l in leaves]
-
-        def lane_sel(live, x, y):
-            # mirror pallas_run.lane_sel (Mosaic-safe lane-last select)
-            if x is y:
-                return x
-            mi = jnp.broadcast_to(
-                live.astype(jnp.int32).reshape(
-                    live.shape + (1,) * (x.ndim - 2)
-                ),
-                x.shape,
-            )
-            if x.dtype == jnp.bool_:
-                return ((mi & x.astype(jnp.int32))
-                        | ((mi ^ 1) & y.astype(jnp.int32))) != 0
-            return jnp.where(mi != 0, x, y)
-
-        def single(*ls):
-            sim = jax.tree.unflatten(treedef, ls)
-            live = vcond(sim)
-            sim2 = vstep(sim)
-            out = jax.tree.map(
-                lambda x, y: lane_sel(live, x, y), sim2, sim
-            )
-            return jax.tree.leaves(out)
-
-        config.KERNEL_MODE = True
-        try:
-            # x64 OFF exactly like pallas_run.run(): the real kernel jaxpr
-            # has no 64-bit values; tracing with x64 on here would bisect a
-            # different (and differently-crashing) program
-            with jax.enable_x64(False):
-                closed = jax.make_jaxpr(single)(*leaves)
-                from cimba_tpu.core import bool32
-
-                carrier_avals = [
-                    jax.ShapeDtypeStruct(
-                        l.shape,
-                        jnp.int32 if l.dtype == jnp.bool_ else l.dtype,
-                    )
-                    for l in leaves
-                ]
-                closed = bool32.transform(closed, carrier_avals)
-        finally:
-            config.KERNEL_MODE = False
+        leaves = [jnp.moveaxis(l, 0, -1) for l in leaves]
+        with jax.enable_x64(False):
+            closed, _, _ = krun.trace_chunk(leaves, treedef)
         return closed
 
 
