@@ -9,8 +9,10 @@ feeding selects are the one i1 pattern Mosaic handles everywhere.
 
 What: re-interpret a jaxpr with every bool value carried as int32 (0/1):
 
-* comparisons (`eq/ne/lt/...`, `is_finite`) bind natively, then widen the
-  i1 result to i32 immediately — the i1 lives exactly one edge;
+* comparisons (`eq/ne/lt/...`, `is_finite`) bind natively and stay i1
+  until a consumer needs the carrier (lazy pair, see eval_bool32 —
+  select preds consume the i1 directly, saving a widen+re-compare round
+  trip per comparison);
 * `and/or/xor/not` on bools become bitwise ops on the i32 carriers;
 * `select_n` with a bool pred re-derives the pred as ``carrier != 0``
   (comparison-born, full shape) and selects over carriers;
@@ -105,78 +107,133 @@ def eval_bool32(jaxpr, consts, *args):
     ``args`` must already be carriers (i32 where the jaxpr's invars are
     bool).  Consts with bool dtype are converted on read.  Returns carrier
     outputs (i32 where outvars are bool).
-    """
+
+    Internally an ex-bool value is a lazy PAIR (i1, carrier): comparisons
+    store only the i1 (select preds use it directly — the one i1 pattern
+    Mosaic handles), and the carrier is materialized at most once, on
+    first use by a logic/structural/memory consumer.  This avoids the
+    widen+re-compare round trip per comparison (~28% of all kernel eqns
+    before this)."""
+
+    class _B:
+        __slots__ = ("i1", "c32")
+
+        def __init__(self, i1=None, c32=None):
+            self.i1 = i1
+            self.c32 = c32
+
+        def carrier(self):
+            if self.c32 is None:
+                self.c32 = _widen(self.i1)
+            return self.c32
+
+        def pred(self):
+            if self.i1 is None:
+                self.i1 = self.c32 != 0
+            return self.i1
+
+    def boxed(x):
+        return x if isinstance(x, _B) else _B(c32=x)
+
     env = {}
     for v, c in zip(jaxpr.constvars, consts):
-        env[v] = _to_carrier(c) if _is_bool(v.aval) else c
+        env[v] = _B(c32=_to_carrier(c)) if _is_bool(v.aval) else c
     for v, a in zip(jaxpr.invars, args):
-        env[v] = a
+        env[v] = _B(c32=a) if _is_bool(v.aval) else a
+
+    def read(v):
+        x = _read(env, v)
+        if _is_bool(v.aval):
+            return boxed(x)
+        return x
 
     def write(eqn, outs):
         for v, o in zip(eqn.outvars, outs):
             if type(v).__name__ != "DropVar":
                 env[v] = o
 
+    def carriers(eqn, ins):
+        return [
+            i.carrier() if isinstance(i, _B) else i for i in ins
+        ]
+
     for eqn in jaxpr.eqns:
         prim = str(eqn.primitive)
-        ins = [_read(env, v) for v in eqn.invars]
+        ins = [read(v) for v in eqn.invars]
         in_bool = [_is_bool(v.aval) for v in eqn.invars]
         out_bool = [_is_bool(v.aval) for v in eqn.outvars]
 
         if prim in _LOGIC and any(in_bool):
-            write(eqn, [_LOGIC[prim](*ins)])
+            a, b = carriers(eqn, ins)
+            write(eqn, [_B(c32=_LOGIC[prim](a, b))])
         elif prim == "not" and in_bool[0]:
-            write(eqn, [lax.bitwise_xor(ins[0], jnp.int32(1))])
+            write(
+                eqn,
+                [_B(c32=lax.bitwise_xor(ins[0].carrier(), jnp.int32(1)))],
+            )
         elif prim in _COMPARISONS:
-            outs = eqn.primitive.bind(*ins, **eqn.params)
+            outs = eqn.primitive.bind(*carriers(eqn, ins), **eqn.params)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            write(eqn, [_widen(o) for o in outs])
+            write(eqn, [_B(i1=o) for o in outs])
         elif prim == "select_n" and in_bool[0]:
-            pred = ins[0] != 0
-            cases = ins[1:]
-            write(eqn, [lax.select_n(pred, *cases)])
+            pred = ins[0].pred()
+            cases = carriers(eqn, ins[1:])
+            out = lax.select_n(pred, *cases)
+            write(eqn, [_B(c32=out) if out_bool[0] else out])
         elif prim == "convert_element_type":
             new_dtype = eqn.params["new_dtype"]
             if in_bool[0] and new_dtype == jnp.bool_:
-                write(eqn, [ins[0]])  # carrier stays a carrier
+                write(eqn, [ins[0]])  # stays lazy
             elif in_bool[0]:
                 # the carrier is exactly 0/1 — a plain numeric convert
-                write(eqn, [ins[0].astype(new_dtype)])
+                write(eqn, [ins[0].carrier().astype(new_dtype)])
             elif new_dtype == jnp.bool_:
-                write(eqn, [_widen(ins[0] != 0)])
+                write(eqn, [_B(i1=ins[0] != 0)])
             else:
                 write(eqn, [eqn.primitive.bind(*ins, **eqn.params)])
         elif prim in ("reduce_or", "reduce_and") and in_bool[0]:
             red = lax.reduce_max if prim == "reduce_or" else lax.reduce_min
-            write(eqn, [red(ins[0], axes=eqn.params["axes"])])
+            write(
+                eqn,
+                [_B(c32=red(ins[0].carrier(), axes=eqn.params["axes"]))],
+            )
         elif prim == "while":
-            write(eqn, _bind_while(eqn, ins))
+            write(eqn, _bind_while(eqn, carriers(eqn, ins), out_bool))
         elif prim == "cond":
-            write(eqn, _bind_cond(eqn, ins))
+            write(eqn, _bind_cond(eqn, carriers(eqn, ins), out_bool))
         elif prim == "scan":
-            write(eqn, _bind_scan(eqn, ins))
+            write(eqn, _bind_scan(eqn, carriers(eqn, ins), out_bool))
         elif prim in ("pjit", "jit"):
             # inline the body (in-kernel there is nothing for pjit to do)
             closed = eqn.params["jaxpr"]
-            write(eqn, eval_bool32(closed.jaxpr, closed.consts, *ins))
+            outs = eval_bool32(
+                closed.jaxpr, closed.consts, *carriers(eqn, ins)
+            )
+            write(
+                eqn,
+                [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
+            )
         elif prim in _STRUCTURAL and in_bool[0]:
             # structural ops act on the i32 carrier directly — binding on
             # a materialized i1 would re-emit the i1 broadcasts this
             # transform exists to eliminate
-            outs = eqn.primitive.bind(*ins, **eqn.params)
+            outs = eqn.primitive.bind(*carriers(eqn, ins), **eqn.params)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            write(eqn, list(outs))
+            write(
+                eqn,
+                [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
+            )
         elif any(in_bool) or any(out_bool):
             # unknown primitive touching bools: materialize, bind, widen
             mats = [
-                (x != 0) if b else x for x, b in zip(ins, in_bool)
+                i.pred() if isinstance(i, _B) else i for i in ins
             ]
             outs = eqn.primitive.bind(*mats, **eqn.params)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
             write(
                 eqn,
                 [
-                    _widen(o) if b else o
+                    _B(i1=o) if b else o
                     for o, b in zip(outs, out_bool)
                 ],
             )
@@ -186,10 +243,14 @@ def eval_bool32(jaxpr, consts, *args):
                 outs = [outs]
             write(eqn, list(outs))
 
-    return [_read(env, v) for v in jaxpr.outvars]
+    return [
+        (boxed(_read(env, v)).carrier() if _is_bool(v.aval)
+         else _read(env, v))
+        for v in jaxpr.outvars
+    ]
 
 
-def _bind_while(eqn, ins):
+def _bind_while(eqn, ins, out_bool=None):
     cond_j = eqn.params["cond_jaxpr"]
     body_j = eqn.params["body_jaxpr"]
     cn = eqn.params["cond_nconsts"]
@@ -213,7 +274,7 @@ def _bind_while(eqn, ins):
     return list(lax.while_loop(cond_fn, body_fn, tuple(carry)))
 
 
-def _bind_cond(eqn, ins):
+def _bind_cond(eqn, ins, out_bool=None):
     branches = eqn.params["branches"]
     idx = ins[0]
     if idx.dtype == jnp.bool_:  # shouldn't happen: carriers are i32
@@ -223,7 +284,7 @@ def _bind_cond(eqn, ins):
     return list(lax.switch(idx, fns, *ops))
 
 
-def _bind_scan(eqn, ins):
+def _bind_scan(eqn, ins, out_bool=None):
     p = eqn.params
     j = p["jaxpr"]
     nc, ncarry = p["num_consts"], p["num_carry"]
