@@ -224,7 +224,27 @@ def eval_bool32(jaxpr, consts, *args):
                 [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
             )
         elif any(in_bool) or any(out_bool):
-            # unknown primitive touching bools: materialize, bind, widen
+            # unknown primitive touching bools: scalar bools are safe
+            # (SREGs, not vector mask registers) — materialize and bind.
+            # NON-scalar bools here would silently reintroduce the i1
+            # vectors this transform exists to eliminate, surfacing hours
+            # later as a Mosaic layout-pass SIGABRT far from the cause:
+            # fail fast with the primitive and shapes instead.
+            nonscalar = [
+                f"{('in' if k < len(eqn.invars) else 'out')}:{v.aval}"
+                for k, (v, b) in enumerate(
+                    list(zip(eqn.invars, in_bool))
+                    + list(zip(eqn.outvars, out_bool))
+                )
+                if b and tuple(v.aval.shape)
+            ]
+            if nonscalar:
+                raise NotImplementedError(
+                    f"bool32: no rule for primitive '{prim}' touching "
+                    f"non-scalar bool values ({', '.join(nonscalar)}); "
+                    "binding it raw would materialize i1 vectors that "
+                    "crash the Mosaic layout pass — add a rule here"
+                )
             mats = [
                 i.pred() if isinstance(i, _B) else i for i in ins
             ]

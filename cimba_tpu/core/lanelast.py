@@ -128,6 +128,18 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             (i,) = ins
             outs = eqn.primitive.bind(i.x, **eqn.params)
             outs = outs if eqn.primitive.multiple_results else [outs]
+            if not i.batched:
+                # unbatched operands are lane-ready ([..., 1]); a per-lane
+                # scalar result must collapse that trailing dim back to a
+                # true rank-0 scalar or the 'unbatched scalars stay
+                # scalars' invariant breaks downstream (mixed ()/(1,)
+                # elementwise operands, while-cond rank check)
+                outs = [
+                    lax.reshape(o, ())
+                    if tuple(v.aval.shape) == () and jnp.ndim(o) == 1
+                    else o
+                    for o, v in zip(outs, eqn.outvars)
+                ]
             write(eqn, [_Val(o, i.batched) for o in outs])
         elif prim == "broadcast_in_dim":
             (i,) = ins
@@ -197,10 +209,19 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             dtype = eqn.params["dtype"]
             out = lax.broadcasted_iota(dtype, shape + (1,), dim)
             write(eqn, [_Val(out, False)])
+        elif prim == "dot_general":
+            write(eqn, [_dot_general(eqn, ins, L)])
         elif prim == "while":
             write(eqn, _bind_while(eqn, ins, L))
         elif prim in ("pjit", "jit"):
             closed = eqn.params["jaxpr"]
+            write(
+                eqn, eval_lanelast(closed.jaxpr, closed.consts, L, ins)
+            )
+        elif prim == "custom_jvp_call":
+            # forward-pass semantics only (no AD inside the kernel):
+            # inline the primal jaxpr, e.g. jax.nn.relu / sigmoid
+            closed = eqn.params["call_jaxpr"]
             write(
                 eqn, eval_lanelast(closed.jaxpr, closed.consts, L, ins)
             )
@@ -211,6 +232,51 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             )
 
     return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _dot_general(eqn, ins, L):
+    """Per-lane matmul, lane-last: [m,K] @ [K,n] per lane, carried as
+    [m,K,lane] x [K,n,1].  Covers the physics-hook pattern — batched
+    activations against UNBATCHED weights (consts), no batch dims — by
+    unrolling the contracting dim into multiply-accumulates whose only
+    broadcasts are sublane 1->n and minor 1->lane, both Mosaic-supported.
+    The MXU is unreachable from a lane-last VPU kernel, but K,n are small
+    for in-loop scorers (e.g. models/awacs.py NN: K<=33), so the VPU
+    multiply-add cost equals the matmul FLOPs."""
+    lhs, rhs = ins
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    out_aval = eqn.outvars[0].aval
+    pref = eqn.params.get("preferred_element_type") or out_aval.dtype
+    lhs_shape = tuple(lhs.x.shape[:-1])  # per-lane (trailing dim = lane)
+    rhs_shape = tuple(rhs.x.shape[:-1])
+    if (
+        rhs.batched
+        or lb
+        or rb
+        or len(lhs_shape) != 2
+        or len(rhs_shape) != 2
+        or tuple(lc) != (1,)
+        or tuple(rc) != (0,)
+    ):
+        raise NotImplementedError(
+            "lanelast: dot_general rule covers per-lane [m,K] @ unbatched "
+            f"[K,n] only (dims {eqn.params['dimension_numbers']}, "
+            f"lhs {lhs_shape} batched={lhs.batched}, "
+            f"rhs {rhs_shape} batched={rhs.batched})"
+        )
+    m, K = lhs_shape
+    n = rhs_shape[1]
+    lane = lhs.x.shape[-1]
+    acc = jnp.zeros((m, n, lane), pref)
+    for k in range(K):
+        lk = lax.slice(lhs.x, (0, k, 0), (m, k + 1, lane))  # [m,1,lane]
+        rk = lax.slice(rhs.x, (k, 0, 0), (k + 1, n, 1))  # [1,n,1]
+        acc = acc + jnp.broadcast_to(lk.astype(pref), (m, n, lane)) * (
+            jnp.broadcast_to(rk.astype(pref), (m, n, lane))
+        )
+    if acc.dtype != out_aval.dtype:
+        acc = acc.astype(out_aval.dtype)
+    return _Val(acc, lhs.batched)
 
 
 def _promote(val, aval, L):

@@ -180,17 +180,8 @@ def make_kernel_run(
         n = len(leaves)
         flat_chunk, bool_idx, carrier_avals = trace_chunk(leaves, treedef)
 
-        const_info = []  # ("in", shape) for shipped arrays, ("lit", value)
-        consts_in = []
-        import numpy as _np
-
-        for c in flat_chunk.consts:
-            if isinstance(c, (jax.Array, _np.ndarray)):
-                const_info.append(("in", (jnp.shape(c), jnp.size(c))))
-                # integer tables ride in SMEM; rank>=1 at the boundary
-                consts_in.append(jnp.reshape(c, (-1,)))
-            else:
-                const_info.append(("lit", c))
+        const_info, smem_in, vmem_in = route_consts(flat_chunk.consts)
+        consts_in = smem_in + vmem_in
         chunk_call = pl.pallas_call(
             partial(_kernel_body, flat_chunk.jaxpr, const_info, n),
             out_shape=[
@@ -198,7 +189,7 @@ def make_kernel_run(
                 for a in carrier_avals
             ],
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n
-            + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(consts_in),
+            + const_specs(const_info),
             out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n,
             input_output_aliases={i: i for i in range(n)},
             interpret=interpret,
@@ -282,21 +273,75 @@ def make_kernel_run(
     return run
 
 
-def _kernel_body(jaxpr, const_info, n, *refs):
-    nc = sum(1 for kind, _ in const_info if kind == "in")
-    in_refs = refs[:n]
-    const_refs = list(refs[n : n + nc])
-    out_refs = refs[n + nc :]
+def route_consts(consts):
+    """Const routing, shared by the kernel and tools/mosaic_eqn_bisect.py
+    so tool and kernel can never diverge on const placement.  Three kinds
+    (python literals stay captured; arrays must become kernel inputs or
+    pallas rejects the trace):
+
+    * ``smem``: small integer tables / scalars — flattened, rebuilt by
+      per-element scalar loads (dynamic indexing friendly);
+    * ``vmem``: float or large arrays (e.g. the AWACS NN weights,
+      lane-ready [K,n,1]) — whole-ref VMEM reads in natural shape, no
+      reshape at the boundary (Mosaic shape casts from flattened form are
+      exactly the crash class core/lanelast.py exists to avoid).
+
+    Returns ``(const_info, smem_in, vmem_in)``; kernel arg order is
+    ``*smem_in, *vmem_in`` after the state leaves.
+    """
+    const_info = []  # ("lit", value) | ("smem", (shape, size)) | ("vmem",)
+    smem_in, vmem_in = [], []
+    for c in consts:
+        if not (hasattr(c, "dtype") and hasattr(c, "shape")):
+            const_info.append(("lit", c))
+            continue
+        arr = jnp.asarray(c)  # normalizes TypedNdArray / np scalars
+        if arr.ndim == 0 or (
+            jnp.issubdtype(arr.dtype, jnp.integer) and arr.size <= 256
+        ):
+            const_info.append(("smem", (arr.shape, arr.size)))
+            smem_in.append(jnp.reshape(arr, (-1,)))
+        else:
+            const_info.append(("vmem",))
+            vmem_in.append(arr)
+    return const_info, smem_in, vmem_in
+
+
+def const_specs(const_info):
+    """BlockSpecs for the const inputs, in ``*smem_in, *vmem_in`` order."""
+    n_smem = sum(1 for info in const_info if info[0] == "smem")
+    n_vmem = sum(1 for info in const_info if info[0] == "vmem")
+    return [pl.BlockSpec(memory_space=pltpu.SMEM)] * n_smem + [
+        pl.BlockSpec(memory_space=pltpu.VMEM)
+    ] * n_vmem
+
+
+def materialize_consts(const_info, const_refs):
+    """Rebuild const VALUES from their kernel refs inside a kernel body.
+    ``const_refs``: the refs for ``*smem_in, *vmem_in``, in order."""
+    n_smem = sum(1 for info in const_info if info[0] == "smem")
+    smem_refs = list(const_refs[:n_smem])
+    vmem_refs = list(const_refs[n_smem:])
     consts = []
-    for kind, payload in const_info:
-        if kind == "in":
-            shape, size = payload
-            ref = const_refs.pop(0)
+    for info in const_info:
+        if info[0] == "smem":
+            shape, size = info[1]
+            ref = smem_refs.pop(0)
             vals = [ref[i] for i in range(size)]  # SMEM: scalar loads
             c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
             consts.append(c)
+        elif info[0] == "vmem":
+            consts.append(vmem_refs.pop(0)[...])
         else:
-            consts.append(payload)
+            consts.append(info[1])
+    return consts
+
+
+def _kernel_body(jaxpr, const_info, n, *refs):
+    nc = sum(1 for info in const_info if info[0] != "lit")
+    in_refs = refs[:n]
+    out_refs = refs[n + nc :]
+    consts = materialize_consts(const_info, refs[n : n + nc])
     # the jaxpr is bool32-transformed: ex-bool leaves are i32 at this
     # boundary already, and no i1 vector survives inside
     args = [r[...] for r in in_refs]

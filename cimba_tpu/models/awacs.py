@@ -194,13 +194,17 @@ def build(n_targets: int, scoring: str = "nn"):
         pos_now = dyn.dget(sim.user["pos"], idx) + dyn.dget(
             sim.user["vel"], idx
         ) * (sim.clock - dyn.dget(sim.user["t_mark"], idx))
-        # soft-bounce: if outside the arena, head back toward the center
+        # soft-bounce: if outside the arena, head back toward the center.
+        # Directions are selected as unit VECTORS, not heading angles:
+        # cos/sin(arctan2(-y,-x)) in closed form is just -pos/|pos|, and
+        # atan2 has no Pallas TPU lowering (the kernel path compiles this
+        # block through Mosaic).
         sim, heading = api.draw(sim, cr.uniform, 0.0, 2.0 * jnp.pi)
-        to_center = -pos_now
-        outside = jnp.linalg.norm(pos_now) > ARENA
-        center_heading = jnp.arctan2(to_center[1], to_center[0])
-        heading = jnp.where(outside, center_heading, heading)
-        vel = SPEED * jnp.stack([jnp.cos(heading), jnp.sin(heading)])
+        rand_dir = jnp.stack([jnp.cos(heading), jnp.sin(heading)])
+        r = jnp.sqrt(jnp.sum(pos_now * pos_now))
+        outside = r > ARENA
+        center_dir = -pos_now / jnp.maximum(r, 1e-6)
+        vel = SPEED * jnp.where(outside, center_dir, rand_dir)
         u = sim.user
         sim = api.set_user(
             sim,

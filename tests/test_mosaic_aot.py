@@ -1,0 +1,106 @@
+"""Offline Mosaic AOT-compilation of the mega-kernel chunk.
+
+The interpret-mode equivalence tests (test_pallas_run.py) validate kernel
+*semantics* but say nothing about Mosaic *lowering* — the very properties
+the lanelast/bool32 transforms exist to guarantee (lane-last layouts, no
+i1 vectors).  A transform regression would previously surface only on real
+TPU hardware, hours from the cause, and a mid-RPC Mosaic SIGABRT can wedge
+the accelerator tunnel (BENCH_NOTES.md).
+
+These tests run the FULL Mosaic pass pipeline on the CPU host with no TPU
+attached: `jax.experimental.topologies.get_topology_desc("v5e:2x2")`
+yields a compile-only client, and `jit(chunk).lower(aval_with_topology_
+sharding).compile()` drives Mosaic end to end (the round-2 crash class
+reproduced and bisected exactly this way — tools/mosaic_bisect.py stage
+1x).  A Mosaic check failure is a SIGABRT, not an exception, so each
+compile runs in a subprocess.
+
+Reference-parity note: this is the TPU answer to the reference CI building
+every tier (debug/NDEBUG/NASSERT) to prove each still *builds*
+(`/root/reference/test/meson.build:8-38`).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from cimba_tpu import config
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import pallas_run as pr
+
+L = 8
+with config.profile("f32"):
+    spec, args = {build}
+    def one(rep):
+        return cl.init_sim(spec, 2026, rep, args)
+    sims = jax.jit(jax.vmap(one))(jnp.arange(L))
+    krun = pr.make_kernel_run(spec, chunk_steps=16)
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    sh = NamedSharding(Mesh([topo.devices[0]], "x"), P())
+    with jax.enable_x64(False):
+        leaves, treedef = jax.tree.flatten(sims)
+        leaves = [jnp.moveaxis(l, 0, -1) for l in leaves]
+        chunk_fn, _ = krun.build_chunk_call(leaves, treedef)
+        avals = [
+            jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh)
+            for l in leaves
+        ]
+        jax.jit(chunk_fn).lower(*avals).compile()
+print("AOT_OK")
+"""
+
+_BUILDS = {
+    "mm1": "__import__('cimba_tpu.models.mm1', fromlist=['m']).build("
+    "record=False)[0], (1.0 / 0.9, 1.0, 20)",
+    "awacs": "__import__('cimba_tpu.models.awacs', fromlist=['m'])"
+    ".build(16)[0], (1.0,)",
+}
+
+
+def _aot_compile(model):
+    code = _SCRIPT.format(repo=_REPO, build=_BUILDS[model])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # offline: never touch the tunnel
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=_REPO,
+    )
+    ok = proc.returncode == 0 and "AOT_OK" in proc.stdout
+    if not ok:
+        lines = (proc.stderr or "").strip().splitlines()
+        keep = [
+            l
+            for l in lines
+            if "Check failed" in l or "Error" in l or "error" in l
+        ]
+        pytest.fail(
+            f"Mosaic AOT compile of {model} chunk failed "
+            f"(rc={proc.returncode}): "
+            + "; ".join((keep or lines)[-3:])[:800]
+        )
+
+
+@pytest.mark.slow
+def test_mm1_chunk_compiles_through_mosaic():
+    _aot_compile("mm1")
+
+
+@pytest.mark.slow
+def test_awacs_chunk_compiles_through_mosaic():
+    """Covers the lanelast dot_general rule + VMEM const inputs."""
+    _aot_compile("awacs")

@@ -82,6 +82,29 @@ def test_kernel_requires_f32_profile():
         pr.make_kernel_run(spec)
 
 
+def test_kernel_matches_xla_f32_awacs(f32_profile):
+    """configs[4] through the kernel: exercises the lanelast dot_general
+    rule (NN scorer matmuls against unbatched weights, models/awacs.py)
+    and VMEM const hoisting (the weights ride as whole-ref VMEM inputs,
+    core/pallas_run.py const routing)."""
+    from cimba_tpu.models import awacs
+
+    spec, _ = awacs.build(16)  # default scoring='nn'
+
+    def one(rep):
+        return cl.init_sim(spec, 2026, rep, awacs.params(2.0))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(8))
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    ker = pr.make_kernel_run(spec, chunk_steps=64, interpret=True)(sims)
+    assert bool((xla.n_events == ker.n_events).all())
+    assert bool((xla.clock == ker.clock).all())
+    assert int(ker.err.sum()) == 0
+    mx = sm.merge_tree(xla.user["detections"])
+    mk = sm.merge_tree(ker.user["detections"])
+    assert float(sm.mean(mx)) == float(sm.mean(mk))
+
+
 def test_kernel_matches_xla_f32_mmc(f32_profile):
     """Kernel path on a model with pool + bool pqueue-style state (mmc):
     exercises lane_sel's bool-leaf handling (i1 selects are rewritten as

@@ -113,15 +113,14 @@ def probe(spec_json):
         effects=target.effects,
     )
     # consts: only the top-level closed jaxpr carries them; nested jaxprs
-    # have empty constvars.  Ship arrays via SMEM like the real kernel.
+    # have empty constvars.  Ship them via the kernel's OWN routing
+    # (pallas_run.route_consts — smem/vmem/lit) so tool and kernel can
+    # never diverge on const placement.
+    from cimba_tpu.core import pallas_run as _pr
+
     consts = closed.consts if not path else []
-    const_info, consts_in = [], []
-    for c in consts:
-        if isinstance(c, (jax.Array, np.ndarray)):
-            const_info.append(("in", (jnp.shape(c), jnp.size(c))))
-            consts_in.append(jnp.reshape(jnp.asarray(c), (-1,)))
-        else:
-            const_info.append(("lit", c))
+    const_info, smem_in, vmem_in = _pr.route_consts(consts)
+    consts_in = smem_in + vmem_in
 
     in_avals = [v.aval for v in sub.invars]
     out_avals = [v.aval for v in sub.outvars]
@@ -131,20 +130,12 @@ def probe(spec_json):
 
     def kernel(*refs):
         n_in = len(in_avals)
-        nc = sum(1 for kind, _ in const_info if kind == "in")
+        nc = len(consts_in)
         in_refs = refs[:n_in]
-        const_refs = list(refs[n_in : n_in + nc])
         out_refs = refs[n_in + nc :]
-        cvals = []
-        for kind, payload in const_info:
-            if kind == "in":
-                shape, size = payload
-                ref = const_refs.pop(0)
-                vals = [ref[i] for i in range(size)]
-                c = vals[0] if shape == () else jnp.stack(vals).reshape(shape)
-                cvals.append(c)
-            else:
-                cvals.append(payload)
+        cvals = _pr.materialize_consts(
+            const_info, refs[n_in : n_in + nc]
+        )
         args = [
             r[...] if a.shape else r[0]
             for r, a in zip(in_refs, in_avals)
@@ -166,7 +157,7 @@ def probe(spec_json):
             jax.ShapeDtypeStruct(vmem_shape(a), a.dtype) for a in out_avals
         ],
         in_specs=[in_spec(a) for a in in_avals]
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(consts_in),
+        + _pr.const_specs(const_info),
         out_specs=[in_spec(a) for a in out_avals],
     )
     avals = [
