@@ -62,6 +62,7 @@ def make_kernel_run(
     max_chunks: int = 10_000,
     interpret: bool = False,
     single_step: bool = False,
+    mesh=None,
 ):
     """Build ``run(sims) -> sims`` where ``sims`` is a lane-FIRST batched
     Sim (the shape ``jax.vmap(init_sim)`` produces) and every lane is
@@ -70,6 +71,15 @@ def make_kernel_run(
     Must be built and called under the f32 profile
     (``config.use_profile("f32")``); raises otherwise — Mosaic cannot
     represent 64-bit leaves.
+
+    ``mesh``: a 1-D ``jax.sharding.Mesh`` to shard lanes over.  Each
+    device runs the SAME chunk kernel on its local lane block
+    (``shard_map`` over the minor lane axis — reference parity: one event
+    loop per worker thread, `src/cimba.c:156-221`); the host loop drives
+    all devices in lockstep on a global any-lane-live check, so devices
+    whose lanes finished early idle-mask until the slowest is done.  This
+    composes with the all_gather statistics merge in
+    ``runner.experiment`` — together they are the v5e-8 path.
     """
     if config.active_profile() != "f32":
         raise ValueError(
@@ -222,13 +232,52 @@ def make_kernel_run(
 
     _built = {}  # (treedef, leaf avals) -> (chunk_jit, alive_jit)
 
+    def _lane_specs(leaves):
+        from jax.sharding import PartitionSpec as P
+
+        (axis,) = mesh.axis_names
+        return tuple(
+            P(*([None] * (l.ndim - 1) + [axis])) for l in leaves
+        )
+
     def _get_built(leaves, treedef):
         key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
         if key not in _built:
-            chunk_fn, _ = build_chunk_call(leaves, treedef)
+            if mesh is None:
+                chunk_fn, _ = build_chunk_call(leaves, treedef)
+                chunk_jit = jax.jit(chunk_fn)
+            else:
+                # per-device kernel: build the chunk at LOCAL lane width
+                # (L is a static kernel shape), then shard_map it over
+                # the minor lane axis
+                from jax import shard_map
+
+                n_dev = mesh.devices.size
+                L = leaves[0].shape[-1]
+                if L % n_dev:
+                    raise ValueError(
+                        f"lanes={L} must divide evenly over {n_dev} "
+                        "devices"
+                    )
+                local = [
+                    jax.ShapeDtypeStruct(
+                        l.shape[:-1] + (L // n_dev,), l.dtype
+                    )
+                    for l in leaves
+                ]
+                chunk_fn, _ = build_chunk_call(local, treedef)
+                specs = _lane_specs(leaves)
+                sharded = shard_map(
+                    lambda *ls: tuple(chunk_fn(*ls)),
+                    mesh=mesh,
+                    in_specs=specs,
+                    out_specs=specs,
+                    check_vma=False,
+                )
+                chunk_jit = jax.jit(lambda *ls: list(sharded(*ls)))
             vcond1 = jax.vmap(cond)  # lane-first, for host-side liveness
             _built[key] = (
-                jax.jit(chunk_fn),
+                chunk_jit,
                 jax.jit(
                     lambda *ls: jnp.any(
                         vcond1(
@@ -246,6 +295,13 @@ def make_kernel_run(
         first, treedef = jax.tree.flatten(sims)
         # kernel boundary: lane axis moves last (XLA-side moveaxis, cheap)
         leaves = [jnp.moveaxis(l, 0, -1) for l in first]
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            leaves = [
+                jax.device_put(l, NamedSharding(mesh, s))
+                for l, s in zip(leaves, _lane_specs(leaves))
+            ]
 
         # Chunks are dispatched from the host: each call is bounded device
         # time (well under the runtime watchdog), the any-lane-live check

@@ -34,11 +34,48 @@ def run_dryrun(n_devices: int) -> None:
     assert int(n_failed) == 0, f"dryrun had failed replications: {n_failed}"
     assert int(pooled.n) == 2 * n_devices * 20, int(pooled.n)
     assert float(sm.mean(pooled)) > 0.0
+
+    # the Pallas kernel path over the same mesh (interpret mode on the
+    # virtual devices; Mosaic-compiled on real chips): per-device chunk
+    # kernels under shard_map must agree with the XLA path's event counts
+    kernel_events = _dryrun_kernel_mesh(mesh, n_devices)
     print(
         f"dryrun_multichip OK: {n_devices} devices, "
-        f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}",
+        f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}, "
+        f"kernel-mesh events {kernel_events}",
         flush=True,
     )
+
+
+def _dryrun_kernel_mesh(mesh, n_devices: int) -> int:
+    """Sharded mega-kernel dry run: f32 profile, lanes split over the
+    mesh, bitwise-compared against the single-device kernel run."""
+    import jax
+    import jax.numpy as jnp
+
+    from cimba_tpu import config
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.core import pallas_run as pr
+    from cimba_tpu.models import mm1
+
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+
+        def one(rep):
+            return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, 20))
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(2 * n_devices))
+        interp = jax.default_backend() != "tpu"
+        single = pr.make_kernel_run(
+            spec, chunk_steps=32, interpret=interp
+        )(sims)
+        sharded = pr.make_kernel_run(
+            spec, chunk_steps=32, interpret=interp, mesh=mesh
+        )(sims)
+        assert bool((single.n_events == sharded.n_events).all())
+        assert bool((single.clock == sharded.clock).all())
+        assert int(sharded.err.sum()) == 0, "kernel-mesh dryrun errors"
+        return int(sharded.n_events.sum())
 
 
 if __name__ == "__main__":

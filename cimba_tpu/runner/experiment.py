@@ -120,6 +120,60 @@ def run_experiment(
     )
 
 
+def run_experiment_regrow(
+    spec: ModelSpec,
+    params: Any,
+    n_replications: int,
+    *,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    t_end: Optional[float] = None,
+    max_regrows: int = 4,
+):
+    """``run_experiment`` with the capacity escape hatch: if any
+    replication died with ``ERR_EVENT_OVERFLOW``/``ERR_GUARD_OVERFLOW``,
+    double both capacities and re-run the WHOLE batch under the grown
+    spec (a re-jit at the larger shapes).
+
+    Reference parity: the reference's hashheap grows amortized-doubling
+    under the hood (`src/cmi_hashheap.c:384-426`); under jit capacities
+    are static shapes, so growth happens between jit calls instead.
+    Re-running every lane (not only the overflowed ones) keeps the
+    batched Sim shape-consistent, and costs nothing in correctness:
+    replication streams are counter-derived from (seed, rep), so healthy
+    lanes reproduce bit-identically under any capacity.
+
+    Returns ``(result, final_spec, n_regrows)`` — ``final_spec`` is what
+    actually ran last (callers reuse it to skip re-discovery).
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from cimba_tpu.core import loop as _cl
+
+    grow_errs = (_cl.ERR_EVENT_OVERFLOW, _cl.ERR_GUARD_OVERFLOW)
+    for n_regrows in range(max_regrows + 1):
+        result = run_experiment(
+            spec, params, n_replications, seed=seed, mesh=mesh, t_end=t_end
+        )
+        err = np.asarray(result.sims.err)
+        if not np.isin(err, grow_errs).any():
+            return result, spec, n_regrows
+        if n_regrows < max_regrows:
+            spec = dataclasses.replace(
+                spec,
+                event_cap=2 * spec.event_cap,
+                guard_cap=2 * spec.guard_cap,
+            )
+    raise RuntimeError(
+        f"run_experiment_regrow: capacity overflow persists after "
+        f"{max_regrows} doublings (last run at event_cap={spec.event_cap}, "
+        f"guard_cap={spec.guard_cap}) — the model schedules unboundedly "
+        "or the cap estimate is pathologically low"
+    )
+
+
 def pooled_summary(batched: sm.Summary) -> sm.Summary:
     """Merge per-replication summaries into one (host-side / jit-able)."""
     return jax.jit(sm.merge_tree)(batched)

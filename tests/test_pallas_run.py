@@ -82,6 +82,26 @@ def test_kernel_requires_f32_profile():
         pr.make_kernel_run(spec)
 
 
+def test_kernel_sharded_over_mesh_matches_single(f32_profile):
+    """Kernel x mesh composition: the chunked kernel driver under
+    shard_map over the lane axis (per-device kernels, global-liveness
+    host loop) must reproduce the single-device kernel run bitwise —
+    lanes are independent, so device placement cannot leak into
+    results.  Runs on the 8-virtual-device CPU mesh (conftest)."""
+    from jax.sharding import Mesh
+
+    spec, _ = mm1.build(record=False)
+    sims = _init_batch(spec, 64, 100)
+    mesh = Mesh(jax.devices(), ("rep",))
+    one = pr.make_kernel_run(spec, chunk_steps=64, interpret=True)(sims)
+    many = pr.make_kernel_run(
+        spec, chunk_steps=64, interpret=True, mesh=mesh
+    )(sims)
+    assert bool((one.n_events == many.n_events).all())
+    assert bool((one.clock == many.clock).all())
+    assert int(many.err.sum()) == 0
+
+
 def test_kernel_matches_xla_f32_awacs(f32_profile):
     """configs[4] through the kernel: exercises the lanelast dot_general
     rule (NN scorer matmuls against unbatched weights, models/awacs.py)

@@ -1,0 +1,81 @@
+"""Capacity escape hatch (runner/experiment.py run_experiment_regrow).
+
+Reference parity: the reference's event heap grows by amortized doubling
+(`src/cmi_hashheap.c:384-426`), so no model ever dies of a full queue.
+Under jit, capacities are static shapes — growth happens between jit
+calls: overflowed batches re-run under a doubled-cap spec (re-jit), and
+counter-derived RNG makes healthy lanes reproduce bit-identically.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import cimba_tpu.random as cr
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+from cimba_tpu.runner.experiment import (
+    run_experiment,
+    run_experiment_regrow,
+)
+
+
+def _burst_spec(n_procs, event_cap):
+    """n_procs concurrent holders: needs ~n_procs event slots at once."""
+    m = Model("burst", event_cap=event_cap, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, 1.0)
+        done = api.clock(sim) > 3.0
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(t, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work, count=n_procs)
+    return m.build()
+
+
+def test_overflow_replication_completes_after_regrow():
+    spec = _burst_spec(12, event_cap=4)
+
+    # without the hatch: every lane dies of event overflow
+    res0 = run_experiment(spec, (), 8, seed=3)
+    assert int(res0.n_failed) == 8
+    assert bool((res0.sims.err == cl.ERR_EVENT_OVERFLOW).all())
+
+    # with it: completes, caps doubled at least once
+    res, final_spec, n_regrows = run_experiment_regrow(
+        spec, (), 8, seed=3
+    )
+    assert int(res.n_failed) == 0
+    assert int(res.sims.err.sum()) == 0
+    assert n_regrows >= 1
+    assert final_spec.event_cap > spec.event_cap
+    assert int(res.total_events) > 0
+
+
+def test_regrow_noop_when_capacity_suffices():
+    spec = _burst_spec(4, event_cap=16)
+    res, final_spec, n_regrows = run_experiment_regrow(spec, (), 4, seed=1)
+    assert n_regrows == 0
+    assert final_spec.event_cap == spec.event_cap
+    assert int(res.n_failed) == 0
+
+
+def test_regrow_reproduces_ample_cap_run_bitwise():
+    """A regrown run must equal the run that started at the final cap:
+    streams are (seed, rep)-derived, so capacity cannot leak into
+    results."""
+    tight = _burst_spec(12, event_cap=4)
+    res, final_spec, _ = run_experiment_regrow(tight, (), 8, seed=3)
+    direct = run_experiment(final_spec, (), 8, seed=3)
+    assert bool((res.sims.clock == direct.sims.clock).all())
+    assert bool((res.sims.n_events == direct.sims.n_events).all())
+
+
+def test_regrow_gives_up_on_runaway():
+    """A model whose demand outruns any doubling within max_regrows."""
+    spec = _burst_spec(64, event_cap=2)
+    with pytest.raises(RuntimeError, match="overflow persists"):
+        run_experiment_regrow(spec, (), 4, seed=0, max_regrows=2)
