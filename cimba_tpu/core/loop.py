@@ -1086,7 +1086,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
     def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
         k = cmd.i
         amt = jnp.minimum(cmd.f, dyn.dget2(sim.pools.held, k, p))  # partial ok
-        owner_ok = dyn.dget2(sim.pools.held, k, p) >= cmd.f - 1e-12
+        # profile-scaled ownership tolerance: held amounts accumulate in
+        # REAL, so the release check must forgive rounding at REAL's
+        # resolution (a fixed 1e-12 is below f32 eps and would degenerate
+        # to exact compare under the kernel profile)
+        # floored at the historical 1e-12: held carries absolute error
+        # from its past magnitude, not cmd.f's, so the relative term
+        # alone would be tighter than the old constant on f64
+        tol = jnp.maximum(
+            64.0 * float(jnp.finfo(config.REAL_DTYPE).eps) * jnp.maximum(
+                jnp.asarray(1.0, config.REAL_DTYPE), jnp.abs(cmd.f)
+            ),
+            jnp.asarray(1e-12, config.REAL_DTYPE),
+        )
+        owner_ok = dyn.dget2(sim.pools.held, k, p) >= cmd.f - tol
         in_use = p_cap[k] - (dyn.dget(sim.pools.level, k) + amt)
         p2 = sim.pools._replace(
             level=dyn.dadd(sim.pools.level, k, amt),

@@ -292,6 +292,9 @@ class Model:
                 entries.append(pt.entry_pc)
                 prios.append(pt.prio)
                 names.append(pt.name if pt.count == 1 else f"{pt.name}[{k}]")
+        from cimba_tpu.utils import logger as _logger
+
+        _logger.names_set(names)  # log lines render name(pid)
         return ModelSpec(
             name=self.name,
             blocks=list(self._blocks),

@@ -27,13 +27,24 @@ def run_dryrun(n_devices: int) -> None:
 
     mesh = ex.make_mesh(n_devices)
     spec, _ = mm1.build()
-    fn = ex.make_sharded_experiment(spec, 2 * n_devices, mesh)
+    # volume matters: 32 reps/device x 50 objects is enough to catch a
+    # cross-shard statistics bug (wrong merge weights, shard overlap,
+    # dropped shard) that a smoke-sized run would slip past
+    reps = 32 * n_devices
+    fn = ex.make_sharded_experiment(spec, reps, mesh)
     pooled, n_failed, events = jax.block_until_ready(
-        fn(mm1.params(20), seed=1)
+        fn(mm1.params(50), seed=1)
     )
     assert int(n_failed) == 0, f"dryrun had failed replications: {n_failed}"
-    assert int(pooled.n) == 2 * n_devices * 20, int(pooled.n)
-    assert float(sm.mean(pooled)) > 0.0
+    assert int(pooled.n) == reps * 50, int(pooled.n)
+    mean = float(sm.mean(pooled))
+    assert mean > 0.0
+    if n_devices == 8:
+        # golden pooled mean for the canonical driver configuration
+        # (f64 path, seed=1, 256 reps x 50 objects): device placement
+        # must not leak into pooled statistics
+        golden = 4.342174158607185
+        assert abs(mean - golden) <= 1e-9 * golden, (mean, golden)
 
     # the Pallas kernel path over the same mesh (interpret mode on the
     # virtual devices; Mosaic-compiled on real chips): per-device chunk

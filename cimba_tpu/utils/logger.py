@@ -36,6 +36,43 @@ _mask = FATAL | ERROR | WARNING  # INFO off by default, like release builds
 # default fixed-width rendering
 _timeformatter = None
 
+# process-name table (parity: the reference line carries the process NAME
+# and func(line), `src/cmb_logger.c:149-227`).  Names are static model
+# structure, so the table binds host-side: Model.build() registers the
+# per-pid names and log lines render ``name(pid)`` in a host callback.
+_proc_names = None
+
+
+def names_set(names) -> None:
+    """Register per-pid process names for log rendering (called by
+    ``Model.build``; last built model wins, like the reference's one
+    TLS process context per thread)."""
+    global _proc_names
+    _proc_names = list(names) if names else None
+
+
+def _pid_str(names, p) -> str:
+    if names is not None and 0 <= int(p) < len(names):
+        return f"{names[int(p)]}({int(p)})"
+    return str(int(p))
+
+
+def _caller_src() -> str:
+    """Trace-time call-site tag ``func(line)`` (parity: the reference's
+    __func__/__LINE__ in every line) — resolved once per trace, free at
+    run time.  Walks raw frames (no inspect.stack(): that materializes
+    source context for the entire, hundreds-deep tracing stack)."""
+    import sys
+
+    f = sys._getframe(2)
+    for _ in range(4):
+        if f is None:
+            break
+        if f.f_code.co_filename != __file__:
+            return f"{f.f_code.co_name}({f.f_lineno})"
+        f = f.f_back
+    return "?"
+
 
 def flags_on(bits: int) -> None:
     """Enable levels (parity: cmb_logger_flags_on)."""
@@ -76,30 +113,25 @@ def _stream_id(sim):
 
 
 def _emit(level_name, sim, p, fmt, *args, **kwargs):
+    """One host-callback line: ``[level] r t process func(line) err | msg``
+    (parity: the reference's `[trial] [seed] time process func(line): msg`,
+    `src/cmb_logger.c:149-227`).  Process names and the call-site tag are
+    trace-time constants; only the numeric payload crosses the boundary."""
     rep = getattr(sim, "rep", -1)
-    if _timeformatter is None:
-        jax.debug.print(
-            "[{level}] r={r} t={t:.6f} p={p} err={e} | " + fmt,
-            level=level_name,
-            r=rep,
-            t=sim.clock,
-            p=p,
-            e=sim.err,
-            *args,
-            **kwargs,
-            ordered=False,
+    src = _caller_src()
+    tff = _timeformatter
+    names = _proc_names  # snapshot at trace time, like tff/src — a later
+    # Model.build() must not relabel an already-jitted model's lines
+
+    def host(r, t, p_, e, *a, **kw):
+        ts = tff(float(t)) if tff is not None else f"{float(t):.6f}"
+        print(
+            f"[{level_name}] r={int(r)} t={ts} p={_pid_str(names, p_)} "
+            f"{src} err={int(e)} | " + fmt.format(*a, **kw),
+            flush=True,
         )
-    else:
-        tff = _timeformatter
 
-        def host(r, t, p_, e, *a, **kw):
-            print(
-                f"[{level_name}] r={r} t={tff(float(t))} p={p_} err={e} | "
-                + fmt.format(*a, **kw),
-                flush=True,
-            )
-
-        jax.debug.callback(host, rep, sim.clock, p, sim.err, *args, **kwargs)
+    jax.debug.callback(host, rep, sim.clock, p, sim.err, *args, **kwargs)
 
 
 def _emit_with_seed(level_name, sim, p, fmt, *args, **kwargs):

@@ -17,22 +17,32 @@ import jax
 import numpy as np
 
 from cimba_tpu.core import loop as cl
-from cimba_tpu.models import mg1, mm1, mmc
+from cimba_tpu.models import awacs, jobshop, mg1, mm1, mmc
 
 GOLDEN = {
-    # model: (seed, rep, params) -> (clock, n_events, m1, m2, mn, mx)
+    # model: (seed, rep, params, stat_key) -> (clock, n_events, m1, m2, mn, mx)
     "mm1": (
-        (777, 3, mm1.params(500)),
+        (777, 3, mm1.params(500), "wait"),
         (563.6007325975469, 1046, 6.648322754634136, 9289.83086148609,
          0.118860917529787, 17.67583232398144),
     ),
     "mmc": (
-        (777, 5, mmc.params(400, 2.4, 1.0)),
+        (777, 5, mmc.params(400, 2.4, 1.0), "wait"),
         (187.9299965705548, 1064, 2.1212906904515667, None, None, None),
     ),
     "mg1": (
-        (777, 7, (1.25, 1.0, 1.5, 400)),
+        (777, 7, (1.25, 1.0, 1.5, 400), "wait"),
         (534.9388620042981, 866, 6.65407153510022, None, None, None),
+    ),
+    "jobshop": (
+        (777, 11, jobshop.params(120), "done"),
+        (186.45856514611054, 473, 97.12698622241122, 328903.1741311248,
+         1.391091807326474, 186.45856514611054),
+    ),
+    "awacs": (
+        (777, 13, awacs.params(200.0), "detections"),
+        (200.0, 596, 2.6716417910447765, 1450.3283582089562,
+         0.0, 8.0),
     ),
 }
 
@@ -42,19 +52,23 @@ def _run(name):
         spec, _ = mm1.build()
     elif name == "mmc":
         spec, _ = mmc.build(3)
-    else:
+    elif name == "mg1":
         spec, _ = mg1.build()
-    (seed, rep, params), _ = GOLDEN[name]
+    elif name == "jobshop":
+        spec, _ = jobshop.build()
+    else:
+        spec, _ = awacs.build(8)
+    (seed, rep, params, _key), _ = GOLDEN[name]
     return jax.jit(cl.make_run(spec))(cl.init_sim(spec, seed, rep, params))
 
 
 def _check(name):
     sim = _run(name)
-    _, (clock, n_events, m1, m2, mn, mx) = GOLDEN[name]
+    (_, _, _, key), (clock, n_events, m1, m2, mn, mx) = GOLDEN[name]
     assert int(sim.err) == 0
     np.testing.assert_allclose(float(sim.clock), clock, rtol=1e-12)
     assert int(sim.n_events) == n_events
-    w = sim.user["wait"]
+    w = sim.user[key]
     np.testing.assert_allclose(float(w.m1), m1, rtol=1e-12)
     if m2 is not None:
         np.testing.assert_allclose(float(w.m2), m2, rtol=1e-9)
@@ -74,10 +88,19 @@ def test_golden_mg1():
     _check("mg1")
 
 
+def test_golden_jobshop():
+    _check("jobshop")
+
+
+def test_golden_awacs():
+    _check("awacs")
+
+
 if __name__ == "__main__":  # regeneration helper
     for name in GOLDEN:
         sim = _run(name)
-        w = sim.user["wait"]
+        key = GOLDEN[name][0][3]
+        w = sim.user[key]
         print(
             name,
             repr(float(sim.clock)),

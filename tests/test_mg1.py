@@ -5,6 +5,7 @@ checks queue behavior against Pollaczek–Khinchine theory)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cimba_tpu.models import mg1
 from cimba_tpu.runner import experiment as ex
@@ -35,6 +36,34 @@ def test_mg1_sweep_matches_pollaczek_khinchine():
         )
         i += 1
     assert i == 6
+
+
+@pytest.mark.slow
+def test_mg1_full_sweep_matches_pk_at_scale():
+    """The reference's FULL 4 CVs x 5 utilizations x 10 reps battery
+    (`test/test_cimba.c`, README.md:283-294) at 10^4 objects per
+    replication (~4.6M events), every cell checked against
+    Pollaczek–Khinchine.  Measured relative errors (seed=11) are <=8.5%
+    everywhere except the heaviest cell (cv=2, rho=0.9), which sits ~31%
+    below theory at this horizon — finite-horizon transient bias, not an
+    engine error (the reference runs 10^6 time units per trial for the
+    same reason); it gets a documented looser bound."""
+    spec, _ = mg1.build()
+    params, cells = mg1.sweep_params(10_000)
+    res = ex.run_experiment(spec, params, len(cells), seed=11)
+    assert int(res.n_failed) == 0
+    means = np.asarray(res.sims.user["wait"].m1)
+    checked = 0
+    for (cv, rho) in dict.fromkeys(cells):
+        idx = [k for k, c in enumerate(cells) if c == (cv, rho)]
+        cell_mean = means[idx].mean()
+        w = mg1.pk_sojourn(rho, cv)
+        tol = 0.35 if (cv, rho) == (2.0, 0.9) else 0.12
+        assert abs(cell_mean - w) < tol * w, (
+            f"cell cv={cv} rho={rho}: {cell_mean:.3f} vs {w:.3f}"
+        )
+        checked += 1
+    assert checked == 20
 
 
 def test_mg1_heavy_tail_cell_converges():

@@ -87,3 +87,57 @@ def test_mmc_oracle_c1_degenerates_to_mm1():
     a = native.oracle_mm1(77, 5, 3000, 1.0 / 0.9, 1.0)
     b = native.oracle_mmc(77, 5, 3000, 1.0 / 0.9, 1.0, 1)
     assert a == b
+
+
+@pytest.mark.slow
+def test_engine_matches_cpp_oracle_at_replication_scale():
+    """The VERDICT-promised at-scale cross-validation: R=1000 vmapped
+    replications, EVERY lane checked against the sequential C++ oracle
+    (bit-identical u32 streams; the only divergence is libm-vs-XLA
+    log1p ulps accumulating in f64 sums).  This is the strongest
+    correctness statement the framework makes: a thousand independent
+    trajectories of the batched, masked, vectorized engine, each equal
+    to a straight-line scalar reimplementation."""
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.models import mm1, mmc
+
+    R, n_objects = 1000, 2000
+    spec, _ = mm1.build()
+    run = cl.make_run(spec)
+
+    def one(rep):
+        return run(cl.init_sim(spec, 42, rep, mm1.params(n_objects)))
+
+    sims = jax.block_until_ready(jax.jit(jax.vmap(one))(jnp.arange(R)))
+    clocks = np.asarray(sims.clock)
+    n_events = np.asarray(sims.n_events)
+    w = sims.user["wait"]
+    m1 = np.asarray(w.m1)
+    m2 = np.asarray(w.m2)
+    for rep in range(R):
+        ora = native.oracle_mm1(42, rep, n_objects, 1.0 / 0.9, 1.0)
+        assert n_events[rep] == ora["events"]
+        np.testing.assert_allclose(clocks[rep], ora["clock"], rtol=1e-9)
+        np.testing.assert_allclose(m1[rep], ora["mean"], rtol=1e-8)
+        np.testing.assert_allclose(m2[rep], ora["m2"], rtol=1e-6)
+
+    # the toolkit path (guards, FIFO wake order, cascades) at the same
+    # scale: M/M/3
+    c = 3
+    spec_c, _ = mmc.build(c)
+    run_c = cl.make_run(spec_c)
+
+    def one_c(rep):
+        return run_c(
+            cl.init_sim(spec_c, 43, rep, mmc.params(n_objects, 2.5, 1.0))
+        )
+
+    sims_c = jax.block_until_ready(jax.jit(jax.vmap(one_c))(jnp.arange(R)))
+    clocks = np.asarray(sims_c.clock)
+    n_events = np.asarray(sims_c.n_events)
+    m1 = np.asarray(sims_c.user["wait"].m1)
+    for rep in range(R):
+        ora = native.oracle_mmc(43, rep, n_objects, 1.0 / 2.5, 1.0, c)
+        assert n_events[rep] == ora["events"]
+        np.testing.assert_allclose(clocks[rep], ora["clock"], rtol=1e-9)
+        np.testing.assert_allclose(m1[rep], ora["mean"], rtol=1e-8)
