@@ -296,22 +296,23 @@ def _bind_while(eqn, ins, L):
     carry = list(ins[cn + bn :])
     carry_avals = [v.aval for v in body_j.jaxpr.invars[bn:]]
 
-    # batchedness fixpoint over the carry: a body pass may batch a carry
-    # leaf that started unbatched; promote and re-trace until stable
-    flags = [c.batched for c in carry]
-    for _ in range(len(flags) + 1):
-        sub = [
+    def _sub(flags):
+        return [
             _Val(jax.ShapeDtypeStruct(
                 tuple(a.shape) + ((L,) if f else ()), a.dtype
             ), f)
             for a, f in zip(carry_avals, flags)
         ]
 
+    # batchedness fixpoint over the carry: a body pass may batch a carry
+    # leaf that started unbatched; promote and re-trace until stable
+    flags = [c.batched for c in carry]
+    for _ in range(len(flags) + 1):
         def _flags_of(vals):
             return [v.batched for v in vals]
 
         out_flags = _flags_of(
-            _abstract_eval(body_j, body_consts, L, sub)
+            _abstract_eval(body_j, body_consts, L, _sub(flags))
         )
         new_flags = [a or b for a, b in zip(flags, out_flags)]
         if new_flags == flags:
@@ -320,15 +321,42 @@ def _bind_while(eqn, ins, L):
     else:
         raise RuntimeError("lanelast: while batchedness did not converge")
 
-    def cond_fn(c):
-        vals = [
-            _Val(x, f) for x, f in zip(c, flags)
-        ]
+    # Does the condition vary per lane?  A counter-only loop (dyn.kfori)
+    # keeps an unbatched scalar cond and lowers as-is.  A DATA-DEPENDENT
+    # loop (per-lane cond, e.g. the dispatcher's chain loop) lowers as
+    # any-lane-live with per-lane freeze masking — the same shape as the
+    # chunk driver's proven-on-Mosaic outer loop (pallas_run
+    # batched_chunk): scalar `reduce_or` condition, masked carries.  Each
+    # lane stops updating the moment its own cond goes false (cond is a
+    # pure function of the carry, so a frozen lane's cond stays false),
+    # which makes the batched loop exit after max-over-lanes iterations
+    # instead of a static worst-case trip count.
+    cond_batched = _abstract_eval(
+        cond_j, cond_consts, L, _sub(flags)
+    )[0].batched
+    if cond_batched:
+        # per-lane divergence freezes lanes independently, so every
+        # carry leaf must be able to hold per-lane values
+        flags = [True] * len(flags)
+
+    def _eval_cond(c):
+        vals = [_Val(x, f) for x, f in zip(c, flags)]
         (out,) = eval_lanelast(
             cond_j.jaxpr, cond_j.consts, L,
             list(cond_consts) + vals,
         )
+        return out
+
+    def cond_fn(c):
+        out = _eval_cond(c)
         r = out.x
+        if cond_batched:
+            if not out.batched or jnp.ndim(r) != 1:
+                raise RuntimeError(
+                    "lanelast: batched while condition must be a "
+                    f"per-lane scalar (got shape {jnp.shape(r)})"
+                )
+            return jnp.any(r)
         if out.batched or jnp.ndim(r):
             raise RuntimeError(
                 "lanelast: while condition must be unbatched scalar "
@@ -342,9 +370,16 @@ def _bind_while(eqn, ins, L):
             body_j.jaxpr, body_j.consts, L,
             list(body_consts) + vals,
         )
-        return tuple(
+        new = tuple(
             _promote(o, a, L) if f else o.x
             for o, a, f in zip(outs, carry_avals, flags)
+        )
+        if not cond_batched:
+            return new
+        live = _eval_cond(c).x  # [L]; broadcasts over leading dims
+        return tuple(
+            x if x is y else jnp.where(live, x, y)
+            for x, y in zip(new, c)
         )
 
     init = tuple(

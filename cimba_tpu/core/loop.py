@@ -284,23 +284,28 @@ _kfori = dyn.kfori  # scan-free counted loop (see dyn.kfori docstring)
 
 
 def _bounded_while(cond, body, init, bound: int):
-    """``lax.while_loop`` that degrades to a masked counted loop in kernel
-    mode.  A vmapped while's condition is a vector, which Mosaic cannot
-    lower (`scf.condition` needs a scalar); the masked loop runs ``bound``
-    iterations with no-op steps once ``cond`` goes false — equivalent as
-    long as ``bound`` covers the longest real chain, which the callers
-    guarantee (and check, via their runaway error codes)."""
+    """``lax.while_loop`` with a trip-count backstop in kernel mode.
+
+    In kernel mode the emitted while keeps the REAL data-dependent
+    condition (per-lane at trace time); lanelast's batched-cond rule
+    lowers it as a scalar any-lane-live condition with per-lane freeze
+    masking — so the loop exits after max-over-lanes iterations instead
+    of always running ``bound`` masked steps (the chain loop's bound is
+    ``spec.max_chain``=16 by default while real chains are 2-3 blocks:
+    measured ~5x of pure hot-loop waste before this).  ``bound`` remains
+    as the runaway backstop the callers' error codes check."""
     if not config.KERNEL_MODE:
         return lax.while_loop(cond, body, init)
 
-    def fbody(_, c):
-        live = cond(c)
-        c2 = body(c)
-        return jax.tree.map(
-            lambda x, y: x if x is y else dyn.bwhere(live, x, y), c2, c
-        )
+    def wcond(kc):
+        k, c = kc
+        return cond(c) & (k < bound)
 
-    return _kfori(0, bound, fbody, init)
+    def wbody(kc):
+        k, c = kc
+        return k + jnp.int32(1), body(c)
+
+    return lax.while_loop(wcond, wbody, (jnp.int32(0), init))[1]
 
 
 def _vswitch(idx, branches, *args):
@@ -315,14 +320,31 @@ def _vswitch(idx, branches, *args):
     stay cheap)."""
     if not config.KERNEL_MODE:
         return lax.switch(idx, branches, *args)
-    outs = [b(*args) for b in branches]
+    # dedupe identical branch callables: the dispatch table aliases the
+    # same handler at several indices (K_PROC and K_TIMER both run
+    # on_proc), and tracing it per alias would duplicate the entire chain
+    # loop in the hot kernel (measured: 2x the step body for any model
+    # with no user handlers)
+    uniq: list = []
+    index_sets: list = []
+    for j, b in enumerate(branches):
+        for u, (ub, idxs) in enumerate(zip(uniq, index_sets)):
+            if ub is b:
+                idxs.append(j)
+                break
+        else:
+            uniq.append(b)
+            index_sets.append([j])
+    outs = [b(*args) for b in uniq]
     idx = jnp.asarray(idx, _I)
     result = outs[0]
-    for j in range(1, len(outs)):
-        sel = idx == j
+    for u in range(1, len(outs)):
+        sel = idx == index_sets[u][0]
+        for j in index_sets[u][1:]:
+            sel = sel | (idx == j)
         result = jax.tree.map(
             lambda x, y: x if x is y else dyn.bwhere(sel, x, y),
-            outs[j],
+            outs[u],
             result,
         )
     return result
