@@ -97,16 +97,86 @@ def _expand_mask(mask, shape, extra: int):
     return jnp.broadcast_to(mi, shape) != 0
 
 
+# One-hot memo: the dispatcher reads/writes many component arrays at the
+# SAME traced index inside one step (procs.pc, .status, .prio ... all at
+# pid p), and every dget/dset re-derived the iota==i mask — at AWACS
+# scale ([P]=1001) the dominant per-access cost.  Keyed by (dims, id) of
+# the live index tracer; entries hold a strong ref to the index so ids
+# cannot be reused while cached.  Enabled around kernel-mode step
+# tracing (pallas_run), where the trace is built once per spec; the
+# bounded leak of one trace's masks is reclaimed by oh_cache_clear().
+_oh_cache = None
+
+
+def oh_cache_enable() -> None:
+    global _oh_cache
+    _oh_cache = {}
+
+
+def oh_cache_clear() -> None:
+    global _oh_cache
+    _oh_cache = None
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def oh_cache():
+    """Scope the one-hot memo around exactly one jaxpr trace."""
+    oh_cache_enable()
+    try:
+        yield
+    finally:
+        oh_cache_clear()
+
+
+def _key_of(i):
+    # concrete ints key by value (a fresh const tracer per asarray call
+    # would never hit); live tracers key by identity, pinned in the entry
+    try:
+        return ("v", int(i))
+    except Exception:
+        return ("t", id(i))
+
+
+def _cached(key_dims, idx_objs, make):
+    if _oh_cache is None:
+        return make()
+    # the CURRENT trace scopes the entry: a mask built inside a
+    # while/cond body sub-trace must never be served to the enclosing
+    # trace (leaked tracer) or vice versa
+    from jax._src import core as _jcore
+
+    trace = _jcore.trace_ctx.trace
+    key = (id(trace), key_dims, tuple(_key_of(i) for i in idx_objs))
+    hit = _oh_cache.get(key)
+    if hit is None:
+        hit = (make(), idx_objs, trace)  # refs pin the ids
+        _oh_cache[key] = hit
+    return hit[0]
+
+
 def _oh1(n: int, i):
     """One-hot bool mask [n] for scalar index i (batched by vmap)."""
-    return lax.broadcasted_iota(_I32, (n,), 0) == jnp.asarray(i, _I32)
+    i = jnp.asarray(i, _I32)
+    return _cached(
+        (n,), (i,),
+        lambda: lax.broadcasted_iota(_I32, (n,), 0) == i,
+    )
 
 
 def _oh2(n0: int, n1: int, i0, i1):
     """One-hot bool mask [n0, n1] for a 2-D index."""
-    m0 = lax.broadcasted_iota(_I32, (n0, n1), 0) == jnp.asarray(i0, _I32)
-    m1 = lax.broadcasted_iota(_I32, (n0, n1), 1) == jnp.asarray(i1, _I32)
-    return m0 & m1
+    i0 = jnp.asarray(i0, _I32)
+    i1 = jnp.asarray(i1, _I32)
+
+    def make():
+        m0 = lax.broadcasted_iota(_I32, (n0, n1), 0) == i0
+        m1 = lax.broadcasted_iota(_I32, (n0, n1), 1) == i1
+        return m0 & m1
+
+    return _cached((n0, n1), (i0, i1), make)
 
 
 def _reduce_pick(mask, arr):

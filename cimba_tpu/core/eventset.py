@@ -106,9 +106,13 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
     free = jnp.isinf(es.time)
     slot = _argmax32(free).astype(_I)  # first free slot
     ok = jnp.any(free) & jnp.isfinite(t)
+    # ONE shared write mask for all six field scatters (a per-field
+    # dyn.dset would re-derive the iota==slot one-hot six times over —
+    # at AWACS's CAP=2008 the dominant per-schedule cost, measured)
+    m = dyn._oh1(es.time.shape[0], slot) & ok
 
     def put(a, v):
-        return dyn.dset(a, slot, v, ok)
+        return jnp.where(m, jnp.asarray(v, a.dtype), a)
 
     es2 = EventSet(
         time=put(es.time, t),
@@ -121,7 +125,9 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
         next_seq=es.next_seq + jnp.where(ok, 1, 0).astype(_I),
         overflow=es.overflow | ~ok,
     )
-    handle = jnp.where(ok, _handle(slot, dyn.dget(es.gen, slot)), NULL_HANDLE)
+    handle = jnp.where(
+        ok, _handle(slot, dyn._reduce_pick(m, es.gen)), NULL_HANDLE
+    )
     return es2, handle.astype(_I)
 
 
@@ -142,15 +148,26 @@ def _valid(es: EventSet, handle):
     )
 
 
+def _handle_mask(es: EventSet, handle):
+    """Shared (one-hot mask, ok) for handle-addressed ops: the slot
+    one-hot is derived once and reused for the liveness/generation reads
+    AND the writes, instead of one one-hot per dget/dset."""
+    slot = _slot_of(jnp.maximum(handle, 0))
+    ohs = dyn._oh1(es.time.shape[0], slot)
+    t_at = dyn._reduce_pick(ohs, es.time)
+    g_at = dyn._reduce_pick(ohs, es.gen)
+    ok = (handle >= 0) & jnp.isfinite(t_at) & (g_at == _gen_of(handle))
+    return ohs & ok, ok
+
+
 def cancel(es: EventSet, handle):
     """Remove by handle; returns (es, existed).  O(1) scatter — the
     capability the reference needed the whole hash map for."""
-    slot = _slot_of(jnp.maximum(handle, 0))
-    ok = _valid(es, handle)
+    m, ok = _handle_mask(es, handle)
     return (
         es._replace(
-            time=dyn.dset(es.time, slot, NEVER, ok),
-            gen=dyn.dadd(es.gen, slot, 1, ok),
+            time=jnp.where(m, _T(NEVER), es.time),
+            gen=es.gen + m.astype(_I),
         ),
         ok,
     )
@@ -159,23 +176,23 @@ def cancel(es: EventSet, handle):
 def reschedule(es: EventSet, handle, new_t):
     """Move an event in time, keeping FIFO seq (parity:
     ``cmb_event_reschedule``).  Returns (es, existed)."""
-    slot = _slot_of(jnp.maximum(handle, 0))
-    ok = _valid(es, handle) & jnp.isfinite(jnp.asarray(new_t, _T))
+    new_t = jnp.asarray(new_t, _T)
+    m, ok = _handle_mask(es, handle)
+    fin = jnp.isfinite(new_t)
     return (
         es._replace(
-            time=dyn.dset(es.time, slot, jnp.asarray(new_t, _T), ok)
+            time=jnp.where(m & fin, new_t, es.time)
         ),
-        ok,
+        ok & fin,
     )
 
 
 def reprioritize(es: EventSet, handle, new_prio):
     """Parity: ``cmb_event_reprioritize``.  Returns (es, existed)."""
-    slot = _slot_of(jnp.maximum(handle, 0))
-    ok = _valid(es, handle)
+    m, ok = _handle_mask(es, handle)
     return (
         es._replace(
-            prio=dyn.dset(es.prio, slot, jnp.asarray(new_prio, _I), ok)
+            prio=jnp.where(m, jnp.asarray(new_prio, _I), es.prio)
         ),
         ok,
     )
@@ -183,20 +200,22 @@ def reprioritize(es: EventSet, handle, new_prio):
 
 def _argnext(es: EventSet):
     """Index of the next event: min time, then max prio, then min seq —
-    three masked reductions, no data-dependent control flow."""
+    three masked reductions, no data-dependent control flow.
+
+    ``found`` is folded into the first mask, which makes the final mask
+    EXACTLY one-hot with no uniquification pass: live slots carry
+    distinct seq values (strictly increasing at schedule, preserved by
+    reschedule), and when the set is empty m1 is all-false rather than
+    matching every +inf free slot."""
     t_min = jnp.min(es.time)
-    m1 = es.time == t_min
+    found = jnp.isfinite(t_min)
+    m1 = (es.time == t_min) & found
     p_max = jnp.max(jnp.where(m1, es.prio, jnp.iinfo(jnp.int32).min))
     m2 = m1 & (es.prio == p_max)
     s_min = jnp.min(jnp.where(m2, es.seq, jnp.iinfo(jnp.int32).max))
-    m3 = m2 & (es.seq == s_min)
-    # exactly one slot set when found; the mask doubles as the one-hot
-    # for the field reads in peek/pop (dyn._reduce_pick)
-    first = _argmax32(m3).astype(_I)
-    m3 = m3 & (
-        lax.broadcasted_iota(jnp.int32, m3.shape, 0) == first
-    )
-    return first, m3, jnp.isfinite(t_min)
+    m3 = m2 & (es.seq == s_min)  # one-hot (or empty): seq unique when live
+    slot = _argmax32(m3).astype(_I)
+    return slot, m3, found
 
 
 def peek(es: EventSet) -> Event:
@@ -228,11 +247,11 @@ def pop(es: EventSet):
             found, _handle(slot, dyn._reduce_pick(m, es.gen)), NULL_HANDLE
         ).astype(_I),
     )
-    # found is per-lane scalar under vmap: combine with the slot mask in
-    # int32 (an i1 rank-expansion would not compile in Mosaic)
+    # m already folds `found` (all-false on an empty set), so the consume
+    # writes need no extra gating
     es2 = es._replace(
-        time=dyn.bwhere(found, jnp.where(m, _T(NEVER), es.time), es.time),
-        gen=es.gen + m.astype(_I) * found.astype(_I),
+        time=jnp.where(m, _T(NEVER), es.time),
+        gen=es.gen + m.astype(_I),
     )
     return es2, ev
 

@@ -50,7 +50,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cimba_tpu import config
-from cimba_tpu.core import bool32, lanelast
+from cimba_tpu.core import bool32, dyn, lanelast
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core.model import ModelSpec
 
@@ -102,14 +102,19 @@ def make_kernel_run(
         ]
         config.KERNEL_MODE = True
         try:
-            step_j = jax.make_jaxpr(
-                lambda *ls: jax.tree.leaves(
-                    step(jax.tree.unflatten(treedef, ls))
-                )
-            )(*per_avals)
-            cond_j = jax.make_jaxpr(
-                lambda *ls: cond(jax.tree.unflatten(treedef, ls))
-            )(*per_avals)
+            # one-hot memo scoped per trace: repeated accesses at the
+            # same pid/slot index share a single iota==i mask (cleared
+            # between traces so no tracer crosses jaxprs)
+            with dyn.oh_cache():
+                step_j = jax.make_jaxpr(
+                    lambda *ls: jax.tree.leaves(
+                        step(jax.tree.unflatten(treedef, ls))
+                    )
+                )(*per_avals)
+            with dyn.oh_cache():
+                cond_j = jax.make_jaxpr(
+                    lambda *ls: cond(jax.tree.unflatten(treedef, ls))
+                )(*per_avals)
         finally:
             config.KERNEL_MODE = False
         _maybe_dump_64bit(step_j)
