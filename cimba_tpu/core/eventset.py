@@ -198,22 +198,28 @@ def reprioritize(es: EventSet, handle, new_prio):
     )
 
 
+def _lexmin(time, prio, seq):
+    """Shared (time asc, prio desc, seq asc) argnext over parallel arrays:
+    returns (mask, found, t_min, p_max, s_min).  ``found`` is folded into
+    the first mask, which makes the result EXACTLY one-hot with no
+    uniquification pass: live slots carry distinct seq values (strictly
+    increasing at schedule, preserved by reschedule), and when the set is
+    empty the mask is all-false rather than matching every +inf free
+    slot."""
+    t_min = jnp.min(time)
+    found = jnp.isfinite(t_min)
+    m1 = (time == t_min) & found
+    p_max = jnp.max(jnp.where(m1, prio, jnp.iinfo(jnp.int32).min))
+    m2 = m1 & (prio == p_max)
+    s_min = jnp.min(jnp.where(m2, seq, jnp.iinfo(jnp.int32).max))
+    m3 = m2 & (seq == s_min)  # one-hot (or empty): seq unique when live
+    return m3, found, t_min, p_max, s_min
+
+
 def _argnext(es: EventSet):
     """Index of the next event: min time, then max prio, then min seq —
-    three masked reductions, no data-dependent control flow.
-
-    ``found`` is folded into the first mask, which makes the final mask
-    EXACTLY one-hot with no uniquification pass: live slots carry
-    distinct seq values (strictly increasing at schedule, preserved by
-    reschedule), and when the set is empty m1 is all-false rather than
-    matching every +inf free slot."""
-    t_min = jnp.min(es.time)
-    found = jnp.isfinite(t_min)
-    m1 = (es.time == t_min) & found
-    p_max = jnp.max(jnp.where(m1, es.prio, jnp.iinfo(jnp.int32).min))
-    m2 = m1 & (es.prio == p_max)
-    s_min = jnp.min(jnp.where(m2, es.seq, jnp.iinfo(jnp.int32).max))
-    m3 = m2 & (es.seq == s_min)  # one-hot (or empty): seq unique when live
+    three masked reductions, no data-dependent control flow."""
+    m3, found, _, _, _ = _lexmin(es.time, es.prio, es.seq)
     slot = _argmax32(m3).astype(_I)
     return slot, m3, found
 
@@ -304,3 +310,118 @@ def pattern_find(es: EventSet, kind=WILDCARD, subj=WILDCARD):
     return jnp.where(
         found, _handle(slot, dyn.dget(es.gen, slot)), NULL_HANDLE
     ).astype(_I)
+
+# --- dense per-process resume events ------------------------------------
+#
+# The overwhelming majority of events in any model are process resumes —
+# holds, guard wakes, interrupt/timer deliveries (kind K_PROC) — and the
+# dispatcher maintains at most ONE pending resume per process (every
+# K_PROC schedule either follows a cancel of the previous wake or targets
+# a process that provably has none; loop.py's _schedule_wake/_cancel_wake
+# discipline).  Storing them densely with slot = pid removes the general
+# table's free-slot search, generation tags and scatter masks for the hot
+# case, and shrinks the general table to timers + user events only.
+# Priority is read LIVE from procs.prio at pop time — exactly the
+# semantics priority_set's reshuffle used to restore — and seq draws from
+# the same next_seq counter as the general table, so the (time, prio
+# DESC, seq) dispatch contract is preserved verbatim across both tables.
+# (Reference parity note: this splits `cmi_hashheap` by event class; the
+# reference's heap does not need the split because its per-op cost is
+# O(log n) serial, ours is O(table width) vectorized.)
+
+
+class Wakes(NamedTuple):
+    """Pending per-process resumes ([P] slots, +inf time = none)."""
+
+    time: jnp.ndarray  # [P] _T
+    sig: jnp.ndarray   # [P] i32 signal delivered on resume
+    seq: jnp.ndarray   # [P] i32 FIFO tiebreak (shared next_seq counter)
+
+
+def wakes_create(n: int) -> Wakes:
+    return Wakes(
+        time=jnp.full((n,), NEVER, _T),
+        sig=jnp.zeros((n,), _I),
+        seq=jnp.zeros((n,), _I),
+    )
+
+
+def wake_set(wk: Wakes, p, t, sig, seq, pred=True):
+    """Arm (or overwrite) process p's resume; returns (wk, ok).  ``ok``
+    is false — and nothing is written — for a non-finite time (the
+    general table's overflow-as-failure parity; a dense slot can never
+    be 'full')."""
+    t = jnp.asarray(t, _T)
+    ok = jnp.isfinite(t)
+    if pred is not True:
+        ok = ok & pred
+    m = dyn._oh1(wk.time.shape[0], p) & ok
+    return (
+        Wakes(
+            time=jnp.where(m, t, wk.time),
+            sig=jnp.where(m, jnp.asarray(sig, _I), wk.sig),
+            seq=jnp.where(m, jnp.asarray(seq, _I), wk.seq),
+        ),
+        ok,
+    )
+
+
+def wake_clear(wk: Wakes, p, pred=True) -> Wakes:
+    m = dyn._oh1(wk.time.shape[0], p)
+    if pred is not True:
+        m = m & pred
+    return wk._replace(time=jnp.where(m, _T(NEVER), wk.time))
+
+
+def wakes_empty(wk: Wakes):
+    return ~jnp.any(jnp.isfinite(wk.time))
+
+
+def pop_merged(es: EventSet, wk: Wakes, prio, wake_kind):
+    """Pop the next event across the general table and the dense wakes
+    (lexicographic (time, prio DESC, seq) over the union; ``prio`` is the
+    live procs.prio array, ``wake_kind`` the dispatch kind a wake pop
+    reports — the caller's K_PROC).  Returns (es, wk, Event).  A wake pop
+    carries ``handle=NULL_HANDLE`` — wake events are unaddressable, so
+    the wait_event machinery (which only ever holds general-table
+    handles) never matches them."""
+    m_e, found_e, t_e, p_e, s_e = _lexmin(es.time, es.prio, es.seq)
+    m_w, found_w, t_w, p_w, s_w = _lexmin(wk.time, prio, wk.seq)
+
+    wake_first = found_w & (
+        ~found_e
+        | (t_w < t_e)
+        | ((t_w == t_e) & ((p_w > p_e) | ((p_w == p_e) & (s_w < s_e))))
+    )
+    found = found_e | found_w
+
+    slot_e = _argmax32(m_e).astype(_I)
+    pid_w = _argmax32(m_w).astype(_I)
+    event = Event(
+        time=jnp.where(wake_first, t_w, t_e),
+        prio=jnp.where(wake_first, p_w, p_e),
+        kind=jnp.where(
+            wake_first, jnp.asarray(wake_kind, _I),
+            dyn._reduce_pick(m_e, es.kind),
+        ),
+        subj=jnp.where(wake_first, pid_w, dyn._reduce_pick(m_e, es.subj)),
+        arg=jnp.where(
+            wake_first, dyn._reduce_pick(m_w, wk.sig),
+            dyn._reduce_pick(m_e, es.arg),
+        ),
+        found=found,
+        handle=jnp.where(
+            found & ~wake_first,
+            _handle(slot_e, dyn._reduce_pick(m_e, es.gen)),
+            NULL_HANDLE,
+        ).astype(_I),
+    )
+    take_e = m_e & ~wake_first
+    es2 = es._replace(
+        time=jnp.where(take_e, _T(NEVER), es.time),
+        gen=es.gen + take_e.astype(_I),
+    )
+    wk2 = wk._replace(
+        time=jnp.where(m_w & wake_first, _T(NEVER), wk.time)
+    )
+    return es2, wk2, event

@@ -116,6 +116,7 @@ class Sim(NamedTuple):
     rep: jnp.ndarray       # i32 replication index (logger trial context)
     rng: rb.RandomState
     events: ev.EventSet
+    wakes: ev.Wakes        # dense per-process resumes (see eventset.Wakes)
     procs: pr.Procs
     guards: gd.Guards
     queues: Queues
@@ -158,17 +159,18 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
     procs = pr.create(
         spec.proc_entry, spec.proc_prio, spec.n_flocals, spec.n_ilocals
     )
-    start_handles = []
-    for pid in range(spec.n_procs):
-        events, handle = ev.schedule(
-            events, t0, int(spec.proc_prio[pid]), K_PROC, pid, pr.SUCCESS
-        )
-        start_handles.append(handle)
+    # process starts are dense wakes at t0, consuming seqs 0..P-1 exactly
+    # as the former per-start ev.schedule calls did (golden-stable order)
+    wakes = ev.wakes_create(spec.n_procs)._replace(
+        time=jnp.full((spec.n_procs,), t0, config.TIME),
+        sig=jnp.full((spec.n_procs,), pr.SUCCESS, _I),
+        seq=jnp.arange(spec.n_procs, dtype=_I),
+    )
+    events = events._replace(
+        next_seq=jnp.asarray(spec.n_procs, _I)
+    )
     procs = procs._replace(
         status=jnp.full((spec.n_procs,), pr.RUNNING, _I),
-        # tracked like any other wake so an interrupt arriving before the
-        # start event pops cancels it instead of being swallowed
-        wake_handle=jnp.stack(start_handles).astype(_I),
     )
     user = spec.user_init(params) if spec.user_init else jnp.zeros(())
     t0 = jnp.asarray(t0, _T)
@@ -179,6 +181,7 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         rep=jnp.asarray(replication, _I),
         rng=rb.initialize(seed, replication),
         events=events,
+        wakes=wakes,
         procs=procs,
         guards=gd.create(spec.n_guards, spec.guard_cap),
         # absent components carry no state at all (None prunes the
@@ -363,22 +366,22 @@ def _schedule_if(sim: Sim, pred, t, prio, kind, subj, arg) -> Sim:
     return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
 
 
-def _schedule_wake(sim: Sim, pred, p, sig) -> Sim:
-    """Schedule an immediate resume for process p and record the handle in
-    wake_handle so _unwait can cancel it (an untracked wake would double-
-    resume a process that gets interrupted/stopped at the same timestamp)."""
-    es2, handle = ev.schedule(
-        sim.events, sim.clock, dyn.dget(sim.procs.prio, p), K_PROC, p, sig
-    )
-    es2 = _tree_select(pred, es2, sim.events)
-    handle = jnp.where(pred, handle, dyn.dget(sim.procs.wake_handle, p))
+def _schedule_wake(sim: Sim, pred, p, sig, t=None) -> Sim:
+    """Arm a resume for process p at ``t`` (default: now).  Dense wake
+    slot — at most one resume per process exists, every caller follows
+    the cancel-before-rearm discipline, so the overwrite is safe.  A
+    non-finite target time fails the replication (the general table's
+    overflow-as-failure parity)."""
+    t = sim.clock if t is None else t
+    wk2, ok = ev.wake_set(sim.wakes, p, t, sig, sim.events.next_seq, pred)
     sim = sim._replace(
-        events=es2,
-        procs=sim.procs._replace(
-            wake_handle=dyn.dset(sim.procs.wake_handle, p, handle)
+        wakes=wk2,
+        events=sim.events._replace(
+            next_seq=sim.events.next_seq + ok.astype(_I)
         ),
     )
-    return _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
+    armed = pred if pred is not True else jnp.asarray(True)
+    return _set_err(sim, armed & ~ok, ERR_EVENT_OVERFLOW)
 
 
 def _guard_signal(sim: Sim, gid) -> Sim:
@@ -452,14 +455,9 @@ def _record_row_if(flags, acc, row, t, v):
 
 
 def _cancel_wake(sim: Sim, p) -> Sim:
-    """Cancel p's outstanding wake event (generation-safe: a no-op if the
-    event already fired).  The analog of cancelling a stale hold timer
-    (`src/cmb_process.c:344-349`)."""
-    es2, _ = ev.cancel(sim.events, dyn.dget(sim.procs.wake_handle, p))
-    return sim._replace(
-        events=es2,
-        procs=sim.procs._replace(wake_handle=dyn.dset(sim.procs.wake_handle, p, -1)),
-    )
+    """Cancel p's outstanding resume (a no-op if none is armed).  The
+    analog of cancelling a stale hold timer (`src/cmb_process.c:344-349`)."""
+    return sim._replace(wakes=ev.wake_clear(sim.wakes, p))
 
 
 def _unwait(sim: Sim, p) -> Sim:
@@ -736,12 +734,12 @@ def priority_set(sim: Sim, p, new_prio) -> Sim:
     """Change a process's priority, reshuffling its wake event and guard
     entry (parity: cmb_process_priority_set, `src/cmb_process.c:170-220`)."""
     new_prio = jnp.asarray(new_prio, _I)
-    es2, _ = ev.reprioritize(sim.events, dyn.dget(sim.procs.wake_handle, p), new_prio)
+    # the pending wake needs no touch-up: pop_merged reads procs.prio
+    # LIVE, which IS the reshuffle the reference performs here
     gid = dyn.dget(sim.procs.pend_guard, p)
     g2 = gd.reprioritize(sim.guards, jnp.maximum(gid, 0), p, new_prio)
     g2 = _tree_select(gid >= 0, g2, sim.guards)
     return sim._replace(
-        events=es2,
         guards=g2,
         procs=sim.procs._replace(prio=dyn.dset(sim.procs.prio, p, new_prio)),
     )
@@ -853,19 +851,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     def h_hold(sim: Sim, p, cmd: pr.Command, is_retry):
         dur = jnp.maximum(cmd.f, 0.0)
-        es2, handle = ev.schedule(
-            sim.events, sim.clock + dur, dyn.dget(sim.procs.prio, p), K_PROC, p,
-            pr.SUCCESS,
+        sim = _schedule_wake(
+            sim, True, p, pr.SUCCESS, t=sim.clock + dur
         )
-        sim = sim._replace(
-            events=es2,
-            procs=sim.procs._replace(
-                wake_handle=dyn.dset(sim.procs.wake_handle, p, handle),
-                pc=dyn.dset(sim.procs.pc, p, cmd.next_pc),
-            ),
-        )
-        sim = _set_err(sim, es2.overflow, ERR_EVENT_OVERFLOW)
-        return sim, jnp.asarray(True)
+        return set_pc(sim, p, cmd.next_pc), jnp.asarray(True)
 
     def h_exit(sim: Sim, p, cmd: pr.Command, is_retry):
         return finish_process(spec, sim, p, pr.SUCCESS), jnp.asarray(True)
@@ -1506,9 +1495,12 @@ def make_step(spec: ModelSpec):
     dispatch_fns = [on_proc, on_proc] + user_handlers  # K_PROC, K_TIMER
 
     def step(sim: Sim) -> Sim:
-        es2, event = ev.pop(sim.events)
+        es2, wk2, event = ev.pop_merged(
+            sim.events, sim.wakes, sim.procs.prio, K_PROC
+        )
         sim = sim._replace(
             events=es2,
+            wakes=wk2,
             clock=jnp.where(event.found, event.time, sim.clock),
             n_events=sim.n_events
             + jnp.where(event.found, 1, 0).astype(config.COUNT),
@@ -1522,7 +1514,12 @@ def make_step(spec: ModelSpec):
             # would strand its waiter forever).
             sim = _dispatch_evt_wakes(sim, event.handle, event.found)
             sim = sim._replace(
-                done=sim.done | (~event.found & ev.is_empty(sim.events))
+                done=sim.done
+                | (
+                    ~event.found
+                    & ev.is_empty(sim.events)
+                    & ev.wakes_empty(sim.wakes)
+                )
             )
         else:
             sim = sim._replace(done=sim.done | ~event.found)
@@ -1544,7 +1541,7 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
     the while-loop out of vmap and needs the same predicate)."""
 
     def cond(sim: Sim):
-        empty = ev.is_empty(sim.events)
+        empty = ev.is_empty(sim.events) & ev.wakes_empty(sim.wakes)
         if _may_wait_events(spec, sim):
             # an event-waiter whose handle died with the set (a cancel was
             # the run's last activity) still needs one more step: the
@@ -1558,7 +1555,9 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
             out_of_work = empty
         live = ~sim.done & (sim.err == 0) & ~out_of_work
         if t_end is not None:
-            nxt = jnp.min(sim.events.time)
+            nxt = jnp.minimum(
+                jnp.min(sim.events.time), jnp.min(sim.wakes.time)
+            )
             live = live & ((nxt <= t_end) | (empty & ~out_of_work))
         return live
 

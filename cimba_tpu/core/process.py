@@ -223,7 +223,6 @@ class Procs(NamedTuple):
     pc: jnp.ndarray        # i32 current block (global index)
     status: jnp.ndarray    # i32 CREATED/RUNNING/FINISHED
     prio: jnp.ndarray      # i32 current priority
-    wake_handle: jnp.ndarray  # i32 event handle of pending hold/timer
     pend_tag: jnp.ndarray  # i32 blocked command tag, NO_PEND if none
     pend_f: jnp.ndarray    # f64
     pend_f2: jnp.ndarray   # f64
@@ -246,7 +245,6 @@ def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
         pc=entry,
         status=jnp.full((p,), CREATED, _I),
         prio=jnp.asarray(prios, _I),
-        wake_handle=jnp.full((p,), -1, _I),
         pend_tag=jnp.full((p,), NO_PEND, _I),
         pend_f=jnp.zeros((p,), _R),
         pend_f2=jnp.zeros((p,), _R),

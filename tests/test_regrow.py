@@ -20,19 +20,24 @@ from cimba_tpu.runner.experiment import (
 )
 
 
-def _burst_spec(n_procs, event_cap):
-    """n_procs concurrent holders: needs ~n_procs event slots at once."""
+def _burst_spec(n_timers, event_cap):
+    """One process keeping ~n_timers live timers: needs that many GENERAL
+    event slots at once (holds live in the dense wake table and cannot
+    overflow; timers/user events are what event_cap bounds)."""
     m = Model("burst", event_cap=event_cap, guard_cap=2)
 
     @m.block
     def work(sim, p, sig):
         sim, t = api.draw(sim, cr.exponential, 1.0)
+        for k in range(n_timers):
+            sim, _ = api.timer_add(sim, p, 10.0 + k, 100 + k)
+        sim = api.timers_clear(sim, p)
         done = api.clock(sim) > 3.0
         return sim, cmd.select(
             done, cmd.exit_(), cmd.hold(t, next_pc=work.pc)
         )
 
-    m.process("w", entry=work, count=n_procs)
+    m.process("w", entry=work)
     return m.build()
 
 
