@@ -397,8 +397,8 @@ def bench_jobshop():
 def bench_awacs():
     """BASELINE configs[4]: AWACS — 1000 target processes + NN-scored radar
     dwells (ref tutorial/tut_5_1.c at n=1000; reference runs 300 trials x
-    6 h simulated in 78 s on 3970X + 2x RTX 3090).  This is the flat event
-    set at reference scale: event_cap=2008, O(CAP) argmin per pop."""
+    6 h simulated in 78 s on 3970X + 2x RTX 3090).  This is the engine at
+    reference scale: 1001 process rows, dense wake-table pop over [P]."""
     from cimba_tpu.models import awacs
 
     n_targets = int(os.environ.get("CIMBA_BENCH_AWACS_TARGETS", 1000))

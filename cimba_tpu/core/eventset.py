@@ -108,7 +108,8 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
     ok = jnp.any(free) & jnp.isfinite(t)
     # ONE shared write mask for all six field scatters (a per-field
     # dyn.dset would re-derive the iota==slot one-hot six times over —
-    # at AWACS's CAP=2008 the dominant per-schedule cost, measured)
+    # measured as the dominant per-schedule cost at large CAP, back when
+    # holds still lived here; timer-heavy models still hit this path)
     m = dyn._oh1(es.time.shape[0], slot) & ok
 
     def put(a, v):

@@ -163,7 +163,12 @@ def build(n_targets: int, scoring: str = "nn"):
         raise ValueError(f"scoring must be 'nn' or 'threshold': {scoring}")
     m = Model(
         "awacs",
-        event_cap=2 * n_targets + 8,
+        # the general event table holds only timers/user events (process
+        # holds and resumes live in the dense per-pid wake table) and
+        # this model schedules neither — a token capacity suffices where
+        # 2*n_targets+8 slots were needed before the wake-table split,
+        # and the per-event table scan cost scales with it
+        event_cap=8,
         guard_cap=2,
     )
 

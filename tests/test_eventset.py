@@ -109,3 +109,37 @@ def test_works_under_jit_and_vmap():
     kinds, times = jax.jit(jax.vmap(program))(jnp.arange(4.0))
     np.testing.assert_array_equal(np.asarray(kinds), [2, 2, 2, 2])
     np.testing.assert_allclose(np.asarray(times), [1.0, 2.0, 3.0, 4.0])
+
+def test_big_capacity_battery():
+    """Large GENERAL table (cap=2048): ordering, handle ops and pop all
+    behave at the scale a timer-heavy model would drive (models fill
+    this table only with timers/user events since holds moved to the
+    dense wake table — no shipped model stresses it, so this does)."""
+    import numpy as np
+
+    cap = 2048
+    es = ev.create(cap)
+    rng = np.random.default_rng(7)
+    times = rng.uniform(0.0, 100.0, size=1000)
+    handles = []
+    for t in times:
+        es, h = ev.schedule(es, float(t), 0, 1, 0, 0)
+        handles.append(h)
+    assert not bool(es.overflow)
+    # cancel every third event
+    kept = []
+    for k, h in enumerate(handles):
+        if k % 3 == 0:
+            es, existed = ev.cancel(es, h)
+            assert bool(existed)
+        else:
+            kept.append(float(times[k]))
+    # pops come out in exact time order
+    kept.sort()
+    for want in kept:
+        es, e = ev.pop(es)
+        assert bool(e.found)
+        np.testing.assert_allclose(float(e.time), want, rtol=1e-12)
+    es, e = ev.pop(es)
+    assert not bool(e.found)
+    assert bool(ev.is_empty(es))

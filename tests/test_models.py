@@ -107,9 +107,11 @@ def test_awacs_nn_and_threshold_scoring_both_run():
 
 def test_awacs_reference_scale_1000_targets():
     """The reference scenario runs 1000 target coroutines
-    (`tutorial/tut_5_1.c`); this exercises the flat event set at that
-    scale — event_cap=2008, O(CAP) argmin per pop — which is exactly the
-    regime the slot-table design is worst at."""
+    (`tutorial/tut_5_1.c`); this exercises the dense wake table at that
+    scale — 1001 process rows, O(P) lexicographic pop per event — the
+    widest per-event scan any shipped model performs.  (Large GENERAL
+    event tables are covered by test_eventset's big-capacity battery:
+    models only fill that table with timers/user events now.)"""
     spec, _ = awacs.build(1000)
     run = cl.make_run(spec)
     sim = jax.jit(run)(cl.init_sim(spec, 3, 0, awacs.params(2.0)))
