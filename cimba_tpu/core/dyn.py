@@ -158,7 +158,14 @@ def _cached(key_dims, idx_objs, make):
 
 
 def _oh1(n: int, i):
-    """One-hot bool mask [n] for scalar index i (batched by vmap)."""
+    """One-hot bool mask [n] for scalar index i (batched by vmap).
+
+    A size-1 dim needs no compare: component ids come from build-time
+    Refs, so a valid index over [1] is always 0 and the mask is
+    constant-true (single-queue/resource models skip the iota+eq pass
+    entirely)."""
+    if n == 1:
+        return jnp.ones((1,), jnp.bool_)
     i = jnp.asarray(i, _I32)
     return _cached(
         (n,), (i,),
@@ -167,16 +174,26 @@ def _oh1(n: int, i):
 
 
 def _oh2(n0: int, n1: int, i0, i1):
-    """One-hot bool mask [n0, n1] for a 2-D index."""
+    """One-hot bool mask [n0, n1] for a 2-D index (size-1 dims skip
+    their compare — see :func:`_oh1`)."""
     i0 = jnp.asarray(i0, _I32)
     i1 = jnp.asarray(i1, _I32)
 
     def make():
+        if n0 == 1 and n1 == 1:
+            return jnp.ones((1, 1), jnp.bool_)
+        if n0 == 1:
+            return lax.broadcasted_iota(_I32, (1, n1), 1) == i1
+        if n1 == 1:
+            return lax.broadcasted_iota(_I32, (n0, 1), 0) == i0
         m0 = lax.broadcasted_iota(_I32, (n0, n1), 0) == i0
         m1 = lax.broadcasted_iota(_I32, (n0, n1), 1) == i1
         return m0 & m1
 
-    return _cached((n0, n1), (i0, i1), make)
+    keys = (() if n0 == 1 else (i0,)) + (() if n1 == 1 else (i1,))
+    if not keys:
+        return make()
+    return _cached((n0, n1), keys, make)
 
 
 def _reduce_pick(mask, arr):
@@ -196,6 +213,9 @@ def _reduce_pick(mask, arr):
 
 def dget(arr, i):
     """``arr[i]`` (scalar if arr is 1-D, row if 2-D+) for a traced index."""
+    if arr.shape[0] == 1:
+        # single-member component table: the read is the row itself
+        return lax.reshape(arr, arr.shape[1:])
     return _reduce_pick(_oh1(arr.shape[0], i), arr)
 
 

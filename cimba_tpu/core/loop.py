@@ -384,20 +384,27 @@ def _schedule_wake(sim: Sim, pred, p, sig, t=None) -> Sim:
     return _set_err(sim, armed & ~ok, ERR_EVENT_OVERFLOW)
 
 
-def _guard_signal(sim: Sim, gid) -> Sim:
+def _guard_signal(sim: Sim, gid, pred=True) -> Sim:
     """Wake the best waiter (if any): schedule its retry at the current
     time with its process priority (parity: cmb_resourceguard_signal
-    scheduling wakeup events rather than switching directly)."""
+    scheduling wakeup events rather than switching directly).  ``pred``
+    gates the whole signal (lets handlers run straight-line with masked
+    writes instead of a whole-Sim branch select)."""
     g2, pid = gd.pop_best(sim.guards, gid)
     woke = pid != gd.NO_PID
+    if pred is not True:
+        woke = woke & pred
+        g2 = _tree_select(pred, g2, sim.guards)
     p = jnp.maximum(pid, 0)
     sim = sim._replace(guards=g2)
     return _schedule_wake(sim, woke, p, pr.SUCCESS)
 
 
-def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False) -> Sim:
+def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False,
+                pred=True) -> Sim:
     """Pend the blocked command, enqueue on the guard, and advance pc to
     the continuation (signals deliver there if the wait is aborted).
+    ``pred`` gates every write (see _guard_signal).
 
     A retry re-enqueues with the process's original FIFO sequence so a
     woken-but-unsatisfied waiter keeps its place (no starvation; parity
@@ -408,18 +415,21 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False) -> Sim:
     g2, ok, seq = gd.enqueue(
         sim.guards, gid, p, dyn.dget(sim.procs.prio, p), seq_override=seq_override
     )
+    if pred is not True:
+        g2 = _tree_select(pred, g2, sim.guards)
     procs = sim.procs._replace(
-        pend_tag=dyn.dset(sim.procs.pend_tag, p, cmd.tag),
-        pend_f=dyn.dset(sim.procs.pend_f, p, cmd.f),
-        pend_f2=dyn.dset(sim.procs.pend_f2, p, cmd.f2),
-        pend_i=dyn.dset(sim.procs.pend_i, p, cmd.i),
-        pend_pc=dyn.dset(sim.procs.pend_pc, p, cmd.next_pc),
-        pend_guard=dyn.dset(sim.procs.pend_guard, p, jnp.asarray(gid, _I)),
-        pend_seq=dyn.dset(sim.procs.pend_seq, p, seq),
-        pc=dyn.dset(sim.procs.pc, p, cmd.next_pc),
+        pend_tag=dyn.dset(sim.procs.pend_tag, p, cmd.tag, pred),
+        pend_f=dyn.dset(sim.procs.pend_f, p, cmd.f, pred),
+        pend_f2=dyn.dset(sim.procs.pend_f2, p, cmd.f2, pred),
+        pend_i=dyn.dset(sim.procs.pend_i, p, cmd.i, pred),
+        pend_pc=dyn.dset(sim.procs.pend_pc, p, cmd.next_pc, pred),
+        pend_guard=dyn.dset(sim.procs.pend_guard, p, jnp.asarray(gid, _I), pred),
+        pend_seq=dyn.dset(sim.procs.pend_seq, p, seq, pred),
+        pc=dyn.dset(sim.procs.pc, p, cmd.next_pc, pred),
     )
     sim = sim._replace(procs=procs, guards=g2)
-    return _set_err(sim, ~ok, ERR_GUARD_OVERFLOW)
+    blocked = ~ok if pred is True else pred & ~ok
+    return _set_err(sim, blocked, ERR_GUARD_OVERFLOW)
 
 
 def _clear_pend(sim: Sim, p) -> Sim:
@@ -431,21 +441,21 @@ def _clear_pend(sim: Sim, p) -> Sim:
     )
 
 
-def _record_row(acc: ts.StepAccum, row, t, v) -> ts.StepAccum:
-    """step_record on one row of a batched StepAccum."""
+def _record_row(acc: ts.StepAccum, row, t, v, pred=True) -> ts.StepAccum:
+    """step_record on one row of a batched StepAccum, gated by ``pred``."""
     one = jax.tree.map(lambda x: dyn.dget(x, row), acc)
     upd = ts.step_record(one, t, v)
-    return jax.tree.map(lambda a, u: dyn.dset(a, row, u), acc, upd)
+    return jax.tree.map(lambda a, u: dyn.dset(a, row, u, pred), acc, upd)
 
 
-def _record_row_if(flags, acc, row, t, v):
+def _record_row_if(flags, acc, row, t, v, pred=True):
     """Recording gated by per-component static flags: traces to nothing
     when no component records (parity: the reference's optional recording
     — a documented hot-loop cost), and to a masked update when only some
     do."""
     if acc is None or not any(flags):
         return acc
-    rec = _record_row(acc, row, t, v)
+    rec = _record_row(acc, row, t, v, pred)
     if all(flags):
         return rec
     # int table compared != 0: a bool _ConstTable would emit i1 select
@@ -863,6 +873,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         return set_pc(sim, p, cmd.next_pc), jnp.asarray(False)
 
     def h_put(sim: Sim, p, cmd: pr.Command, is_retry):
+        # straight-line with pred-gated writes: the ok and blocked paths
+        # touch disjoint state under complementary predicates, so no
+        # whole-Sim branch select is needed (each saved select is a full
+        # pass over the queue ring in the kernel)
         qid = cmd.i
         size = dyn.dget(sim.queues.size, qid)
         cap = q_cap[qid]
@@ -871,52 +885,56 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # IS the dequeued front and may proceed despite others behind it
         may = is_retry | gd.is_empty(sim.guards, q_rear[qid])
         full = (size >= cap) | ~may
+        ok = ~full
 
         col = (dyn.dget(sim.queues.head, qid) + size) % cap
-        q2 = Queues(
-            items=dyn.dset2(sim.queues.items, qid, col, cmd.f),
+        sim = sim._replace(queues=Queues(
+            items=dyn.dset2(sim.queues.items, qid, col, cmd.f, ok),
             head=sim.queues.head,
-            size=dyn.dadd(sim.queues.size, qid, 1),
+            size=dyn.dadd(sim.queues.size, qid, 1, ok),
             acc=_record_row_if(
-                q_rec, sim.queues.acc, qid, sim.clock, (size + 1).astype(_R)
+                q_rec, sim.queues.acc, qid, sim.clock,
+                (size + 1).astype(_R), ok,
             ),
-        )
-        ok_sim = sim._replace(queues=q2)
+        ))
         # a successful put frees no space, so only the getter side can
         # newly be satisfiable
-        ok_sim = _guard_signal(ok_sim, q_front[qid])
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-
-        blocked_sim = _guard_wait(sim, p, q_rear[qid], cmd, is_retry)
-        return _tree_select(full, blocked_sim, ok_sim), full
+        sim = _guard_signal(sim, q_front[qid], pred=ok)
+        # both outcomes continue at next_pc (the blocked path's signals
+        # deliver there), so the pc write is unconditional
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, q_rear[qid], cmd, is_retry, pred=full)
+        return sim, full
 
     def h_get(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
         size = dyn.dget(sim.queues.size, qid)
         may = is_retry | gd.is_empty(sim.guards, q_front[qid])
         empty = (size <= 0) | ~may
+        ok = ~empty
         cap = q_cap[qid]
 
         head = dyn.dget(sim.queues.head, qid)
         item = dyn.dget2(sim.queues.items, qid, head)
-        q2 = Queues(
-            items=sim.queues.items,
-            head=dyn.dset(sim.queues.head, qid, (head + 1) % cap),
-            size=dyn.dadd(sim.queues.size, qid, -1),
-            acc=_record_row_if(
-                q_rec, sim.queues.acc, qid, sim.clock, (size - 1).astype(_R)
+        sim = sim._replace(
+            queues=Queues(
+                items=sim.queues.items,
+                head=dyn.dset(sim.queues.head, qid, (head + 1) % cap, ok),
+                size=dyn.dadd(sim.queues.size, qid, -1, ok),
+                acc=_record_row_if(
+                    q_rec, sim.queues.acc, qid, sim.clock,
+                    (size - 1).astype(_R), ok,
+                ),
+            ),
+            procs=sim.procs._replace(
+                got=dyn.dset(sim.procs.got, p, item, ok)
             ),
         )
-        ok_sim = sim._replace(
-            queues=q2,
-            procs=sim.procs._replace(got=dyn.dset(sim.procs.got, p, item)),
-        )
-        ok_sim = _guard_signal(ok_sim, q_rear[qid])   # space for putters
-        ok_sim = _guard_signal(ok_sim, q_front[qid])  # leftover items cascade
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-
-        blocked_sim = _guard_wait(sim, p, q_front[qid], cmd, is_retry)
-        return _tree_select(empty, blocked_sim, ok_sim), empty
+        sim = _guard_signal(sim, q_rear[qid], pred=ok)   # space for putters
+        sim = _guard_signal(sim, q_front[qid], pred=ok)  # leftover items
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, q_front[qid], cmd, is_retry, pred=empty)
+        return sim, empty
 
     def _grab_resource(sim, p, rid):
         r2 = Resources(
