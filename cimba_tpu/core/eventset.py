@@ -378,14 +378,16 @@ def wakes_empty(wk: Wakes):
     return ~jnp.any(jnp.isfinite(wk.time))
 
 
-def pop_merged(es: EventSet, wk: Wakes, prio, wake_kind):
-    """Pop the next event across the general table and the dense wakes
-    (lexicographic (time, prio DESC, seq) over the union; ``prio`` is the
-    live procs.prio array, ``wake_kind`` the dispatch kind a wake pop
-    reports — the caller's K_PROC).  Returns (es, wk, Event).  A wake pop
-    carries ``handle=NULL_HANDLE`` — wake events are unaddressable, so
-    the wait_event machinery (which only ever holds general-table
-    handles) never matches them."""
+def peek_merged(es: EventSet, wk: Wakes, prio, wake_kind):
+    """Next event across the general table and the dense wakes WITHOUT
+    consuming it (lexicographic (time, prio DESC, seq) over the union;
+    ``prio`` is the live procs.prio array, ``wake_kind`` the dispatch
+    kind a wake pop reports — the caller's K_PROC).  Returns
+    (Event, take_e, take_w): the one-hot consume masks for the two
+    tables, for :func:`consume_merged`.  A wake pop carries
+    ``handle=NULL_HANDLE`` — wake events are unaddressable, so the
+    wait_event machinery (which only ever holds general-table handles)
+    never matches them."""
     m_e, found_e, t_e, p_e, s_e = _lexmin(es.time, es.prio, es.seq)
     m_w, found_w, t_w, p_w, s_w = _lexmin(wk.time, prio, wk.seq)
 
@@ -417,12 +419,26 @@ def pop_merged(es: EventSet, wk: Wakes, prio, wake_kind):
             NULL_HANDLE,
         ).astype(_I),
     )
-    take_e = m_e & ~wake_first
+    return event, m_e & ~wake_first, m_w & wake_first
+
+
+def consume_merged(es: EventSet, wk: Wakes, take_e, take_w, pred=True):
+    """Remove the peeked event (``pred`` gates the removal — the kernel
+    driver defers boundary-block dispatches by peeking without
+    consuming)."""
+    if pred is not True:
+        take_e = take_e & pred
+        take_w = take_w & pred
     es2 = es._replace(
         time=jnp.where(take_e, _T(NEVER), es.time),
         gen=es.gen + take_e.astype(_I),
     )
-    wk2 = wk._replace(
-        time=jnp.where(m_w & wake_first, _T(NEVER), wk.time)
-    )
+    wk2 = wk._replace(time=jnp.where(take_w, _T(NEVER), wk.time))
+    return es2, wk2
+
+
+def pop_merged(es: EventSet, wk: Wakes, prio, wake_kind):
+    """peek_merged + consume_merged in one step; returns (es, wk, Event)."""
+    event, take_e, take_w = peek_merged(es, wk, prio, wake_kind)
+    es2, wk2 = consume_merged(es, wk, take_e, take_w)
     return es2, wk2, event

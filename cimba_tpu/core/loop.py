@@ -73,6 +73,7 @@ ERR_GUARD_OVERFLOW = 2
 ERR_CHAIN_RUNAWAY = 3
 ERR_USER = 4
 ERR_BAD_RELEASE = 5
+ERR_BOUNDARY = 6   # boundary block entered mid-chain inside the kernel
 
 
 class Queues(NamedTuple):
@@ -128,6 +129,9 @@ class Sim(NamedTuple):
     done: jnp.ndarray      # bool, set by model code (api.stop)
     err: jnp.ndarray       # i32, ERR_* (0 = healthy)
     n_events: jnp.ndarray  # i64, dispatched events (bench metric)
+    #: kernel path only: this lane's next dispatch targets a boundary
+    #: block — the chunk freezes it for the host driver (pallas_run)
+    boundary_pending: jnp.ndarray
 
 
 def _tree_select(pred, a, b):
@@ -248,6 +252,7 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
             jnp.zeros((), _I),
         ),
         n_events=jnp.zeros((), config.COUNT),
+        boundary_pending=jnp.asarray(False),
     )
 
 
@@ -510,16 +515,24 @@ def _scan_evt_waiters(sim: Sim, decide) -> Sim:
     return _kfori(0, sim.procs.await_evt.shape[0], body, sim)
 
 
-def _dispatch_evt_wakes(sim: Sim, handle, found) -> Sim:
+def _dispatch_evt_wakes(sim: Sim, handle, found, pred=True) -> Sim:
     """Wake processes waiting on the just-popped event with SUCCESS —
     before its action runs, like the reference (`src/cmb_event.c:312-314`)
     — and, as the lazy arm of the cancel protocol, any waiter whose awaited
-    handle has died (pattern-cancelled timers etc.) with CANCELLED."""
+    handle has died (pattern-cancelled timers etc.) with CANCELLED.
+
+    ``pred`` suppresses the WHOLE scan (both arms) for a step that defers
+    a boundary dispatch: even the stale arm must wait, because its wake
+    would be armed at the un-advanced clock and dispatch AHEAD of the
+    deferred event — the host-side XLA step re-runs the scan in order."""
 
     def decide(sim, h):
         fired = found & (h == handle)
         stale = ~fired & ~ev._valid(sim.events, h)
-        return fired | stale, jnp.where(fired, pr.SUCCESS, pr.CANCELLED).astype(_I)
+        wake = fired | stale
+        if pred is not True:
+            wake = wake & pred
+        return wake, jnp.where(fired, pr.SUCCESS, pr.CANCELLED).astype(_I)
 
     return _scan_evt_waiters(sim, decide)
 
@@ -1383,10 +1396,24 @@ def make_step(spec: ModelSpec):
             _cache["apply"] = _make_apply(spec, _used_tags_for(spec, sim))
         return _cache["apply"](sim, p, cmd, is_retry)
 
+    def _boundary_stub(sim, p, sig):
+        # placeholder for a boundary block in the KERNEL trace: the block
+        # is unreachable there (dispatch defers it to the chunk driver;
+        # mid-chain entry is flagged ERR_BOUNDARY before the switch), so
+        # its body — the whole point of the marker — stays out of the
+        # kernel jaxpr
+        return sim, pr.exit_()
+
     def run_block(sim: Sim, p, sig):
+        table = blocks
+        if config.KERNEL_MODE and spec.boundary_pcs:
+            table = [
+                _boundary_stub if pc in spec.boundary_pcs else b
+                for pc, b in enumerate(blocks)
+            ]
         return _vswitch(
             jnp.clip(dyn.dget(sim.procs.pc, p), 0, len(blocks) - 1),
-            blocks,
+            table,
             sim,
             p,
             sig,
@@ -1449,6 +1476,13 @@ def make_step(spec: ModelSpec):
         def body(carry):
             sim, sig, _, n, use_pend = carry
             if config.KERNEL_MODE:
+                if spec.boundary_pcs:
+                    # boundary blocks may only be entered by dispatch
+                    # (which the kernel defers to the chunk driver) —
+                    # reaching one mid-chain would run its stub, so it
+                    # fails the lane loudly instead
+                    in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
+                    sim = _set_err(sim, in_b & ~use_pend, ERR_BOUNDARY)
                 # both arms run under vmap regardless; the explicit
                 # bwhere-fold keeps bool leaves off Mosaic's unsupported
                 # i1 select_n path
@@ -1512,16 +1546,50 @@ def make_step(spec: ModelSpec):
     ]
     dispatch_fns = [on_proc, on_proc] + user_handlers  # K_PROC, K_TIMER
 
+    boundary_table = (
+        _ConstTable(
+            [
+                1 if pc in spec.boundary_pcs else 0
+                for pc in range(len(spec.blocks))
+            ],
+            _I,
+        )
+        if spec.boundary_pcs
+        else None
+    )
+
     def step(sim: Sim) -> Sim:
-        es2, wk2, event = ev.pop_merged(
+        event, take_e, take_w = ev.peek_merged(
             sim.events, sim.wakes, sim.procs.prio, K_PROC
+        )
+        if config.KERNEL_MODE and spec.boundary_pcs:
+            # a resume whose target block is a boundary block is NOT
+            # dispatched here: the event stays in its table, the lane
+            # raises boundary_pending, and the chunk driver applies one
+            # plain-XLA engine step to it between chunks (MXU physics —
+            # parity with the reference's in-coroutine CUDA launches)
+            pc_t = dyn.dget(
+                sim.procs.pc, jnp.maximum(event.subj, 0)
+            )
+            is_b = boundary_table[pc_t] != 0
+            boundary = event.found & (event.kind <= K_TIMER) & is_b
+            proceed = event.found & ~boundary
+            not_deferred = ~boundary
+            sim = sim._replace(boundary_pending=boundary)
+        else:
+            proceed = event.found
+            not_deferred = True
+        out_of_events = ~event.found  # BEFORE the boundary defer masks it
+        event = event._replace(found=proceed)
+        es2, wk2 = ev.consume_merged(
+            sim.events, sim.wakes, take_e, take_w, proceed
         )
         sim = sim._replace(
             events=es2,
             wakes=wk2,
-            clock=jnp.where(event.found, event.time, sim.clock),
+            clock=jnp.where(proceed, event.time, sim.clock),
             n_events=sim.n_events
-            + jnp.where(event.found, 1, 0).astype(config.COUNT),
+            + jnp.where(proceed, 1, 0).astype(config.COUNT),
         )
         if _may_wait_events(spec, sim):
             # wake event-waiters before the action runs (reference order,
@@ -1530,17 +1598,19 @@ def make_step(spec: ModelSpec):
             # schedule wakes even on an empty pop, so "out of events" is
             # judged AFTER the scan (else a cancel that drains the set
             # would strand its waiter forever).
-            sim = _dispatch_evt_wakes(sim, event.handle, event.found)
+            sim = _dispatch_evt_wakes(
+                sim, event.handle, event.found, not_deferred
+            )
             sim = sim._replace(
                 done=sim.done
                 | (
-                    ~event.found
+                    out_of_events
                     & ev.is_empty(sim.events)
                     & ev.wakes_empty(sim.wakes)
                 )
             )
         else:
-            sim = sim._replace(done=sim.done | ~event.found)
+            sim = sim._replace(done=sim.done | out_of_events)
         dispatched = _vswitch(
             jnp.clip(event.kind, 0, len(dispatch_fns) - 1),
             dispatch_fns,
@@ -1572,6 +1642,11 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
         else:
             out_of_work = empty
         live = ~sim.done & (sim.err == 0) & ~out_of_work
+        if config.KERNEL_MODE and spec.boundary_pcs:
+            # a lane whose next dispatch is a boundary block freezes in
+            # the chunk; the chunk driver steps it host-side (the XLA
+            # path traces with KERNEL_MODE off and never sees this)
+            live = live & ~sim.boundary_pending
         if t_end is not None:
             nxt = jnp.minimum(
                 jnp.min(sim.events.time), jnp.min(sim.wakes.time)

@@ -131,6 +131,9 @@ class ModelSpec:
     max_chain: int
     user_init: Optional[Callable[..., Any]]
     user_handlers: List[Callable]
+    #: pcs of blocks dispatched OUTSIDE the Pallas kernel at chunk
+    #: boundaries (see Model.boundary_block); empty for most models
+    boundary_pcs: tuple = ()
 
     @property
     def n_procs(self) -> int:
@@ -167,6 +170,7 @@ class Model:
         self._n_guards = 0
         self._user_init: Optional[Callable] = None
         self._user_handlers: List[Callable] = []
+        self._boundary_pcs: List[int] = []
 
     # --- structure -----------------------------------------------------
 
@@ -174,6 +178,27 @@ class Model:
         """Register a block; sets ``fn.pc`` to its global index."""
         fn.pc = len(self._blocks)
         self._blocks.append(fn)
+        return fn
+
+    def boundary_block(self, fn: Callable) -> Callable:
+        """Register a block whose dispatch runs OUTSIDE the Pallas kernel,
+        at a chunk boundary, as plain XLA (the physics-hook analog of the
+        reference launching CUDA from a coroutine, `tutorial/tut_5_3.c`).
+
+        Use for bulk work over whole component arrays — batched matmuls,
+        big reductions — that would otherwise execute masked on EVERY
+        kernel event: the kernel freezes a lane whose next dispatch
+        targets this block, and the chunk driver applies one ordinary
+        XLA engine step (MXU and all) to the frozen lanes between
+        chunks.  Semantics are identical to a normal block — same event
+        order, same statistics — and the XLA path ignores the marker.
+
+        Constraint: a boundary block must be entered by RESUMES (process
+        entry, hold/wake continuations), not mid-chain via cmd.jump or a
+        completed command's next_pc — the kernel flags such an entry as
+        a failed replication (ERR_BOUNDARY)."""
+        fn = self.block(fn)
+        self._boundary_pcs.append(fn.pc)
         return fn
 
     def process(self, name: str, entry, *, prio: int = 0, count: int = 1):
@@ -317,4 +342,5 @@ class Model:
             max_chain=self.max_chain,
             user_init=self._user_init,
             user_handlers=list(self._user_handlers),
+            boundary_pcs=tuple(self._boundary_pcs),
         )

@@ -281,19 +281,61 @@ def make_kernel_run(
                 )
                 chunk_jit = jax.jit(lambda *ls: list(sharded(*ls)))
             vcond1 = jax.vmap(cond)  # lane-first, for host-side liveness
-            _built[key] = (
-                chunk_jit,
-                jax.jit(
-                    lambda *ls: jnp.any(
-                        vcond1(
-                            jax.tree.unflatten(
-                                treedef,
-                                [jnp.moveaxis(l, -1, 0) for l in ls],
-                            )
+            alive_jit = jax.jit(
+                lambda *ls: jnp.any(
+                    vcond1(
+                        jax.tree.unflatten(
+                            treedef,
+                            [jnp.moveaxis(l, -1, 0) for l in ls],
                         )
                     )
-                ),
+                )
             )
+            if spec.boundary_pcs:
+                # host-side application of boundary-block dispatches:
+                # ONE ordinary XLA engine step (KERNEL_MODE off — MXU
+                # matmuls, gathers, everything) on exactly the frozen
+                # lanes, between chunks.  A fresh make_step instance:
+                # the kernel one's handler cache is bound to kernel-mode
+                # tracing.
+                xstep = jax.vmap(cl.make_step(spec))
+
+                def _boundary_apply(*ls):
+                    sims = jax.tree.unflatten(
+                        treedef, [jnp.moveaxis(l, -1, 0) for l in ls]
+                    )
+                    pending = sims.boundary_pending  # [L]
+                    cleared = sims._replace(
+                        boundary_pending=jnp.zeros_like(pending)
+                    )
+                    stepped = xstep(cleared)
+                    out = jax.tree.map(
+                        lambda a, b: jnp.where(
+                            pending.reshape(
+                                pending.shape + (1,) * (a.ndim - 1)
+                            ),
+                            a,
+                            b,
+                        ),
+                        stepped,
+                        cleared,
+                    )
+                    return [
+                        jnp.moveaxis(l, 0, -1)
+                        for l in jax.tree.leaves(out)
+                    ]
+
+                pending_any = jax.jit(
+                    lambda *ls: jnp.any(
+                        jax.tree.unflatten(
+                            treedef, list(ls)
+                        ).boundary_pending
+                    )
+                )
+                boundary_jit = jax.jit(_boundary_apply)
+            else:
+                pending_any = boundary_jit = None
+            _built[key] = (chunk_jit, alive_jit, pending_any, boundary_jit)
         return _built[key]
 
     def _run(sims):
@@ -315,16 +357,33 @@ def make_kernel_run(
         # the x64-off scope above.  The build (trace + lanelast + bool32 +
         # jit wrappers) is cached per leaf-shape so repeat runs — and a
         # warmup before a timed run — reuse the compiled chunk.
-        chunk_jit, alive_jit = _get_built(leaves, treedef)
-        it = 0
+        chunk_jit, alive_jit, pending_any, boundary_jit = _get_built(
+            leaves, treedef
+        )
+        # budget accounting: a boundary freeze can cut a chunk short (the
+        # frozen lane stops stepping mid-chunk), so boundary rounds get
+        # their own budget — each dispatches >= 1 event per pending lane,
+        # bounding them by the same total-event budget instead of eating
+        # the full-chunk counter 1:1
+        it = rounds = 0
+        max_rounds = max_chunks * chunk_steps
         while bool(alive_jit(*leaves)) and it < max_chunks:
             leaves = chunk_jit(*leaves)
-            it += 1
-        if it >= max_chunks and bool(alive_jit(*leaves)):
+            if boundary_jit is not None and bool(pending_any(*leaves)):
+                leaves = boundary_jit(*leaves)
+                rounds += 1
+                if rounds >= max_rounds:
+                    break
+            else:
+                it += 1
+        if bool(alive_jit(*leaves)) and (
+            it >= max_chunks or rounds >= max_rounds
+        ):
             raise RuntimeError(
-                f"make_kernel_run: lanes still live after max_chunks="
-                f"{max_chunks} x chunk_steps={chunk_steps} events — raise "
-                "one of them (a silent partial run would corrupt statistics)"
+                f"make_kernel_run: lanes still live after {it} full chunks"
+                f" (max {max_chunks} x {chunk_steps} events) and {rounds} "
+                "boundary rounds — raise chunk_steps/max_chunks (a silent "
+                "partial run would corrupt statistics)"
             )
         leaves = [jnp.moveaxis(l, -1, 0) for l in leaves]
         return jax.tree.unflatten(treedef, leaves)

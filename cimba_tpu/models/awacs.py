@@ -226,10 +226,17 @@ def build(n_targets: int, scoring: str = "nn"):
             done, cmd.exit_(), cmd.hold(leg, next_pc=tgt_leg.pc)
         )
 
-    @m.block
+    @m.boundary_block
     def sensor_dwell(sim, p, sig):
         """One radar dwell: vectorized detection over ALL targets — the
-        physics hook (CUDA kernel in the reference, jax/Pallas here)."""
+        physics hook (CUDA kernel in the reference, jax/Pallas here).
+
+        A BOUNDARY block: on the kernel path this dispatch runs host-side
+        between Pallas chunks as plain XLA, so the [N,32] NN stack rides
+        the MXU batched over lanes instead of executing masked on every
+        kernel event (it is only needed once per dwell — ~1 in 2N
+        events).  Entered only via hold resumes and process entry, as
+        the boundary contract requires."""
         pos = _current_positions(sim)
         # detection scores for every target, plus one uniform draw for the
         # whole dwell (scan noise)

@@ -38,6 +38,39 @@ from cimba_tpu import config
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run as pr
 
+# in-kernel matmul fixture: a block computing a per-lane [2,3]@[3,4]
+# against a captured weight const — keeps the lanelast dot_general rule
+# and whole-ref VMEM const routing under REAL Mosaic coverage now that
+# awacs's scorer moved to a boundary block (stubbed out of its chunk)
+def _build_matmul():
+    import numpy as np
+    import cimba_tpu.random as cr
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("aot_matmul", event_cap=4)
+    W = jnp.asarray(np.linspace(-1.0, 1.0, 12).reshape(3, 4), jnp.float32)
+
+    @m.user_state
+    def init(params):
+        return {{"h": jnp.zeros((2, 3), jnp.float32),
+                 "acc": jnp.zeros((), jnp.float32)}}
+
+    @m.block
+    def work(sim, p, sig):
+        y = sim.user["h"] @ W
+        sim, u = api.draw(sim, cr.uniform01)
+        sim = api.set_user(sim, {{
+            "h": sim.user["h"] + u.astype(jnp.float32),
+            "acc": sim.user["acc"] + jnp.sum(y),
+        }})
+        sim = api.stop(sim, sim.user["acc"] > 50.0)
+        sim, t = api.draw(sim, cr.exponential, 1.0)
+        return sim, cmd.hold(t, next_pc=work.pc)
+
+    m.process("w", entry=work)
+    return m.build(), None
+
 L = 8
 with config.profile("f32"):
     spec, args = {build}
@@ -64,6 +97,7 @@ _BUILDS = {
     "record=False)[0], (1.0 / 0.9, 1.0, 20)",
     "awacs": "__import__('cimba_tpu.models.awacs', fromlist=['m'])"
     ".build(16)[0], (1.0,)",
+    "matmul": "_build_matmul()",
 }
 
 
@@ -102,5 +136,14 @@ def test_mm1_chunk_compiles_through_mosaic():
 
 @pytest.mark.slow
 def test_awacs_chunk_compiles_through_mosaic():
-    """Covers the lanelast dot_general rule + VMEM const inputs."""
+    """Covers the flagship at scale: dense wake table, boundary-block
+    stubbing (the NN scorer is OUTSIDE this chunk), target physics."""
     _aot_compile("awacs")
+
+
+@pytest.mark.slow
+def test_matmul_chunk_compiles_through_mosaic():
+    """Covers the lanelast dot_general rule + whole-ref VMEM const
+    routing through the real Mosaic pipeline (awacs no longer keeps its
+    matmuls in-kernel)."""
+    _aot_compile("matmul")

@@ -103,10 +103,11 @@ def test_kernel_sharded_over_mesh_matches_single(f32_profile):
 
 
 def test_kernel_matches_xla_f32_awacs(f32_profile):
-    """configs[4] through the kernel: exercises the lanelast dot_general
-    rule (NN scorer matmuls against unbatched weights, models/awacs.py)
-    and VMEM const hoisting (the weights ride as whole-ref VMEM inputs,
-    core/pallas_run.py const routing)."""
+    """configs[4] through the kernel: exercises the BOUNDARY-block
+    machinery end to end — sensor_dwell dispatches are deferred by the
+    chunk, applied host-side as plain XLA steps between chunks, and the
+    result must still match the pure-XLA run bitwise (event counts,
+    clocks, statistics)."""
     from cimba_tpu.models import awacs
 
     spec, _ = awacs.build(16)  # default scoring='nn'
@@ -142,3 +143,31 @@ def test_kernel_matches_xla_f32_mmc(f32_profile):
     assert bool((xla.n_events == ker.n_events).all())
     assert bool((xla.clock == ker.clock).all())
     assert int(ker.err.sum()) == 0
+
+
+def test_lanelast_dot_general_rule(f32_profile):
+    """Direct coverage for lanelast's per-lane dot_general rule ([m,K] @
+    unbatched [K,n] under the lane-last layout) — awacs no longer
+    exercises it in-kernel since its scorer became a boundary block, but
+    the rule stays for models that keep small matmuls in the hot loop."""
+    import numpy as np
+
+    from cimba_tpu.core import lanelast
+
+    W = jnp.asarray(np.random.default_rng(0).normal(size=(3, 4)), jnp.float32)
+
+    def f(x):  # per-lane [2,3] @ [3,4]
+        return (x @ W).sum(axis=1)
+
+    L = 8
+    xs = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 3, L)), jnp.float32
+    )
+    j = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((2, 3), jnp.float32))
+    (out,) = lanelast.eval_lanelast(
+        j.jaxpr, j.consts, L, [lanelast._Val(xs, True)]
+    )
+    want = jax.vmap(f, in_axes=-1, out_axes=-1)(xs)
+    np.testing.assert_allclose(
+        np.asarray(out.x), np.asarray(want), rtol=1e-6
+    )
