@@ -949,11 +949,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         sim = _guard_wait(sim, p, q_front[qid], cmd, is_retry, pred=empty)
         return sim, empty
 
-    def _grab_resource(sim, p, rid):
+    def _grab_resource(sim, p, rid, pred=True):
         r2 = Resources(
-            holder=dyn.dset(sim.resources.holder, rid, p),
+            holder=dyn.dset(sim.resources.holder, rid, p, pred),
             acc=_record_row_if(
-                r_rec, sim.resources.acc, rid, sim.clock, 1.0
+                r_rec, sim.resources.acc, rid, sim.clock, 1.0, pred
             ),
         )
         return sim._replace(resources=r2)
@@ -964,9 +964,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         may_grab = is_retry | gd.is_empty(sim.guards, r_guard[rid])
         ok = free & may_grab
 
-        ok_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry)
-        return _tree_select(~ok, blocked_sim, ok_sim), ~ok
+        sim = _grab_resource(sim, p, rid, ok)
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry, pred=~ok)
+        return sim, ~ok
 
     def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
         """Parity: cmb_resource_preempt (`src/cmb_resource.c:275-325`) —
@@ -1183,22 +1184,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 ),
             )
         )
-        sig_sim = _guard_signal(sim, other_guard)
-        sim = _tree_select(moved > 0.0, sig_sim, sim)
-        ok_sim = _guard_signal(sim, my_guard)  # pass leftover wake along
-        ok_sim = set_pc(
-            ok_sim._replace(
-                procs=ok_sim.procs._replace(
-                    got=dyn.dset(ok_sim.procs.got, p, total)
-                )
-            ),
-            p,
-            cmd.next_pc,
+        sim = _guard_signal(sim, other_guard, pred=moved > 0.0)
+        # pass leftover wake along on completion only
+        sim = _guard_signal(sim, my_guard, pred=done)
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                got=dyn.dset(sim.procs.got, p, total, done)
+            )
         )
-        blocked_sim = _guard_wait(
-            sim, p, my_guard, cmd._replace(f=rem2, f2=total), is_retry
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(
+            sim, p, my_guard, cmd._replace(f=rem2, f2=total), is_retry,
+            pred=~done,
         )
-        return _tree_select(done, ok_sim, blocked_sim), ~done
+        return sim, ~done
 
     def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry):
         return _buffer_xfer_impl(sim, p, cmd, is_retry, getting=True)
@@ -1211,26 +1210,28 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         n_live = jnp.sum(dyn.dget(sim.pqueues.live, qid).astype(_I))
         may = is_retry | gd.is_empty(sim.guards, pq_rear[qid])
         full = (n_live >= pq_cap[qid]) | ~may
+        ok = ~full
         free_col = _argmax32(~dyn.dget(sim.pqueues.live, qid)).astype(_I)
         pq2 = PQueues(
-            items=dyn.dset2(sim.pqueues.items, qid, free_col, cmd.f),
-            prio=dyn.dset2(sim.pqueues.prio, qid, free_col, cmd.f2),
-            seq=dyn.dset2(sim.pqueues.seq, qid, free_col, 
-                dyn.dget(sim.pqueues.next_seq, qid)
+            items=dyn.dset2(sim.pqueues.items, qid, free_col, cmd.f, ok),
+            prio=dyn.dset2(sim.pqueues.prio, qid, free_col, cmd.f2, ok),
+            seq=dyn.dset2(
+                sim.pqueues.seq, qid, free_col,
+                dyn.dget(sim.pqueues.next_seq, qid), ok,
             ),
-            live=dyn.dset2(sim.pqueues.live, qid, free_col, True),
-            next_seq=dyn.dadd(sim.pqueues.next_seq, qid, 1),
+            live=dyn.dset2(sim.pqueues.live, qid, free_col, True, ok),
+            next_seq=dyn.dadd(sim.pqueues.next_seq, qid, 1, ok),
             acc=_record_row_if(
                 pq_rec, sim.pqueues.acc, qid, sim.clock,
-                (n_live + 1).astype(_R),
+                (n_live + 1).astype(_R), ok,
             ),
         )
-        ok_sim = sim._replace(pqueues=pq2)
+        sim = sim._replace(pqueues=pq2)
         # put frees no slots: only the getter side can newly proceed
-        ok_sim = _guard_signal(ok_sim, pq_front[qid])
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, pq_rear[qid], cmd, is_retry)
-        return _tree_select(full, blocked_sim, ok_sim), full
+        sim = _guard_signal(sim, pq_front[qid], pred=ok)
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, pq_rear[qid], cmd, is_retry, pred=full)
+        return sim, full
 
     def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry):
         qid = cmd.i
@@ -1247,22 +1248,25 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         col = _argmax32(m & (dyn.dget(sim.pqueues.seq, qid) == s_min)).astype(_I)
         item = dyn.dget2(sim.pqueues.items, qid, col)
+        ok = ~empty
         pq2 = sim.pqueues._replace(
-            live=dyn.dset2(sim.pqueues.live, qid, col, False),
+            live=dyn.dset2(sim.pqueues.live, qid, col, False, ok),
             acc=_record_row_if(
                 pq_rec, sim.pqueues.acc, qid, sim.clock,
-                (n_live - 1).astype(_R),
+                (n_live - 1).astype(_R), ok,
             ),
         )
-        ok_sim = sim._replace(
+        sim = sim._replace(
             pqueues=pq2,
-            procs=sim.procs._replace(got=dyn.dset(sim.procs.got, p, item)),
+            procs=sim.procs._replace(
+                got=dyn.dset(sim.procs.got, p, item, ok)
+            ),
         )
-        ok_sim = _guard_signal(ok_sim, pq_rear[qid])
-        ok_sim = _guard_signal(ok_sim, pq_front[qid])
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, pq_front[qid], cmd, is_retry)
-        return _tree_select(empty, blocked_sim, ok_sim), empty
+        sim = _guard_signal(sim, pq_rear[qid], pred=ok)
+        sim = _guard_signal(sim, pq_front[qid], pred=ok)
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, pq_front[qid], cmd, is_retry, pred=empty)
+        return sim, empty
 
     def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry):
         """First issue always blocks until a signal (parity: the reference's
@@ -1272,9 +1276,9 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         cid = cmd.i
         satisfied = _cond_satisfied(spec, sim, cid, p)
         proceed = is_retry & satisfied
-        ok_sim = set_pc(sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, c_guard[cid], cmd, is_retry)
-        return _tree_select(proceed, ok_sim, blocked_sim), ~proceed
+        sim = set_pc(sim, p, cmd.next_pc)
+        sim = _guard_wait(sim, p, c_guard[cid], cmd, is_retry, pred=~proceed)
+        return sim, ~proceed
 
     def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry):
         tgt = cmd.i
