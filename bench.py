@@ -153,6 +153,37 @@ def _line(metric, rate, vs_baseline, detail):
     )
 
 
+def _kernel_mesh():
+    """CIMBA_BENCH_MESH=1 on a multi-chip host: shard lanes over all
+    devices (per-device chunk kernels under shard_map + lockstep host
+    loop) — the single command for the v5e-8 number."""
+    if os.environ.get("CIMBA_BENCH_MESH") and jax.device_count() > 1:
+        from jax.sharding import Mesh as _Mesh
+
+        return _Mesh(jax.devices(), ("rep",))
+    return None
+
+
+def _time_kernel(spec, make_batch, warm_arg, real_arg, chunk, mesh=None):
+    """Warm-compile + time the Pallas kernel path on a vmapped-init
+    batch; returns (events, failed, wall).  Shared by every config that
+    rides the kernel so the warm-up/timing protocol cannot diverge."""
+    from cimba_tpu.core import pallas_run as _pr
+
+    krun = _pr.make_kernel_run(
+        spec, chunk_steps=chunk, interpret=not _accel(), mesh=mesh
+    )
+    fn = jax.jit(make_batch)
+    jax.block_until_ready(jax.tree.leaves(krun(fn(warm_arg))))
+    sims = fn(real_arg)
+    jax.block_until_ready(jax.tree.leaves(sims))
+    t0 = time.perf_counter()
+    out = krun(sims)
+    jax.block_until_ready(jax.tree.leaves(out))
+    wall = time.perf_counter() - t0
+    return int(out.n_events.sum()), int((out.err != 0).sum()), wall
+
+
 def bench_mm1():
     """BASELINE configs[0]: M/M/1 single-server queue.
 
@@ -227,17 +258,9 @@ def bench_mm1():
         # VMEM — the per-event kernel-dispatch + HBM cost of the XLA
         # while-loop path disappears (core/pallas_run.py)
         from cimba_tpu import config as _cfg
-        from cimba_tpu.core import pallas_run as _pr
 
         chunk = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 512))
-        # CIMBA_BENCH_MESH=1 on a multi-chip host: shard lanes over all
-        # devices (per-device chunk kernels under shard_map + lockstep
-        # host loop) — the single command for the v5e-8 number
-        mesh = None
-        if os.environ.get("CIMBA_BENCH_MESH") and jax.device_count() > 1:
-            from jax.sharding import Mesh as _Mesh
-
-            mesh = _Mesh(jax.devices(), ("rep",))
+        mesh = _kernel_mesh()
         with _cfg.profile("f32"):
             spec, _ = mm1.build(record=False)
 
@@ -246,20 +269,7 @@ def bench_mm1():
                     lambda r: cl.init_sim(spec, 2026, r, mm1.params(n))
                 )(jnp.arange(R))
 
-            krun = _pr.make_kernel_run(
-                spec, chunk_steps=chunk, interpret=not _accel(), mesh=mesh
-            )
-            jax.block_until_ready(
-                jax.tree.leaves(krun(jax.jit(batch)(1)))
-            )  # compile on a tiny workload
-            sims = jax.jit(batch)(N)
-            jax.block_until_ready(jax.tree.leaves(sims))
-            t0 = time.perf_counter()
-            out = krun(sims)
-            jax.block_until_ready(jax.tree.leaves(out))
-            wall = time.perf_counter() - t0
-            ev = int(out.n_events.sum())
-            failed = int((out.err != 0).sum())
+            ev, failed, wall = _time_kernel(spec, batch, 1, N, chunk, mesh)
         rate = ev / wall
         _line(
             "mm1_events_per_sec",
@@ -407,6 +417,47 @@ def bench_awacs():
     # the simulated horizon, the knob that scales events per lane)
     R = int(os.environ.get("CIMBA_BENCH_R", R))
     t_end = float(os.environ.get("CIMBA_BENCH_OBJECTS", t_end))
+
+    kern = os.environ.get("CIMBA_BENCH_KERNEL")
+    if kern and kern != "0":
+        # flagship through the kernel + boundary-block path: DES events
+        # step in Pallas chunks, the NN dwell scorer runs between chunks
+        # as batched MXU matmuls (models/awacs.py sensor_dwell)
+        from cimba_tpu import config as _cfg
+
+        chunk = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 512))
+        mesh = _kernel_mesh()
+        with _cfg.profile("f32"):
+            spec, _ = awacs.build(n_targets)
+
+            def batch(t):
+                return jax.vmap(
+                    lambda r: cl.init_sim(spec, 2026, r, (t,))
+                )(jnp.arange(R))
+
+            ev, failed, wall = _time_kernel(
+                spec, batch, jnp.asarray(0.5), jnp.asarray(t_end), chunk,
+                mesh,
+            )
+        _line(
+            "awacs_events_per_sec",
+            ev / wall,
+            None,
+            {
+                "path": "pallas_kernel+boundary",
+                "n_targets": n_targets,
+                "mesh_devices": mesh.devices.size if mesh else 1,
+                "chunk_steps": chunk,
+                "replications": R,
+                "t_end": t_end,
+                "total_events": ev,
+                "wall_s": wall,
+                "failed_replications": failed,
+                "reference_wall_s_300x6h": 78.0,
+            },
+        )
+        return
+
     spec, _ = awacs.build(n_targets)
 
     def init_one(rep, t):
@@ -420,6 +471,7 @@ def bench_awacs():
         ev / wall,
         None,
         {
+            "path": "xla_while",
             "n_targets": n_targets,
             "replications": R,
             "t_end": t_end,
