@@ -59,14 +59,19 @@ def build(
         }
 
     # --- stage A: make one WIP unit per job -------------------------------
-    @m.block
-    def a_arrive(sim, p, sig):
+    def _next_arrival(sim, p):
+        """(sim, command) for the arrival cycle — shared by the entry
+        block and a_sig's inlined tail so the logic has one copy."""
         made = api.local_i(sim, p, 0)
         finished = made >= sim.user["n_jobs"]
         sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
         return sim, cmd.select(
             finished, cmd.exit_(), cmd.hold(t, next_pc=a_crew.pc)
         )
+
+    @m.block
+    def a_arrive(sim, p, sig):
+        return _next_arrival(sim, p)
 
     @m.block
     def a_crew(sim, p, sig):
@@ -90,9 +95,12 @@ def build(
     def a_sig(sim, p, sig):
         # the unit is now IN the store — signal the backlog condition after
         # the state change (signal-before-change would evaluate the
-        # predicate one unit short and never fire)
+        # predicate one unit short and never fire).  The next-arrival
+        # logic is inlined rather than cmd.jump(a_arrive): same draw
+        # order (the chain ran a_arrive immediately anyway), one fewer
+        # chain iteration of the whole masked kernel body per job
         sim = api.cond_signal(sim, _spec(), cv)
-        return sim, cmd.jump(a_arrive.pc)
+        return _next_arrival(sim, p)
 
     # --- stage B: consume WIP ---------------------------------------------
     @m.block
@@ -113,11 +121,9 @@ def build(
         done = sm.add(sim.user["done"], api.clock(sim))
         sim = api.set_user(sim, {**sim.user, "done": done})
         sim = api.stop(sim, done.n >= sim.user["n_jobs"].astype(_R))
-        return sim, cmd.pool_release(crew.id, 1.0, next_pc=b_loop.pc)
-
-    @m.block
-    def b_loop(sim, p, sig):
-        return sim, cmd.jump(b_take.pc)
+        # continue straight at b_take (no jump-tail block: each chain
+        # iteration re-executes the whole masked body in the kernel)
+        return sim, cmd.pool_release(crew.id, 1.0, next_pc=b_take.pc)
 
     # --- maintenance: condition-gated -------------------------------------
     @m.block

@@ -79,7 +79,10 @@ def build(c: int, queue_cap: int = 256):
         wait = sm.add(sim.user["wait"], t_sys)
         sim = api.set_user(sim, {**sim.user, "wait": wait})
         sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
-        return sim, cmd.jump(s_get.pc)
+        # return the next blocking command directly (not cmd.jump(s_get)):
+        # a jump tail costs one extra full chain iteration per service in
+        # the kernel, where every iteration re-executes the masked body
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
 
     m.process("arrival", entry=a_hold, prio=0)
     m.process("server", entry=s_get, prio=0, count=c)
