@@ -50,30 +50,35 @@ def run_dryrun(n_devices: int) -> None:
     # virtual devices; Mosaic-compiled on real chips): per-device chunk
     # kernels under shard_map must agree with the XLA path's event counts
     kernel_events = _dryrun_kernel_mesh(mesh, n_devices)
+    # the flagship (AWACS) through kernel + boundary blocks over the
+    # mesh: DES chunks shard per device, the MXU dwell scorer applies
+    # between chunks on the sharded batch — the full v5e-8 shape
+    awacs_events = _dryrun_awacs_mesh(mesh, n_devices)
     print(
         f"dryrun_multichip OK: {n_devices} devices, "
         f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}, "
-        f"kernel-mesh events {kernel_events}",
+        f"kernel-mesh events {kernel_events}, "
+        f"awacs-boundary-mesh events {awacs_events}",
         flush=True,
     )
 
 
-def _dryrun_kernel_mesh(mesh, n_devices: int) -> int:
-    """Sharded mega-kernel dry run: f32 profile, lanes split over the
-    mesh, bitwise-compared against the single-device kernel run."""
+def _dryrun_model_mesh(mesh, n_devices: int, build, params, label) -> int:
+    """Sharded mega-kernel dry run for one model: f32 profile, lanes
+    split over the mesh, bitwise-compared against the single-device
+    kernel run."""
     import jax
     import jax.numpy as jnp
 
     from cimba_tpu import config
     from cimba_tpu.core import loop as cl
     from cimba_tpu.core import pallas_run as pr
-    from cimba_tpu.models import mm1
 
     with config.profile("f32"):
-        spec, _ = mm1.build(record=False)
+        spec, _ = build()
 
         def one(rep):
-            return cl.init_sim(spec, 2026, rep, (1.0 / 0.9, 1.0, 20))
+            return cl.init_sim(spec, 2026, rep, params)
 
         sims = jax.jit(jax.vmap(one))(jnp.arange(2 * n_devices))
         interp = jax.default_backend() != "tpu"
@@ -83,10 +88,33 @@ def _dryrun_kernel_mesh(mesh, n_devices: int) -> int:
         sharded = pr.make_kernel_run(
             spec, chunk_steps=32, interpret=interp, mesh=mesh
         )(sims)
-        assert bool((single.n_events == sharded.n_events).all())
-        assert bool((single.clock == sharded.clock).all())
-        assert int(sharded.err.sum()) == 0, "kernel-mesh dryrun errors"
+        assert bool((single.n_events == sharded.n_events).all()), label
+        assert bool((single.clock == sharded.clock).all()), label
+        assert int(sharded.err.sum()) == 0, f"{label} dryrun errors"
         return int(sharded.n_events.sum())
+
+
+def _dryrun_kernel_mesh(mesh, n_devices: int) -> int:
+    from cimba_tpu.models import mm1
+
+    return _dryrun_model_mesh(
+        mesh, n_devices,
+        build=lambda: mm1.build(record=False),
+        params=(1.0 / 0.9, 1.0, 20),
+        label="kernel-mesh",
+    )
+
+
+def _dryrun_awacs_mesh(mesh, n_devices: int) -> int:
+    """Flagship: AWACS (boundary-block NN physics) sharded over the mesh."""
+    from cimba_tpu.models import awacs
+
+    return _dryrun_model_mesh(
+        mesh, n_devices,
+        build=lambda: awacs.build(8),
+        params=awacs.params(1.0),
+        label="awacs-mesh",
+    )
 
 
 if __name__ == "__main__":

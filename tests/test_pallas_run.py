@@ -171,3 +171,31 @@ def test_lanelast_dot_general_rule(f32_profile):
     np.testing.assert_allclose(
         np.asarray(out.x), np.asarray(want), rtol=1e-6
     )
+
+
+def test_kernel_awacs_sharded_over_mesh_matches_single(f32_profile):
+    """Flagship x mesh: the AWACS kernel run — boundary-block NN physics
+    applied between chunks — sharded over the 8-virtual-device mesh must
+    reproduce the single-device kernel run bitwise (the full multi-chip
+    shape of BASELINE configs[4])."""
+    from jax.sharding import Mesh
+
+    from cimba_tpu.models import awacs
+
+    spec, _ = awacs.build(8)
+
+    def one(rep):
+        return cl.init_sim(spec, 2026, rep, awacs.params(1.5))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    mesh = Mesh(jax.devices(), ("rep",))
+    single = pr.make_kernel_run(spec, chunk_steps=32, interpret=True)(sims)
+    many = pr.make_kernel_run(
+        spec, chunk_steps=32, interpret=True, mesh=mesh
+    )(sims)
+    assert bool((single.n_events == many.n_events).all())
+    assert bool((single.clock == many.clock).all())
+    assert int(many.err.sum()) == 0
+    mx = sm.merge_tree(single.user["detections"])
+    mk = sm.merge_tree(many.user["detections"])
+    assert float(sm.mean(mx)) == float(sm.mean(mk))
