@@ -1,0 +1,97 @@
+"""Element-weighted per-event cost of the kernel-path step — the round-3
+optimization campaign's measuring stick (BENCH_NOTES.md).
+
+For a model's per-lane step traced under KERNEL_MODE, reports
+``sum(prod(out_shape))`` over all equations — the per-lane element count
+one event touches, a direct proxy for VPU cycles (1024 elements/cycle on
+v5e) — plus the shape histogram that says WHERE the cost lives (event
+table? procs one-hots? a physics block that should be a boundary_block?).
+
+Runs offline (CPU, no tunnel):
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python tools/kernel_cost.py [mm1|mmc|mg1|jobshop|awacs] [n]
+
+Caveats: loop bodies are counted ONCE (runtime multiplies the chain body
+by ~max-over-lanes chain length, counter loops by their trip count), and
+Mosaic scheduling sits between this count and real cycles — treat it as
+a relative, structural metric.
+"""
+
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from cimba_tpu import config
+from cimba_tpu.core import dyn
+from cimba_tpu.core import loop as cl
+
+
+def build_model(name: str, n: int):
+    if name == "mm1":
+        from cimba_tpu.models import mm1
+
+        return mm1.build(record=False)[0], (1.0 / 0.9, 1.0, n)
+    if name == "mmc":
+        from cimba_tpu.models import mmc
+
+        return mmc.build(3)[0], mmc.params(n, 2.4, 1.0)
+    if name == "mg1":
+        from cimba_tpu.models import mg1
+
+        return mg1.build()[0], (1.25, 1.0, 1.5, n)
+    if name == "jobshop":
+        from cimba_tpu.models import jobshop
+
+        return jobshop.build()[0], jobshop.params(n)
+    if name == "awacs":
+        from cimba_tpu.models import awacs
+
+        return awacs.build(n)[0], awacs.params(10.0)
+    raise SystemExit(f"unknown model {name}")
+
+
+def hist(jaxpr, c: Counter):
+    for eqn in jaxpr.eqns:
+        sub = False
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                hist(v.jaxpr, c)
+                sub = True
+        if not sub:
+            for ov in eqn.outvars:
+                shp = tuple(getattr(ov.aval, "shape", ()))
+                n = 1
+                for d in shp:
+                    n *= d
+                c[shp] += n
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "mm1"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else (1000 if name == "awacs" else 200)
+    with config.profile("f32"):
+        spec, params = build_model(name, n)
+        sim = cl.init_sim(spec, 2026, 0, params)
+        config.KERNEL_MODE = True
+        try:
+            step = cl.make_step(spec)
+            with dyn.oh_cache():
+                j = jax.make_jaxpr(step)(sim)
+        finally:
+            config.KERNEL_MODE = False
+    c = Counter()
+    hist(j.jaxpr, c)
+    total = sum(c.values())
+    print(f"{name} (n={n}): {total} weighted elements/event/lane")
+    print(f"  VPU-bound ceiling ~ {962e9 / max(total, 1) / 1e6:.1f}M events/s/chip")
+    for shp, w in c.most_common(10):
+        print(f"  {shp}: {w}  ({w * 100 // total}%)")
+
+
+if __name__ == "__main__":
+    main()
