@@ -42,3 +42,12 @@ def test_tut_5_awacs_nn_hook():
     from examples import tut_5_awacs
 
     assert tut_5_awacs.main() > 0.5 * tut_5_awacs.N_TARGETS
+
+
+def test_cookbook_balking_runs_as_printed():
+    """The manual's capstone (docs/08_cookbook_balking.md) ships as a
+    runnable example; its self-assertions (balk fraction, accounting
+    identity served+balked+reneged == generated) are the test."""
+    from examples import cookbook_balking
+
+    cookbook_balking.main()
