@@ -15,7 +15,12 @@ Runs offline (CPU, no tunnel):
 Caveats: loop bodies are counted ONCE (runtime multiplies the chain body
 by ~max-over-lanes chain length, counter loops by their trip count), and
 Mosaic scheduling sits between this count and real cycles — treat it as
-a relative, structural metric.
+a relative, structural metric.  The element weighting is the right model
+only at LARGE lane counts: an op on a small per-lane array (a [4,8]
+guard table, a scalar) still costs ~1 VPU issue slot, so at small L the
+kernel is op-count-bound (the tool prints both).  Size bench lane counts
+so per-op arrays span several tiles (mm1 fits L=4096 in VMEM at ~1.5
+KB/lane; AWACS@1000 ~100 KB/lane caps L near 100).
 """
 
 import os
@@ -55,12 +60,12 @@ def build_model(name: str, n: int):
     raise SystemExit(f"unknown model {name}")
 
 
-def hist(jaxpr, c: Counter):
+def hist(jaxpr, c: Counter, ops: Counter):
     for eqn in jaxpr.eqns:
         sub = False
         for v in eqn.params.values():
             if hasattr(v, "jaxpr"):
-                hist(v.jaxpr, c)
+                hist(v.jaxpr, c, ops)
                 sub = True
         if not sub:
             for ov in eqn.outvars:
@@ -69,6 +74,7 @@ def hist(jaxpr, c: Counter):
                 for d in shp:
                     n *= d
                 c[shp] += n
+                ops[shp] += 1
 
 
 def main():
@@ -85,12 +91,21 @@ def main():
         finally:
             config.KERNEL_MODE = False
     c = Counter()
-    hist(j.jaxpr, c)
+    ops = Counter()
+    hist(j.jaxpr, c, ops)
     total = sum(c.values())
-    print(f"{name} (n={n}): {total} weighted elements/event/lane")
-    print(f"  VPU-bound ceiling ~ {962e9 / max(total, 1) / 1e6:.1f}M events/s/chip")
+    n_ops = sum(ops.values())
+    print(
+        f"{name} (n={n}): {total} weighted elements/event/lane, "
+        f"{n_ops} ops"
+    )
+    print(
+        f"  VPU element-bound ceiling ~ "
+        f"{962e9 / max(total, 1) / 1e6:.1f}M events/s/chip (large L); "
+        f"op-bound ~ {940e6 / max(n_ops, 1) / 1e6:.2f}M steps/s (L=1)"
+    )
     for shp, w in c.most_common(10):
-        print(f"  {shp}: {w}  ({w * 100 // total}%)")
+        print(f"  {shp}: {w} el / {ops[shp]} ops  ({w * 100 // total}%)")
 
 
 if __name__ == "__main__":
