@@ -550,24 +550,52 @@ def _cancel_evt_wakes(sim: Sim, handle, pred) -> Sim:
     return _scan_evt_waiters(sim, decide)
 
 
+def _exclusive_rank(mask):
+    """[P] bool -> [P] i32: for each true element, how many true elements
+    precede it (pid-ascending).  Log-doubling prefix sum built from
+    concatenate+slice (both have lanelast/Mosaic rules; lax.cumsum's
+    lowering does not)."""
+    x = mask.astype(_I)
+    n = x.shape[0]
+    inc = x
+    shift = 1
+    while shift < n:
+        inc = inc + lax.concatenate(
+            [jnp.zeros((shift,), _I), lax.slice(inc, (0,), (n - shift,))],
+            dimension=0,
+        )
+        shift *= 2
+    return inc - x
+
+
 def _wake_waiters(sim: Sim, target, sig) -> Sim:
-    """Wake every process waiting on `target` finishing (WAIT_PROC)."""
-    n_procs = sim.procs.await_pid.shape[0]
-
-    def body(i, sim):
-        waiting = (dyn.dget(sim.procs.await_pid, i) == target) & (
-            dyn.dget(sim.procs.status, i) == pr.RUNNING
-        )
-        sim = _schedule_wake(sim, waiting, i, sig)
-        return sim._replace(
-            procs=sim.procs._replace(
-                await_pid=dyn.dset(sim.procs.await_pid, i, 
-                    jnp.where(waiting, -1, dyn.dget(sim.procs.await_pid, i))
-                )
+    """Wake every process waiting on `target` finishing (WAIT_PROC) — one
+    vectorized mass-arm of the dense wake table.  (The per-pid loop this
+    replaces cost O(P^2) per event at AWACS scale: its [P]-wide body ran
+    P masked iterations inside every chain step.)  Seqs are assigned in
+    pid order among the woken, exactly as the loop did."""
+    waiting = (sim.procs.await_pid == jnp.asarray(target, _I)) & (
+        sim.procs.status == pr.RUNNING
+    )
+    # dtype pinned: under x64, jnp.sum would promote i32 -> i64
+    n_woken = jnp.sum(waiting.astype(_I), dtype=_I)
+    wk = sim.wakes
+    sig = jnp.asarray(sig, _I)
+    base = sim.events.next_seq
+    wk2 = ev.Wakes(
+        time=jnp.where(waiting, sim.clock, wk.time),
+        sig=jnp.where(waiting, sig, wk.sig),
+        seq=jnp.where(waiting, base + _exclusive_rank(waiting), wk.seq),
+    )
+    return sim._replace(
+        wakes=wk2,
+        events=sim.events._replace(next_seq=base + n_woken),
+        procs=sim.procs._replace(
+            await_pid=jnp.where(
+                waiting, jnp.asarray(-1, _I), sim.procs.await_pid
             )
-        )
-
-    return _kfori(0, n_procs, body, sim)
+        ),
+    )
 
 
 def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cimba_tpu.core import api, cmd
+from cimba_tpu.core import api, cmd, dyn
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
@@ -933,3 +933,44 @@ def test_buffer_partial_report_on_interrupt_delivery():
     assert float(out.procs.locals_f[0, 0]) == 4.0
     assert int(out.procs.locals_f[0, 1]) == pr.INTERRUPTED
     np.testing.assert_allclose(float(out.procs.locals_f[0, 2]), 3.0)
+
+
+def test_wait_process_mass_wake_preserves_pid_order():
+    """Several processes waiting on ONE target: its exit wakes all of
+    them in pid-ascending FIFO order (the vectorized mass-wake assigns
+    seqs by prefix rank — parity with the per-pid loop it replaced)."""
+    m = Model("masswake", n_ilocals=1, event_cap=8, guard_cap=4)
+
+    @m.user_state
+    def init(params):
+        return {"order": jnp.zeros((4,), jnp.int32) - 1,
+                "k": jnp.zeros((), jnp.int32)}
+
+    @m.block
+    def target(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=t_exit.pc)
+
+    @m.block
+    def t_exit(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def waiter(sim, p, sig):
+        return sim, cmd.wait_process(0, next_pc=woke.pc)
+
+    @m.block
+    def woke(sim, p, sig):
+        u = sim.user
+        sim = api.set_user(sim, {
+            "order": dyn.dset(u["order"], u["k"], p),
+            "k": u["k"] + 1,
+        })
+        return sim, cmd.exit_()
+
+    m.process("target", entry=target)
+    m.process("waiter", entry=waiter, count=3)
+    spec = m.build()
+    sim = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 1, 0))
+    assert int(sim.err) == 0
+    order = [int(x) for x in sim.user["order"]]
+    assert order == [1, 2, 3, -1], order
