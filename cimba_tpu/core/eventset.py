@@ -149,6 +149,32 @@ def _valid(es: EventSet, handle):
     )
 
 
+def _valid_vec(es: EventSet, handles):
+    """Vectorized :func:`_valid` for a [k] vector of handles (the
+    wait_event waiter scan checks every process's awaited handle per
+    step — a per-handle dget would make that scan O(k*CAP) serial).
+    One [k, CAP] one-hot serves both the liveness and generation reads;
+    out-of-range slots behave exactly as the scalar dget (all-false
+    mask -> zero picks)."""
+    slot = (jnp.maximum(handles, 0) & _SLOT_MASK)[:, None]
+    oh = slot == lax.broadcasted_iota(
+        jnp.int32, (1, es.time.shape[0]), 1
+    )
+    t_at = jnp.sum(
+        jnp.where(oh, es.time[None, :], jnp.zeros((), _T)),
+        axis=1, dtype=_T,
+    )
+    g_at = jnp.sum(
+        jnp.where(oh, es.gen[None, :], jnp.zeros((), _I)),
+        axis=1, dtype=_I,
+    )
+    return (
+        (handles >= 0)
+        & jnp.isfinite(t_at)
+        & (g_at == _gen_of(handles))
+    )
+
+
 def _handle_mask(es: EventSet, handle):
     """Shared (one-hot mask, ok) for handle-addressed ops: the slot
     one-hot is derived once and reused for the liveness/generation reads

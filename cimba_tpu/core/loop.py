@@ -494,25 +494,22 @@ def _unwait(sim: Sim, p) -> Sim:
 
 
 def _scan_evt_waiters(sim: Sim, decide) -> Sim:
-    """Shared waiter scan: for each process awaiting an event handle,
-    ``decide(sim, handle) -> (wake, sig)``; woken waiters get a scheduled
-    resume and their await cleared."""
-
-    def body(i, sim):
-        h = dyn.dget(sim.procs.await_evt, i)
-        awaiting = (h >= 0) & (dyn.dget(sim.procs.status, i) == pr.RUNNING)
-        wake, sig = decide(sim, h)
-        wake = wake & awaiting
-        sim = _schedule_wake(sim, wake, i, sig)
-        return sim._replace(
-            procs=sim.procs._replace(
-                await_evt=dyn.dset(sim.procs.await_evt, i, 
-                    jnp.where(wake, -1, h)
-                )
-            )
-        )
-
-    return _kfori(0, sim.procs.await_evt.shape[0], body, sim)
+    """Shared waiter scan, fully vectorized: ``decide(sim, h_vec[P]) ->
+    (wake_vec, sig_vec)`` elementwise over every process's awaited
+    handle; woken waiters get their dense wake slot armed (FIFO seqs in
+    pid order, like the mass-wake in _wake_waiters) and their await
+    cleared.  (The per-pid counted loop this replaces ran P masked
+    [P]-wide iterations per step for wait_event models — O(P^2).)"""
+    h = sim.procs.await_evt
+    awaiting = (h >= 0) & (sim.procs.status == pr.RUNNING)
+    wake, sig = decide(sim, h)
+    wake = wake & awaiting
+    sim = _mass_wake(sim, wake, sig)
+    return sim._replace(
+        procs=sim.procs._replace(
+            await_evt=jnp.where(wake, jnp.asarray(-1, _I), h)
+        ),
+    )
 
 
 def _dispatch_evt_wakes(sim: Sim, handle, found, pred=True) -> Sim:
@@ -528,7 +525,7 @@ def _dispatch_evt_wakes(sim: Sim, handle, found, pred=True) -> Sim:
 
     def decide(sim, h):
         fired = found & (h == handle)
-        stale = ~fired & ~ev._valid(sim.events, h)
+        stale = ~fired & ~ev._valid_vec(sim.events, h)
         wake = fired | stale
         if pred is not True:
             wake = wake & pred
@@ -568,6 +565,25 @@ def _exclusive_rank(mask):
     return inc - x
 
 
+def _mass_wake(sim: Sim, mask, sig) -> Sim:
+    """Arm the dense wake slot of every process in ``mask`` at the
+    current clock, assigning FIFO seqs in pid order — the contract both
+    waiter-wake paths (WAIT_PROC and wait_event) must share.  The count
+    dtype is pinned: under x64, jnp.sum would promote i32 -> i64."""
+    base = sim.events.next_seq
+    n_woken = jnp.sum(mask.astype(_I), dtype=_I)
+    wk = sim.wakes
+    wk2 = ev.Wakes(
+        time=jnp.where(mask, sim.clock, wk.time),
+        sig=jnp.where(mask, jnp.asarray(sig, _I), wk.sig),
+        seq=jnp.where(mask, base + _exclusive_rank(mask), wk.seq),
+    )
+    return sim._replace(
+        wakes=wk2,
+        events=sim.events._replace(next_seq=base + n_woken),
+    )
+
+
 def _wake_waiters(sim: Sim, target, sig) -> Sim:
     """Wake every process waiting on `target` finishing (WAIT_PROC) — one
     vectorized mass-arm of the dense wake table.  (The per-pid loop this
@@ -577,19 +593,8 @@ def _wake_waiters(sim: Sim, target, sig) -> Sim:
     waiting = (sim.procs.await_pid == jnp.asarray(target, _I)) & (
         sim.procs.status == pr.RUNNING
     )
-    # dtype pinned: under x64, jnp.sum would promote i32 -> i64
-    n_woken = jnp.sum(waiting.astype(_I), dtype=_I)
-    wk = sim.wakes
-    sig = jnp.asarray(sig, _I)
-    base = sim.events.next_seq
-    wk2 = ev.Wakes(
-        time=jnp.where(waiting, sim.clock, wk.time),
-        sig=jnp.where(waiting, sig, wk.sig),
-        seq=jnp.where(waiting, base + _exclusive_rank(waiting), wk.seq),
-    )
+    sim = _mass_wake(sim, waiting, sig)
     return sim._replace(
-        wakes=wk2,
-        events=sim.events._replace(next_seq=base + n_woken),
         procs=sim.procs._replace(
             await_pid=jnp.where(
                 waiting, jnp.asarray(-1, _I), sim.procs.await_pid

@@ -71,6 +71,41 @@ def _build_matmul():
     m.process("w", entry=work)
     return m.build(), None
 
+# wait_event fixture: keeps the vectorized waiter scan (ev._valid_vec's
+# [P, CAP] one-hot) and a LIVE general event table under real Mosaic
+# coverage — every shipped kernel model runs that table empty
+def _build_wev():
+    import cimba_tpu.random as cr
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("aot_wev", n_flocals=1, n_ilocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {{"fires": jnp.zeros((), jnp.int32)}}
+
+    @m.handler
+    def on_fire(sim, subj, arg):
+        return api.set_user(sim, {{"fires": sim.user["fires"] + 1}})
+
+    @m.block
+    def s_go(sim, p, sig):
+        sim, dt = api.draw(sim, cr.exponential, 1.0)
+        sim, h = api.schedule(sim, api.clock(sim) + dt, 0, on_fire)
+        return sim, cmd.wait_event(h, next_pc=s_woke.pc)
+
+    @m.block
+    def s_woke(sim, p, sig):
+        sim = api.set_local_i(sim, p, 0, sig)
+        done = api.clock(sim) > 4.0
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(0.1, next_pc=s_go.pc)
+        )
+
+    m.process("sched", entry=s_go, count=3)
+    return m.build(), None
+
 L = 8
 with config.profile("f32"):
     spec, args = {build}
@@ -98,6 +133,7 @@ _BUILDS = {
     "awacs": "__import__('cimba_tpu.models.awacs', fromlist=['m'])"
     ".build(16)[0], (1.0,)",
     "matmul": "_build_matmul()",
+    "wev": "_build_wev()",
 }
 
 
@@ -139,6 +175,13 @@ def test_awacs_chunk_compiles_through_mosaic():
     """Covers the flagship at scale: dense wake table, boundary-block
     stubbing (the NN scorer is OUTSIDE this chunk), target physics."""
     _aot_compile("awacs")
+
+
+@pytest.mark.slow
+def test_wait_event_chunk_compiles_through_mosaic():
+    """Covers the vectorized event-waiter scan + a live general event
+    table (timers/user events) through the real Mosaic pipeline."""
+    _aot_compile("wev")
 
 
 @pytest.mark.slow

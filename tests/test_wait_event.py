@@ -312,3 +312,62 @@ def test_wait_event_interrupt_during_wait():
     # the event itself still fired
     assert float(out.user["fired_t"]) == 5.0
     assert int(out.procs.await_evt[1]) == -1
+
+
+def test_wait_event_model_through_kernel():
+    """The kernel path on a wait_event model: exercises the vectorized
+    waiter scan (ev._valid_vec's [P, CAP] one-hot) and the event-waiter
+    wake machinery through lanelast/bool32 — bitwise vs the XLA f32
+    path.  Timers + wait_event also keep the GENERAL event table live in
+    the kernel (every other kernel-tested model runs it empty)."""
+    from cimba_tpu import config
+    from cimba_tpu.core import pallas_run as pl_run
+    import cimba_tpu.random as cr
+
+    with config.profile("f32"):
+        m = Model("wev_kernel", n_flocals=2, n_ilocals=2, event_cap=16)
+
+        @m.user_state
+        def init(params):
+            return {"fires": jnp.zeros((), jnp.int32)}
+
+        @m.handler
+        def on_fire(sim, subj, arg):
+            return api.set_user(sim, {"fires": sim.user["fires"] + 1})
+
+        @m.block
+        def s_go(sim, p, sig):
+            sim, dt = api.draw(sim, cr.exponential, 1.0)
+            sim, h = api.schedule(sim, api.clock(sim) + dt, 0, on_fire)
+            sim = api.set_local_i(sim, p, 1, h)
+            return sim, cmd.wait_event(h, next_pc=s_woke.pc)
+
+        @m.block
+        def s_woke(sim, p, sig):
+            sim = api.set_local_i(sim, p, 0, sig)
+            sim = api.set_local_f(sim, p, 0, api.clock(sim))
+            done = api.clock(sim) > 6.0
+            return sim, cmd.select(
+                done, cmd.exit_(), cmd.hold(0.1, next_pc=s_go.pc)
+            )
+
+        m.process("sched", entry=s_go, count=3)
+        spec = m.build()
+
+        def one(rep):
+            return cl.init_sim(spec, 17, rep)
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+        xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+        ker = pl_run.make_kernel_run(
+            spec, chunk_steps=32, interpret=True
+        )(sims)
+        assert int(ker.err.sum()) == 0
+        assert bool((xla.n_events == ker.n_events).all())
+        assert bool((xla.clock == ker.clock).all())
+        np.testing.assert_array_equal(
+            np.asarray(xla.user["fires"]), np.asarray(ker.user["fires"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(xla.procs.locals_i), np.asarray(ker.procs.locals_i)
+        )
