@@ -199,3 +199,44 @@ def test_kernel_awacs_sharded_over_mesh_matches_single(f32_profile):
     mx = sm.merge_tree(single.user["detections"])
     mk = sm.merge_tree(many.user["detections"])
     assert float(sm.mean(mx)) == float(sm.mean(mk))
+
+
+def test_boundary_block_mid_chain_entry_fails_loudly(f32_profile):
+    """A boundary block reached mid-chain (via a completed command's
+    next_pc instead of a resume) violates the boundary contract; the
+    kernel must fail that lane with ERR_BOUNDARY rather than silently
+    running the stub.  The XLA path runs the same model fine (the
+    marker is kernel-only semantics)."""
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("bad_boundary", event_cap=4)
+
+    @m.user_state
+    def init(params):
+        return {"acc": jnp.zeros((), jnp.float32)}
+
+    @m.block
+    def go(sim, p, sig):
+        # jump straight into the boundary block: mid-chain entry
+        return sim, cmd.jump(heavy.pc)
+
+    @m.boundary_block
+    def heavy(sim, p, sig):
+        sim = api.set_user(sim, {"acc": sim.user["acc"] + 1.0})
+        sim = api.stop(sim, sim.user["acc"] > 2.0)
+        return sim, cmd.hold(1.0, next_pc=go.pc)
+
+    m.process("w", entry=heavy)
+    spec = m.build()
+
+    def one(rep):
+        return cl.init_sim(spec, 3, rep)
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(4))
+    # XLA path: marker ignored, model completes
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    assert int(xla.err.sum()) == 0
+    # kernel path: every lane flags the illegal mid-chain entry
+    ker = pr.make_kernel_run(spec, chunk_steps=16, interpret=True)(sims)
+    assert bool((ker.err == cl.ERR_BOUNDARY).all()), [int(e) for e in ker.err]
