@@ -1,0 +1,30 @@
+"""cimba-tpu: a TPU-native discrete-event simulation framework.
+
+A brand-new implementation of the capabilities of the reference library
+(ambonvik/cimba — C17 + assembly coroutines + pthreads): simulated processes
+with hold/interrupt/preempt semantics, resources and queues, a full random
+distribution catalogue, streaming statistics, and an experiment runner for
+hundreds of thousands of parallel replications.
+
+Architecture (see SURVEY.md for the full design translation):
+
+* The reference fans *trials* over pthreads; here replications are the
+  leading batch axis of every state array, ``vmap``-ed across lanes and
+  ``shard_map``-ed across a TPU mesh.
+* The reference multiplexes *processes* with assembly context switches;
+  here processes are state machines (numbered blocks) stepped by a
+  jit-compiled ``lax.while_loop`` event dispatcher.
+* The reference draws randomness from thread-local sfc64; here each
+  replication owns a counter-based Threefry-2x32 stream.
+* Cross-replication statistics merge with the same associative (Pébay)
+  update the reference uses across pthreads — but via ``psum`` over ICI.
+"""
+
+from cimba_tpu import config as config  # noqa: F401  (side effect: x64 setup)
+
+__version__ = "0.1.0"
+
+# convenience re-exports (import is cheap; submodules lazy-load jax anyway)
+from cimba_tpu.core import api, cmd  # noqa: E402, F401
+from cimba_tpu.core.loop import Sim, init_sim, make_run, make_step  # noqa: E402, F401
+from cimba_tpu.core.model import Model  # noqa: E402, F401

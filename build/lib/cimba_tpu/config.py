@@ -1,0 +1,159 @@
+"""Global configuration for cimba-tpu.
+
+The reference (cimba) does platform detection and TLS-model selection in
+``src/cmi_config.h``.  The TPU-native analog is dtype discipline and JAX
+global configuration:
+
+* Simulated **time is float64**.  A clock near 1e6 with unit-scale increments
+  needs ~1e-10 relative resolution for stable event ordering; float32's
+  epsilon at 1e6 is 0.0625 which would corrupt waiting-time statistics.
+  float64 is software-emulated on TPU but only the clock/event-time arrays
+  pay that cost.
+* **Sample values, amounts and statistics accumulate in float64** as well so
+  that per-replication summaries are reproducible against the scalar oracle.
+* **Indices, handles, program counters are int32** (TPU-native width).
+* **RNG internals are uint32** (threefry2x32 counters/keys), which is the
+  natively fast integer width on TPU.
+
+Importing :mod:`cimba_tpu` enables ``jax_enable_x64``.  All framework arrays
+carry explicit dtypes, so user code that wants pure-32-bit models can still
+build them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+#: Simulated-time dtype (see module docstring).  Mutable — see
+#: :func:`use_profile`; read it through :data:`TIME` at trace time.
+TIME_DTYPE = jnp.float64
+#: Continuous sample / statistics dtype.  Mutable — see :func:`use_profile`.
+REAL_DTYPE = jnp.float64
+#: Wide event-counter dtype (``sim.n_events``).  Mutable with the profile:
+#: int64 in the exact profile, int32 in the f32 profile (Mosaic has no i64).
+COUNT_DTYPE = jnp.int64
+#: Index / handle dtype.
+INDEX_DTYPE = jnp.int32
+#: Signal codes are int32 (the reference uses int64 signals; int32 covers the
+#: protocol and all practical user signals; see core/signals.py).
+SIGNAL_DTYPE = jnp.int32
+#: RNG word dtype.
+BITS_DTYPE = jnp.uint32
+
+#: Sentinel "time" for empty event slots: +inf sorts after every real event.
+TIME_NEVER = float("inf")
+
+
+def argmax32(x, axis: int = 0):
+    """``jnp.argmax`` with an int32 result.  Under x64, jnp's arg-reductions
+    return int64 — and Mosaic's int64→int32 convert rule recurses forever,
+    so everything in a potential kernel path uses these.  Mosaic's
+    arg-reduction lowering supports only f32 operands, so bool/int masks
+    (every call site's operand is a mask, a small int, or a time) are cast;
+    ties keep lowest-index semantics either way."""
+    from jax import lax
+
+    if x.dtype != jnp.float32 and x.dtype != jnp.float64:
+        x = x.astype(jnp.float32)
+    return lax.argmax(x, axis, jnp.int32)
+
+
+def argmin32(x, axis: int = 0):
+    """``jnp.argmin`` with an int32 result (see :func:`argmax32`)."""
+    from jax import lax
+
+    if x.dtype != jnp.float32 and x.dtype != jnp.float64:
+        x = x.astype(jnp.float32)
+    return lax.argmin(x, axis, jnp.int32)
+
+
+class _DtypeHandle:
+    """A live view of a mutable config dtype.
+
+    numpy's dtype protocol resolves any object with a ``.dtype`` attribute,
+    so a handle can stand wherever a dtype literal can: ``jnp.asarray(x, _R)``,
+    ``x.astype(_R)``, ``jnp.zeros((), _R)``.  Calling it casts a scalar,
+    mirroring ``jnp.float64(x)``.  Modules alias these once
+    (``_R = config.REAL``) and automatically follow :func:`use_profile`
+    switches at trace time — which is how the same interpreter traces in
+    float64 for the exact XLA path and in float32 inside the Pallas
+    mega-kernel (Mosaic/TPU has no 64-bit types).
+    """
+
+    def __init__(self, name: str):
+        self._name = name
+
+    @property
+    def dtype(self):
+        return jnp.dtype(globals()[self._name])
+
+    def __call__(self, x):
+        return jnp.asarray(x, globals()[self._name])
+
+    def __repr__(self):
+        return f"config.{self._name}(={self.dtype.name})"
+
+
+TIME = _DtypeHandle("TIME_DTYPE")
+REAL = _DtypeHandle("REAL_DTYPE")
+COUNT = _DtypeHandle("COUNT_DTYPE")
+
+_PROFILES = {
+    # exact profile: matches the scalar oracle bit-for-bit; default.
+    "f64": dict(TIME_DTYPE=jnp.float64, REAL_DTYPE=jnp.float64,
+                COUNT_DTYPE=jnp.int64),
+    # TPU-kernel profile: every array Mosaic-representable (no 64-bit
+    # types).  Clock resolution is f32 (documented envelope: fine for runs
+    # with t_end * eps32 well below the smallest meaningful interval);
+    # statistics accumulate in f32.
+    "f32": dict(TIME_DTYPE=jnp.float32, REAL_DTYPE=jnp.float32,
+                COUNT_DTYPE=jnp.int32),
+}
+
+_ACTIVE_PROFILE = "f64"
+
+#: True while tracing inside the Pallas mega-kernel (set by
+#: core.pallas_run).  Data-dependent while-loops in the interpreter become
+#: masked bounded fori-loops under this flag: Mosaic cannot lower a
+#: batched (vector) loop condition.
+KERNEL_MODE = False
+
+
+def active_profile() -> str:
+    return _ACTIVE_PROFILE
+
+
+def use_profile(name: str) -> None:
+    """Switch the trace-time dtype profile ("f64" exact / "f32" kernel).
+
+    Affects subsequent *tracing* only; arrays already built keep their
+    dtypes.  Model builds and runs under different profiles coexist in one
+    process (specs carry no dtypes; all arrays are created at trace time).
+    """
+    global _ACTIVE_PROFILE
+    if name not in _PROFILES:
+        raise ValueError(f"unknown profile {name!r}; one of {sorted(_PROFILES)}")
+    globals().update(_PROFILES[name])
+    _ACTIVE_PROFILE = name
+
+
+@contextlib.contextmanager
+def profile(name: str):
+    """Scoped :func:`use_profile` (restores the previous profile on exit)."""
+    prev = _ACTIVE_PROFILE
+    use_profile(name)
+    try:
+        yield
+    finally:
+        use_profile(prev)
+
+
+def setup() -> None:
+    """Enable the JAX global flags cimba-tpu requires (idempotent)."""
+    jax.config.update("jax_enable_x64", True)
+
+
+setup()

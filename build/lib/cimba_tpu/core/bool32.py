@@ -1,0 +1,338 @@
+"""bool32: a jaxpr transform that eliminates i1 (bool) vector values.
+
+Why: the Mosaic TPU compiler's layout pass check-fails (`layout.h:320
+Check failed: arr.size() >= layout_rank(implicit_dim)`) on elementwise
+logic chains over i1 vectors whose operand layouts disagree — e.g. a mask
+loaded from VMEM meeting a comparison-born mask, or an `or` of two `and`
+results (measured in round 2 via tools/mosaic_eqn_bisect.py).  Comparisons
+feeding selects are the one i1 pattern Mosaic handles everywhere.
+
+What: re-interpret a jaxpr with every bool value carried as int32 (0/1):
+
+* comparisons (`eq/ne/lt/...`, `is_finite`) bind natively and stay i1
+  until a consumer needs the carrier (lazy pair, see eval_bool32 —
+  select preds consume the i1 directly, saving a widen+re-compare round
+  trip per comparison);
+* `and/or/xor/not` on bools become bitwise ops on the i32 carriers;
+* `select_n` with a bool pred re-derives the pred as ``carrier != 0``
+  (comparison-born, full shape) and selects over carriers;
+* `broadcast_in_dim/reshape/transpose/...`-style structural ops act on the
+  i32 carrier, so no i1 broadcasts exist at all;
+* `reduce_or/reduce_and` become max/min reductions over carriers;
+* `convert_element_type` to/from bool routes through carriers;
+* control-flow prims (`while/cond/scan/pjit`) recurse into their
+  sub-jaxprs with the same convention — except `while`'s cond output and
+  `cond`'s scalar predicate index, which jax requires as real bool/i32
+  scalars (scalars live in SREGs, not vector mask registers: safe);
+* everything else binds unchanged (a bool-typed operand to an unknown
+  primitive falls back to materializing the i1 with ``!= 0``).
+
+The function boundary also changes: bool inputs/outputs of the
+transformed jaxpr become i32.  Callers own the cast (cheap, outside the
+kernel).
+
+Used by core/pallas_run.py to make the mega-kernel chunk Mosaic-clean; it
+is generic over any jaxpr built from the primitives the engine uses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax._src import core as jcore
+
+_I32 = jnp.int32
+
+_LOGIC = {"and": lax.bitwise_and, "or": lax.bitwise_or, "xor": lax.bitwise_xor}
+_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge", "is_finite"}
+_STRUCTURAL = {
+    "broadcast_in_dim", "reshape", "transpose", "slice", "squeeze",
+    "concatenate", "rev", "expand_dims",
+}
+
+
+def _is_bool(aval):
+    return getattr(aval, "dtype", None) == jnp.bool_
+
+
+def _widen(pred, dtype=_I32):
+    """i1 -> 0/1 of ``dtype`` WITHOUT convert_element_type: a plain
+    i1->i32 convert on a rank-1 vector is itself a layout-pass crash
+    (measured, culprit #2 of the bisect); a select over constant operands
+    is the pattern Mosaic lowers everywhere."""
+    return lax.select_n(
+        pred,
+        jnp.zeros(jnp.shape(pred), dtype),
+        jnp.ones(jnp.shape(pred), dtype),
+    )
+
+
+def _carrier_aval(aval):
+    if _is_bool(aval):
+        return jcore.ShapedArray(aval.shape, _I32, weak_type=False)
+    return aval
+
+
+def _to_carrier(x):
+    """Concrete bool const -> i32 carrier, converted HOST-SIDE (numpy) so
+    no bool->i32 convert eqn is traced into the kernel."""
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x, np.int32))
+
+
+def _read(env, v):
+    if isinstance(v, jcore.Literal):
+        val = v.val
+        if _is_bool(v.aval):
+            return _to_carrier(val)
+        return val
+    return env[v]
+
+
+def _sub_jaxpr_fn(closed):
+    """Python callable evaluating a ClosedJaxpr under the bool32
+    convention; its signature takes/returns carriers."""
+
+    def fn(*args):
+        return eval_bool32(closed.jaxpr, closed.consts, *args)
+
+    return fn
+
+
+def eval_bool32(jaxpr, consts, *args):
+    """Evaluate ``jaxpr`` with bool values carried as i32.
+
+    ``args`` must already be carriers (i32 where the jaxpr's invars are
+    bool).  Consts with bool dtype are converted on read.  Returns carrier
+    outputs (i32 where outvars are bool).
+
+    Internally an ex-bool value is a lazy PAIR (i1, carrier): comparisons
+    store only the i1 (select preds use it directly — the one i1 pattern
+    Mosaic handles), and the carrier is materialized at most once, on
+    first use by a logic/structural/memory consumer.  This avoids the
+    widen+re-compare round trip per comparison (~28% of all kernel eqns
+    before this)."""
+
+    class _B:
+        __slots__ = ("i1", "c32")
+
+        def __init__(self, i1=None, c32=None):
+            self.i1 = i1
+            self.c32 = c32
+
+        def carrier(self):
+            if self.c32 is None:
+                self.c32 = _widen(self.i1)
+            return self.c32
+
+        def pred(self):
+            if self.i1 is None:
+                self.i1 = self.c32 != 0
+            return self.i1
+
+    def boxed(x):
+        return x if isinstance(x, _B) else _B(c32=x)
+
+    env = {}
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = _B(c32=_to_carrier(c)) if _is_bool(v.aval) else c
+    for v, a in zip(jaxpr.invars, args):
+        env[v] = _B(c32=a) if _is_bool(v.aval) else a
+
+    def read(v):
+        x = _read(env, v)
+        if _is_bool(v.aval):
+            return boxed(x)
+        return x
+
+    def write(eqn, outs):
+        for v, o in zip(eqn.outvars, outs):
+            if type(v).__name__ != "DropVar":
+                env[v] = o
+
+    def carriers(eqn, ins):
+        return [
+            i.carrier() if isinstance(i, _B) else i for i in ins
+        ]
+
+    for eqn in jaxpr.eqns:
+        prim = str(eqn.primitive)
+        ins = [read(v) for v in eqn.invars]
+        in_bool = [_is_bool(v.aval) for v in eqn.invars]
+        out_bool = [_is_bool(v.aval) for v in eqn.outvars]
+
+        if prim in _LOGIC and any(in_bool):
+            a, b = carriers(eqn, ins)
+            write(eqn, [_B(c32=_LOGIC[prim](a, b))])
+        elif prim == "not" and in_bool[0]:
+            write(
+                eqn,
+                [_B(c32=lax.bitwise_xor(ins[0].carrier(), jnp.int32(1)))],
+            )
+        elif prim in _COMPARISONS:
+            outs = eqn.primitive.bind(*carriers(eqn, ins), **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(eqn, [_B(i1=o) for o in outs])
+        elif prim == "select_n" and in_bool[0]:
+            pred = ins[0].pred()
+            cases = carriers(eqn, ins[1:])
+            out = lax.select_n(pred, *cases)
+            write(eqn, [_B(c32=out) if out_bool[0] else out])
+        elif prim == "convert_element_type":
+            new_dtype = eqn.params["new_dtype"]
+            if in_bool[0] and new_dtype == jnp.bool_:
+                write(eqn, [ins[0]])  # stays lazy
+            elif in_bool[0]:
+                # the carrier is exactly 0/1 — a plain numeric convert
+                write(eqn, [ins[0].carrier().astype(new_dtype)])
+            elif new_dtype == jnp.bool_:
+                write(eqn, [_B(i1=ins[0] != 0)])
+            else:
+                write(eqn, [eqn.primitive.bind(*ins, **eqn.params)])
+        elif prim in ("reduce_or", "reduce_and") and in_bool[0]:
+            red = lax.reduce_max if prim == "reduce_or" else lax.reduce_min
+            write(
+                eqn,
+                [_B(c32=red(ins[0].carrier(), axes=eqn.params["axes"]))],
+            )
+        elif prim == "while":
+            write(eqn, _bind_while(eqn, carriers(eqn, ins), out_bool))
+        elif prim == "cond":
+            write(eqn, _bind_cond(eqn, carriers(eqn, ins), out_bool))
+        elif prim == "scan":
+            write(eqn, _bind_scan(eqn, carriers(eqn, ins), out_bool))
+        elif prim in ("pjit", "jit"):
+            # inline the body (in-kernel there is nothing for pjit to do)
+            closed = eqn.params["jaxpr"]
+            outs = eval_bool32(
+                closed.jaxpr, closed.consts, *carriers(eqn, ins)
+            )
+            write(
+                eqn,
+                [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
+            )
+        elif prim in _STRUCTURAL and in_bool[0]:
+            # structural ops act on the i32 carrier directly — binding on
+            # a materialized i1 would re-emit the i1 broadcasts this
+            # transform exists to eliminate
+            outs = eqn.primitive.bind(*carriers(eqn, ins), **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(
+                eqn,
+                [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
+            )
+        elif any(in_bool) or any(out_bool):
+            # unknown primitive touching bools: scalar bools are safe
+            # (SREGs, not vector mask registers) — materialize and bind.
+            # NON-scalar bools here would silently reintroduce the i1
+            # vectors this transform exists to eliminate, surfacing hours
+            # later as a Mosaic layout-pass SIGABRT far from the cause:
+            # fail fast with the primitive and shapes instead.
+            nonscalar = [
+                f"{('in' if k < len(eqn.invars) else 'out')}:{v.aval}"
+                for k, (v, b) in enumerate(
+                    list(zip(eqn.invars, in_bool))
+                    + list(zip(eqn.outvars, out_bool))
+                )
+                if b and tuple(v.aval.shape)
+            ]
+            if nonscalar:
+                raise NotImplementedError(
+                    f"bool32: no rule for primitive '{prim}' touching "
+                    f"non-scalar bool values ({', '.join(nonscalar)}); "
+                    "binding it raw would materialize i1 vectors that "
+                    "crash the Mosaic layout pass — add a rule here"
+                )
+            mats = [
+                i.pred() if isinstance(i, _B) else i for i in ins
+            ]
+            outs = eqn.primitive.bind(*mats, **eqn.params)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            write(
+                eqn,
+                [
+                    _B(i1=o) if b else o
+                    for o, b in zip(outs, out_bool)
+                ],
+            )
+        else:
+            outs = eqn.primitive.bind(*ins, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+            write(eqn, list(outs))
+
+    return [
+        (boxed(_read(env, v)).carrier() if _is_bool(v.aval)
+         else _read(env, v))
+        for v in jaxpr.outvars
+    ]
+
+
+def _bind_while(eqn, ins, out_bool=None):
+    cond_j = eqn.params["cond_jaxpr"]
+    body_j = eqn.params["body_jaxpr"]
+    cn = eqn.params["cond_nconsts"]
+    bn = eqn.params["body_nconsts"]
+    cond_consts = ins[:cn]
+    body_consts = ins[cn : cn + bn]
+    carry = ins[cn + bn :]
+
+    def cond_fn(c):
+        (out,) = eval_bool32(
+            cond_j.jaxpr, cond_j.consts, *cond_consts, *c
+        )
+        # while_loop requires a scalar bool condition
+        return out != 0 if out.dtype != jnp.bool_ else out
+
+    def body_fn(c):
+        return tuple(
+            eval_bool32(body_j.jaxpr, body_j.consts, *body_consts, *c)
+        )
+
+    return list(lax.while_loop(cond_fn, body_fn, tuple(carry)))
+
+
+def _bind_cond(eqn, ins, out_bool=None):
+    branches = eqn.params["branches"]
+    idx = ins[0]
+    if idx.dtype == jnp.bool_:  # shouldn't happen: carriers are i32
+        idx = idx.astype(_I32)
+    ops = ins[1:]
+    fns = [_sub_jaxpr_fn(b) for b in branches]
+    return list(lax.switch(idx, fns, *ops))
+
+
+def _bind_scan(eqn, ins, out_bool=None):
+    p = eqn.params
+    j = p["jaxpr"]
+    nc, ncarry = p["num_consts"], p["num_carry"]
+    consts = ins[:nc]
+    init = ins[nc : nc + ncarry]
+    xs = ins[nc + ncarry :]
+
+    def body(carry, x):
+        outs = eval_bool32(j.jaxpr, j.consts, *consts, *carry, *x)
+        return tuple(outs[:ncarry]), tuple(outs[ncarry:])
+
+    carry, ys = lax.scan(
+        body, tuple(init), tuple(xs), length=p["length"],
+        reverse=p["reverse"], unroll=p.get("unroll", 1),
+    )
+    return list(carry) + list(ys)
+
+
+def transform(closed_jaxpr, example_carriers):
+    """ClosedJaxpr -> ClosedJaxpr with the bool32 convention applied.
+
+    ``example_carriers``: carrier-typed abstract values (or arrays) for the
+    jaxpr's invars — bool invars as i32.
+    """
+
+    def fn(*args):
+        return eval_bool32(
+            closed_jaxpr.jaxpr, closed_jaxpr.consts, *args
+        )
+
+    return jax.make_jaxpr(fn)(*example_carriers)

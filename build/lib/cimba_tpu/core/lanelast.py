@@ -1,0 +1,406 @@
+"""lanelast: batch a per-lane jaxpr with the lane axis ALWAYS last.
+
+Why not ``jax.vmap(step, in_axes=-1)``: Mosaic tiles the last two dims of
+every array, so the one layout where per-lane scalars ([L], lanes minor),
+component arrays ([k, L]) and masks all interact without relayout is
+lane-LAST — every broadcast adds *leading* dims (free) and every per-lane
+reduction contracts *leading* dims (supported).  vmap cannot produce that
+program: its reshape/broadcast batching rules normalize batch dims to
+axis 0 and wrap the ops in minor-axis moveaxis pairs, several of which
+the Mosaic layout pass rejects ("unsupported shape cast") or check-fails
+on (layout.h:320) — all bisected in round 2 (tools/mosaic_eqn_bisect.py).
+
+This module re-implements the batching as a jaxpr interpreter with a
+fixed discipline:
+
+* a BATCHED value of per-lane shape ``s`` is carried as ``s + (L,)``;
+* an UNBATCHED rank>=1 value is carried "lane-ready" as ``s + (1,)`` —
+  constructed that way at its origin (iota, broadcast, const) so no
+  traced reshape ever moves the minor dim; mixing it with batched
+  operands is then a size-1-minor lane broadcast, which Mosaic supports;
+* unbatched scalars stay scalars (splats are free);
+* elementwise ops broadcast every operand to ``out_shape + (L|1,)``;
+* reductions/arg-reductions keep their axes (per-lane dims coincide with
+  leading dims) and never touch the lane axis;
+* ``broadcast_in_dim``/``reshape``/``slice``/``squeeze`` keep the lane
+  axis last and untouched;
+* ``while`` recurses with a batchedness fixpoint over the carry;
+  ``pjit`` bodies are inlined.
+
+The result is a batched jaxpr whose every op keeps lanes minor — the
+program vmap should have written.  Used by core/pallas_run.py; bool32
+runs after it to eliminate i1 vectors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax._src import core as jcore
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow",
+    "and", "or", "xor", "not", "neg", "abs", "sign", "integer_pow",
+    "log", "log1p", "exp", "expm1", "sqrt", "rsqrt", "floor", "ceil",
+    "round", "logistic", "tanh", "sin", "cos", "atan2", "atan", "asin",
+    "acos", "erf", "erfc", "erf_inv", "square",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "lt", "le", "gt", "ge", "is_finite",
+    "select_n", "convert_element_type", "clamp", "nextafter",
+}
+_REDUCTIONS = {
+    "reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+    "reduce_or", "reduce_and", "argmax", "argmin",
+}
+
+
+class _Val:
+    __slots__ = ("x", "batched")
+
+    def __init__(self, x, batched):
+        self.x = x
+        self.batched = batched
+
+
+def _lane_ready(c):
+    """Concrete unbatched const -> lane-ready form, converted HOST-side."""
+    arr = np.asarray(c)
+    if arr.ndim == 0:
+        return jnp.asarray(arr)
+    return jnp.asarray(arr.reshape(arr.shape + (1,)))
+
+
+def _read(env, v):
+    if isinstance(v, jcore.Literal):
+        return _Val(_lane_ready(v.val), False)
+    return env[v]
+
+
+def _align(val, out_shape, L):
+    """Broadcast a _Val to ``out_shape + (L,)``.  Scalars splat; batched
+    values broadcast leading dims; unbatched lane-ready values ([..., 1])
+    add a size-1-minor lane broadcast — all Mosaic-supported directions."""
+    return jnp.broadcast_to(val.x, out_shape + (L,))
+
+
+def _align_unbatched(val, out_shape):
+    return jnp.broadcast_to(val.x, out_shape + (1,))
+
+
+def eval_lanelast(jaxpr, consts, L, in_vals):
+    """Evaluate ``jaxpr`` under the lane-last batching discipline.
+
+    ``in_vals``: list of _Val for the jaxpr invars.  Returns list of _Val.
+    """
+    env = {}
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = _Val(_lane_ready(c), False)
+    for v, val in zip(jaxpr.invars, in_vals):
+        env[v] = val
+
+    def write(eqn, outs):
+        for var, o in zip(eqn.outvars, outs):
+            if type(var).__name__ != "DropVar":
+                env[var] = o
+
+    for eqn in jaxpr.eqns:
+        prim = str(eqn.primitive)
+        ins = [_read(env, v) for v in eqn.invars]
+        batched = any(i.batched for i in ins)
+
+        if prim in _ELEMENTWISE:
+            out_shape = eqn.outvars[0].aval.shape
+            if batched:
+                ops = [_align(i, out_shape, L) for i in ins]
+            else:
+                scalar_out = len(out_shape) == 0
+                if scalar_out:
+                    ops = [i.x for i in ins]
+                else:
+                    ops = [_align_unbatched(i, out_shape) for i in ins]
+            outs = eqn.primitive.bind(*ops, **eqn.params)
+            outs = outs if eqn.primitive.multiple_results else [outs]
+            write(eqn, [_Val(o, batched) for o in outs])
+        elif prim in _REDUCTIONS:
+            (i,) = ins
+            outs = eqn.primitive.bind(i.x, **eqn.params)
+            outs = outs if eqn.primitive.multiple_results else [outs]
+            if not i.batched:
+                # unbatched operands are lane-ready ([..., 1]); a per-lane
+                # scalar result must collapse that trailing dim back to a
+                # true rank-0 scalar or the 'unbatched scalars stay
+                # scalars' invariant breaks downstream (mixed ()/(1,)
+                # elementwise operands, while-cond rank check)
+                outs = [
+                    lax.reshape(o, ())
+                    if tuple(v.aval.shape) == () and jnp.ndim(o) == 1
+                    else o
+                    for o, v in zip(outs, eqn.outvars)
+                ]
+            write(eqn, [_Val(o, i.batched) for o in outs])
+        elif prim == "broadcast_in_dim":
+            (i,) = ins
+            shape = tuple(eqn.params["shape"])
+            bdims = tuple(eqn.params["broadcast_dimensions"])
+            x = i.x
+            if jnp.ndim(x) == 0:
+                out = lax.broadcast_in_dim(x, shape + (1,), ())
+                write(eqn, [_Val(out, False)])
+            else:
+                # x carries a trailing lane dim (L or 1): map it to the
+                # appended last output dim
+                lane = x.shape[-1]
+                out = lax.broadcast_in_dim(
+                    x, shape + (lane,), bdims + (len(shape),)
+                )
+                write(eqn, [_Val(out, i.batched)])
+        elif prim == "reshape":
+            (i,) = ins
+            new_sizes = tuple(eqn.params["new_sizes"])
+            if eqn.params.get("dimensions") is not None:
+                raise NotImplementedError("reshape with dimensions")
+            x = i.x
+            if jnp.ndim(x) == 0:
+                write(eqn, [_Val(lax.reshape(x, new_sizes + (1,)), False)])
+            else:
+                lane = x.shape[-1]
+                out = lax.reshape(x, new_sizes + (lane,))
+                write(eqn, [_Val(out, i.batched)])
+        elif prim == "squeeze":
+            (i,) = ins
+            dims = tuple(eqn.params["dimensions"])
+            x = i.x
+            # per-lane dims coincide with leading dims; lane stays
+            out_shape = eqn.outvars[0].aval.shape
+            out = lax.reshape(x, tuple(out_shape) + (x.shape[-1],))
+            write(eqn, [_Val(out, i.batched)])
+        elif prim == "slice":
+            (i,) = ins
+            x = i.x
+            start = tuple(eqn.params["start_indices"]) + (0,)
+            limit = tuple(eqn.params["limit_indices"]) + (x.shape[-1],)
+            strides = eqn.params["strides"]
+            strides = (
+                tuple(strides) + (1,) if strides is not None
+                else (1,) * x.ndim
+            )
+            out = lax.slice(x, start, limit, strides)
+            write(eqn, [_Val(out, i.batched)])
+        elif prim == "concatenate":
+            d = eqn.params["dimension"]
+            if batched:
+                ops = [
+                    _align(i, tuple(v.aval.shape), L)
+                    for i, v in zip(ins, eqn.invars)
+                ]
+            else:
+                ops = [
+                    _align_unbatched(i, tuple(v.aval.shape))
+                    for i, v in zip(ins, eqn.invars)
+                ]
+            out = lax.concatenate(ops, dimension=d)
+            write(eqn, [_Val(out, batched)])
+        elif prim == "iota":
+            shape = tuple(eqn.params["shape"])
+            dim = eqn.params["dimension"]
+            dtype = eqn.params["dtype"]
+            out = lax.broadcasted_iota(dtype, shape + (1,), dim)
+            write(eqn, [_Val(out, False)])
+        elif prim == "dot_general":
+            write(eqn, [_dot_general(eqn, ins, L)])
+        elif prim == "while":
+            write(eqn, _bind_while(eqn, ins, L))
+        elif prim in ("pjit", "jit"):
+            closed = eqn.params["jaxpr"]
+            write(
+                eqn, eval_lanelast(closed.jaxpr, closed.consts, L, ins)
+            )
+        elif prim == "custom_jvp_call":
+            # forward-pass semantics only (no AD inside the kernel):
+            # inline the primal jaxpr, e.g. jax.nn.relu / sigmoid
+            closed = eqn.params["call_jaxpr"]
+            write(
+                eqn, eval_lanelast(closed.jaxpr, closed.consts, L, ins)
+            )
+        else:
+            raise NotImplementedError(
+                f"lanelast: no rule for primitive '{prim}' "
+                f"({[str(v.aval) for v in eqn.invars]})"
+            )
+
+    return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _dot_general(eqn, ins, L):
+    """Per-lane matmul, lane-last: [m,K] @ [K,n] per lane, carried as
+    [m,K,lane] x [K,n,1].  Covers the physics-hook pattern — batched
+    activations against UNBATCHED weights (consts), no batch dims — by
+    unrolling the contracting dim into multiply-accumulates whose only
+    broadcasts are sublane 1->n and minor 1->lane, both Mosaic-supported.
+    The MXU is unreachable from a lane-last VPU kernel, but K,n are small
+    for in-loop scorers (e.g. models/awacs.py NN: K<=33), so the VPU
+    multiply-add cost equals the matmul FLOPs."""
+    lhs, rhs = ins
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    out_aval = eqn.outvars[0].aval
+    pref = eqn.params.get("preferred_element_type") or out_aval.dtype
+    lhs_shape = tuple(lhs.x.shape[:-1])  # per-lane (trailing dim = lane)
+    rhs_shape = tuple(rhs.x.shape[:-1])
+    if (
+        rhs.batched
+        or lb
+        or rb
+        or len(lhs_shape) != 2
+        or len(rhs_shape) != 2
+        or tuple(lc) != (1,)
+        or tuple(rc) != (0,)
+    ):
+        raise NotImplementedError(
+            "lanelast: dot_general rule covers per-lane [m,K] @ unbatched "
+            f"[K,n] only (dims {eqn.params['dimension_numbers']}, "
+            f"lhs {lhs_shape} batched={lhs.batched}, "
+            f"rhs {rhs_shape} batched={rhs.batched})"
+        )
+    m, K = lhs_shape
+    n = rhs_shape[1]
+    lane = lhs.x.shape[-1]
+    acc = jnp.zeros((m, n, lane), pref)
+    for k in range(K):
+        lk = lax.slice(lhs.x, (0, k, 0), (m, k + 1, lane))  # [m,1,lane]
+        rk = lax.slice(rhs.x, (k, 0, 0), (k + 1, n, 1))  # [1,n,1]
+        acc = acc + jnp.broadcast_to(lk.astype(pref), (m, n, lane)) * (
+            jnp.broadcast_to(rk.astype(pref), (m, n, lane))
+        )
+    if acc.dtype != out_aval.dtype:
+        acc = acc.astype(out_aval.dtype)
+    return _Val(acc, lhs.batched)
+
+
+def _promote(val, aval, L):
+    """Unbatched -> batched (per-lane shape ``aval.shape``)."""
+    if val.batched:
+        return val.x
+    return _align(val, tuple(aval.shape), L)
+
+
+def _bind_while(eqn, ins, L):
+    cond_j = eqn.params["cond_jaxpr"]
+    body_j = eqn.params["body_jaxpr"]
+    cn = eqn.params["cond_nconsts"]
+    bn = eqn.params["body_nconsts"]
+    cond_consts = ins[:cn]
+    body_consts = ins[cn : cn + bn]
+    carry = list(ins[cn + bn :])
+    carry_avals = [v.aval for v in body_j.jaxpr.invars[bn:]]
+
+    def _sub(flags):
+        return [
+            _Val(jax.ShapeDtypeStruct(
+                tuple(a.shape) + ((L,) if f else ()), a.dtype
+            ), f)
+            for a, f in zip(carry_avals, flags)
+        ]
+
+    # batchedness fixpoint over the carry: a body pass may batch a carry
+    # leaf that started unbatched; promote and re-trace until stable
+    flags = [c.batched for c in carry]
+    for _ in range(len(flags) + 1):
+        def _flags_of(vals):
+            return [v.batched for v in vals]
+
+        out_flags = _flags_of(
+            _abstract_eval(body_j, body_consts, L, _sub(flags))
+        )
+        new_flags = [a or b for a, b in zip(flags, out_flags)]
+        if new_flags == flags:
+            break
+        flags = new_flags
+    else:
+        raise RuntimeError("lanelast: while batchedness did not converge")
+
+    # Does the condition vary per lane?  A counter-only loop (dyn.kfori)
+    # keeps an unbatched scalar cond and lowers as-is.  A DATA-DEPENDENT
+    # loop (per-lane cond, e.g. the dispatcher's chain loop) lowers as
+    # any-lane-live with per-lane freeze masking — the same shape as the
+    # chunk driver's proven-on-Mosaic outer loop (pallas_run
+    # batched_chunk): scalar `reduce_or` condition, masked carries.  Each
+    # lane stops updating the moment its own cond goes false (cond is a
+    # pure function of the carry, so a frozen lane's cond stays false),
+    # which makes the batched loop exit after max-over-lanes iterations
+    # instead of a static worst-case trip count.
+    cond_batched = _abstract_eval(
+        cond_j, cond_consts, L, _sub(flags)
+    )[0].batched
+    if cond_batched:
+        # per-lane divergence freezes lanes independently, so every
+        # carry leaf must be able to hold per-lane values
+        flags = [True] * len(flags)
+
+    def _eval_cond(c):
+        vals = [_Val(x, f) for x, f in zip(c, flags)]
+        (out,) = eval_lanelast(
+            cond_j.jaxpr, cond_j.consts, L,
+            list(cond_consts) + vals,
+        )
+        return out
+
+    def cond_fn(c):
+        out = _eval_cond(c)
+        r = out.x
+        if cond_batched:
+            if not out.batched or jnp.ndim(r) != 1:
+                raise RuntimeError(
+                    "lanelast: batched while condition must be a "
+                    f"per-lane scalar (got shape {jnp.shape(r)})"
+                )
+            return jnp.any(r)
+        if out.batched or jnp.ndim(r):
+            raise RuntimeError(
+                "lanelast: while condition must be unbatched scalar "
+                "(kernel-mode loops key on an unbatched counter)"
+            )
+        return r
+
+    def body_fn(c):
+        vals = [_Val(x, f) for x, f in zip(c, flags)]
+        outs = eval_lanelast(
+            body_j.jaxpr, body_j.consts, L,
+            list(body_consts) + vals,
+        )
+        new = tuple(
+            _promote(o, a, L) if f else o.x
+            for o, a, f in zip(outs, carry_avals, flags)
+        )
+        if not cond_batched:
+            return new
+        live = _eval_cond(c).x  # [L]; broadcasts over leading dims
+        return tuple(
+            x if x is y else jnp.where(live, x, y)
+            for x, y in zip(new, c)
+        )
+
+    init = tuple(
+        _promote(c, a, L) if f else c.x
+        for c, a, f in zip(carry, carry_avals, flags)
+    )
+    outs = lax.while_loop(cond_fn, body_fn, init)
+    return [_Val(x, f) for x, f in zip(outs, flags)]
+
+
+def _abstract_eval(closed, consts_vals, L, in_vals):
+    """Shape-level pass to learn output batchedness without building ops:
+    evaluate with ShapeDtypeStructs via jax.eval_shape."""
+    out_box = []
+    all_vals = list(consts_vals) + list(in_vals)
+
+    def run(*xs):
+        ins = [_Val(x, v.batched) for x, v in zip(xs, all_vals)]
+        outs = eval_lanelast(closed.jaxpr, closed.consts, L, ins)
+        out_box.append([o.batched for o in outs])
+        return [o.x for o in outs]
+
+    jax.eval_shape(run, *[v.x for v in all_vals])
+    return [_Val(None, b) for b in out_box[-1]]

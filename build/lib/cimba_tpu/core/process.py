@@ -1,0 +1,261 @@
+"""Processes as state machines: the coroutine layer, lowered to data.
+
+Reference parity: ``cmb_process`` (`src/cmb_process.c`, 870 lines) gives
+each simulated process a stack, an assembly context switch, and
+hold/interrupt/stop/wait semantics with a signal-code protocol
+(`include/cmb_process.h:59-99`).  All control transfers are routed through
+scheduled events — the dispatcher never jumps directly between coroutines.
+
+TPU redesign (SURVEY.md §7 "coroutines become state machines"): a process
+is a row in a struct-of-arrays — program counter, status, priority, pending
+command, result register, typed locals.  A process *body* is a list of
+**blocks**: pure functions ``block(sim, pid, sig) -> (sim, Command)``
+covering the straight-line code between two yield points of the equivalent
+coroutine.  The dispatcher (core/loop.py) runs blocks through
+``lax.switch`` and chains non-yielding commands in an inner while_loop —
+exactly a coroutine resuming until it next waits, with the C stack replaced
+by the explicit (pc, locals) row.  No stacks, no guard pages, no context
+switch: the entire fiber kernel (reference components #2-#4, 1800 LoC of
+C+asm) becomes array indexing.
+
+Signal codes keep the reference's protocol and values.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+
+_I = INDEX_DTYPE
+_R = config.REAL
+
+# --- signal protocol (parity: include/cmb_process.h:59-99) -------------------
+SUCCESS = 0
+PREEMPTED = -1
+INTERRUPTED = -2
+STOPPED = -3
+CANCELLED = -4
+TIMEOUT = -5
+
+# --- process status (parity: enum cmb_process_state + queued refinement) -----
+CREATED = 0
+RUNNING = 1   # live: executing, holding, or waiting on a guard
+FINISHED = 2
+
+# --- command tags -------------------------------------------------------------
+C_HOLD = 0       # yield for a duration                      (f=dur)
+C_EXIT = 1       # terminate the process
+C_JUMP = 2       # continue immediately at next_pc
+C_PUT = 3        # blocking put into object queue i          (f=item)
+C_GET = 4        # blocking get from object queue i
+C_ACQUIRE = 5    # blocking acquire of binary resource i
+C_RELEASE = 6    # release binary resource i (never blocks)
+C_PREEMPT = 7    # priority acquire of resource i (may kick the holder)
+C_POOL_ACQ = 8   # blocking acquire of f units from pool i
+C_POOL_REL = 9   # release f units back to pool i (never blocks)
+C_BUF_GET = 10   # blocking take of f units from buffer i
+C_BUF_PUT = 11   # blocking add of f units into buffer i
+C_PQ_PUT = 12    # blocking put into priority queue i        (f=item, f2=prio)
+C_PQ_GET = 13    # blocking get from priority queue i
+C_COND_WAIT = 14 # wait on condition i until signaled & predicate true
+C_WAIT_PROC = 15 # wait for process i to finish
+C_POOL_PRE = 16  # greedy pool acquire that may mug lower-priority holders
+C_WAIT_EVT = 17  # wait for event handle i to be dispatched
+N_COMMANDS = 18
+
+
+class Command(NamedTuple):
+    """Uniform command pytree (every block returns one)."""
+
+    tag: jnp.ndarray      # i32
+    f: jnp.ndarray        # f64 payload (duration, item, amount)
+    f2: jnp.ndarray       # f64 second payload (item priority, ...)
+    i: jnp.ndarray        # i32 payload (queue/resource/pool id)
+    next_pc: jnp.ndarray  # i32 block to continue at
+
+
+# When set (by core.loop's used-tag inference pass), every constructed
+# command registers its tag here.  Tags reach _cmd as Python int constants,
+# so collection works under abstract (eval_shape) tracing — the dispatcher
+# uses the collected set to trace only the handlers a model can invoke
+# (vmapped lax.switch executes *every* traced branch for every lane, so an
+# unused handler is pure hot-loop cost).
+_tag_collector = None
+
+
+def _cmd(tag, f=0.0, f2=0.0, i=0, next_pc=0) -> Command:
+    if _tag_collector is not None:
+        _tag_collector.add(int(tag))
+    return Command(
+        jnp.asarray(tag, _I),
+        jnp.asarray(f, _R),
+        jnp.asarray(f2, _R),
+        jnp.asarray(i, _I),
+        jnp.asarray(next_pc, _I),
+    )
+
+
+def hold(duration, next_pc) -> Command:
+    """Yield for `duration` sim time (parity: cmb_process_hold)."""
+    return _cmd(C_HOLD, f=duration, next_pc=next_pc)
+
+
+def exit_() -> Command:
+    """Terminate (parity: cmb_process_exit / returning from the body)."""
+    return _cmd(C_EXIT)
+
+
+def jump(next_pc) -> Command:
+    """Continue at another block without yielding."""
+    return _cmd(C_JUMP, next_pc=next_pc)
+
+
+def put(queue, item, next_pc) -> Command:
+    """Blocking put (parity: cmb_objectqueue_put)."""
+    return _cmd(C_PUT, f=item, i=queue, next_pc=next_pc)
+
+
+def get(queue, next_pc) -> Command:
+    """Blocking get (parity: cmb_objectqueue_get); the item lands in the
+    process's result register (api.got)."""
+    return _cmd(C_GET, i=queue, next_pc=next_pc)
+
+
+def acquire(resource, next_pc) -> Command:
+    """Blocking acquire of a binary resource (parity: cmb_resource_acquire)."""
+    return _cmd(C_ACQUIRE, i=resource, next_pc=next_pc)
+
+
+def release(resource, next_pc) -> Command:
+    """Release a binary resource; continues without yielding."""
+    return _cmd(C_RELEASE, i=resource, next_pc=next_pc)
+
+
+def preempt(resource, next_pc) -> Command:
+    """Priority acquire (parity: cmb_resource_preempt): takes the resource
+    from a holder of equal or lower priority (myprio >= holder prio, as in
+    `src/cmb_resource.c:294`), delivering PREEMPTED to it."""
+    return _cmd(C_PREEMPT, i=resource, next_pc=next_pc)
+
+
+def pool_acquire(pool, amount, next_pc) -> Command:
+    """Blocking acquire of ``amount`` units (parity: cmb_resourcepool_acquire,
+    `src/cmb_resourcepool.c:362-533`): greedily grabs whatever is available
+    now and waits for the remainder; aborted waits (INTERRUPTED/TIMEOUT)
+    roll the holding back to what it was before the call."""
+    return _cmd(C_POOL_ACQ, f=amount, i=pool, next_pc=next_pc)
+
+
+def pool_preempt(pool, amount, next_pc) -> Command:
+    """Greedy pool acquire that may also mug strictly-lower-priority
+    holders (parity: cmb_resourcepool_preempt): victims are taken lowest
+    priority first, LIFO within a priority, lose their ENTIRE holding, and
+    resume with PREEMPTED; the surplus beyond the claim returns to the
+    pool."""
+    return _cmd(C_POOL_PRE, f=amount, i=pool, next_pc=next_pc)
+
+
+def pool_release(pool, amount, next_pc) -> Command:
+    """Release units back (parity: cmb_resourcepool_release; partial release
+    allowed)."""
+    return _cmd(C_POOL_REL, f=amount, i=pool, next_pc=next_pc)
+
+
+def buffer_get(buffer, amount, next_pc) -> Command:
+    """Take ``amount`` from a fungible store (parity: cmb_buffer_get)."""
+    return _cmd(C_BUF_GET, f=amount, i=buffer, next_pc=next_pc)
+
+
+def buffer_put(buffer, amount, next_pc) -> Command:
+    """Add ``amount`` into a fungible store (parity: cmb_buffer_put)."""
+    return _cmd(C_BUF_PUT, f=amount, i=buffer, next_pc=next_pc)
+
+
+def pq_put(pqueue, item, prio, next_pc) -> Command:
+    """Blocking put with per-item priority (parity: cmb_priorityqueue_put)."""
+    return _cmd(C_PQ_PUT, f=item, f2=prio, i=pqueue, next_pc=next_pc)
+
+
+def pq_get(pqueue, next_pc) -> Command:
+    """Blocking get of the highest-priority item (parity:
+    cmb_priorityqueue_get)."""
+    return _cmd(C_PQ_GET, i=pqueue, next_pc=next_pc)
+
+
+def cond_wait(condition, next_pc) -> Command:
+    """Wait until the condition is signaled and its predicate holds
+    (parity: cmb_condition_wait; spurious wakeups re-wait internally)."""
+    return _cmd(C_COND_WAIT, i=condition, next_pc=next_pc)
+
+
+def wait_process(pid, next_pc) -> Command:
+    """Wait for another process to finish (parity: cmb_process_wait_process);
+    delivers SUCCESS if it exited, STOPPED if it was killed."""
+    return _cmd(C_WAIT_PROC, i=pid, next_pc=next_pc)
+
+
+def wait_event(handle, next_pc) -> Command:
+    """Wait for an arbitrary scheduled event to occur (parity:
+    cmb_process_wait_event, `include/cmb_process.h:374`): the continuation
+    receives SUCCESS when the event is dispatched (waiters wake before the
+    event's action runs, `src/cmb_event.c:312-314`), CANCELLED if the event
+    was cancelled (or the handle was already dead), or the interrupting
+    signal if this process is interrupted while waiting."""
+    return _cmd(C_WAIT_EVT, i=handle, next_pc=next_pc)
+
+
+def select(pred, a: Command, b: Command) -> Command:
+    """Branch-free choice between two commands (pred ? a : b)."""
+    return Command(*[jnp.where(pred, x, y) for x, y in zip(a, b)])
+
+
+# no pending command sentinel
+NO_PEND = jnp.int32(-1)
+
+
+class Procs(NamedTuple):
+    """All processes of one replication, struct-of-arrays [P]."""
+
+    pc: jnp.ndarray        # i32 current block (global index)
+    status: jnp.ndarray    # i32 CREATED/RUNNING/FINISHED
+    prio: jnp.ndarray      # i32 current priority
+    pend_tag: jnp.ndarray  # i32 blocked command tag, NO_PEND if none
+    pend_f: jnp.ndarray    # f64
+    pend_f2: jnp.ndarray   # f64
+    pend_i: jnp.ndarray    # i32
+    pend_pc: jnp.ndarray   # i32
+    pend_guard: jnp.ndarray  # i32 guard the process waits on, -1 if none
+    pend_seq: jnp.ndarray  # i32 guard FIFO position (kept across retries)
+    await_pid: jnp.ndarray  # i32 process this one waits for (-1 none)
+    await_evt: jnp.ndarray  # i32 event handle this one waits for (-1 none)
+    exit_sig: jnp.ndarray  # i32 signal delivered to waiters (SUCCESS/STOPPED)
+    got: jnp.ndarray       # f64 result register (last GET item, ...)
+    locals_f: jnp.ndarray  # [P, NF] f64 user locals
+    locals_i: jnp.ndarray  # [P, NI] i32 user locals
+
+
+def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
+    entry = jnp.asarray(entry_pcs, _I)
+    p = entry.shape[0]
+    return Procs(
+        pc=entry,
+        status=jnp.full((p,), CREATED, _I),
+        prio=jnp.asarray(prios, _I),
+        pend_tag=jnp.full((p,), NO_PEND, _I),
+        pend_f=jnp.zeros((p,), _R),
+        pend_f2=jnp.zeros((p,), _R),
+        pend_i=jnp.zeros((p,), _I),
+        pend_pc=jnp.zeros((p,), _I),
+        pend_guard=jnp.full((p,), -1, _I),
+        pend_seq=jnp.full((p,), -1, _I),
+        await_pid=jnp.full((p,), -1, _I),
+        await_evt=jnp.full((p,), -1, _I),
+        exit_sig=jnp.full((p,), SUCCESS, _I),
+        got=jnp.zeros((p,), _R),
+        locals_f=jnp.zeros((p, max(n_flocals, 1)), _R),
+        locals_i=jnp.zeros((p, max(n_ilocals, 1)), _I),
+    )

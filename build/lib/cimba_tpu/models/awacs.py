@@ -1,0 +1,271 @@
+"""AWACS radar scenario: many target agents + a scanning sensor with an
+in-step vectorized physics computation.
+
+Reference parity: the tutorial-5 AWACS scenario (`tutorial/tut_5_1.c` CPU,
+`tut_5_3.c` multi-GPU): 1000 target coroutines fly straight-line legs with
+random turn points; one sensor coroutine wakes every dwell interval and
+scores all targets (terrain-masked detection) — on the GPU via CUDA kernels
+launched from inside the coroutine.
+
+TPU rendition of "level-3 parallelism": the physics IS jax — the sensor's
+block computes detection over the whole [N, 2] position array in one
+vectorized expression (later: a Pallas kernel via the same hook — a block
+is arbitrary traced compute).  Per-target processes stay as framework
+processes (count=N instances of one type), exercising the engine at the
+reference's process counts.
+
+Model state: user["pos"] [N,2], user["vel"] [N,2] updated lazily — each
+target process re-draws its leg at leg-end events; the sensor extrapolates
+positions analytically between updates (pos + vel * (t - t_mark)), so
+movement costs nothing between events, exactly like the reference storing
+(position, velocity, t_mark) per target.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import cimba_tpu.random as cr
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import api, cmd, dyn
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+_R = config.REAL
+_I = INDEX_DTYPE
+
+ARENA = 100.0          # square arena half-size
+SPEED = 5.0            # target speed
+LEG_MEAN = 4.0         # mean straight-leg duration
+DETECT_RANGE = 40.0    # sensor detection radius
+DWELL = 0.04 * 25      # dwell interval (scaled tut_5 pattern)
+
+# --- NN detection scorer (BASELINE configs[4]: "on-device NN scoring",
+# the reference's CUDA physics hook `tutorial/tut_5_3.cu` re-imagined as a
+# Pallas matmul stack).  Weights are fixed at import (a deterministic
+# stand-in for a trained radar-SNR model): two hidden layers + a strong
+# skip connection on the range-gaussian feature so near targets dominate
+# detections, as in the threshold model.
+
+_NN_F = 8    # features per target
+_NN_H = 32   # hidden width
+
+
+def _make_nn_weights():
+    rng = np.random.default_rng(20260729)
+
+    def glorot(shape):
+        lim = np.sqrt(6.0 / (shape[0] + shape[1]))
+        return rng.uniform(-lim, lim, shape).astype(np.float32)
+
+    w1 = glorot((_NN_F, _NN_H))
+    b1 = np.zeros(_NN_H, np.float32)
+    w2 = glorot((_NN_H, _NN_H))
+    b2 = np.zeros(_NN_H, np.float32)
+    # final layer sees [h2, range_gaussian]; the fixed skip weight keeps
+    # the scorer physically sensible without training
+    w3 = np.concatenate(
+        [0.3 * glorot((_NN_H, 1)), np.full((1, 1), 8.0, np.float32)]
+    )
+    b3 = np.full(1, -2.0, np.float32)
+    return tuple(jnp.asarray(a) for a in (w1, b1, w2, b2, w3, b3))
+
+
+_NN_WEIGHTS = _make_nn_weights()
+
+
+def _nn_features(pos, vel):
+    """[N,2],[N,2] -> ([N,F] f32 features, [N] f32 range gaussian)."""
+    pos = pos.astype(jnp.float32)
+    vel = vel.astype(jnp.float32)
+    r2 = jnp.sum(pos * pos, axis=1)
+    g = jnp.exp(-r2 / jnp.float32(DETECT_RANGE**2))
+    radial = jnp.sum(pos * vel, axis=1) / jnp.float32(SPEED * DETECT_RANGE)
+    feats = jnp.stack(
+        [
+            pos[:, 0] / ARENA,
+            pos[:, 1] / ARENA,
+            r2 / jnp.float32(ARENA**2),
+            g,
+            vel[:, 0] / SPEED,
+            vel[:, 1] / SPEED,
+            radial,
+            jnp.ones_like(g),
+        ],
+        axis=1,
+    )
+    return feats, g
+
+
+def _nn_forward(feats, g, w1, b1, w2, b2, w3, b3):
+    """The matmul stack: [N,F] -> detection probability [N] (f32)."""
+    h1 = jax.nn.relu(
+        jnp.dot(feats, w1, preferred_element_type=jnp.float32) + b1
+    )
+    h2 = jax.nn.relu(
+        jnp.dot(h1, w2, preferred_element_type=jnp.float32) + b2
+    )
+    h2g = jnp.concatenate([h2, g[:, None]], axis=1)
+    logit = jnp.dot(h2g, w3, preferred_element_type=jnp.float32) + b3
+    return jax.nn.sigmoid(logit[:, 0])
+
+
+def _nn_kernel(f_ref, g_ref, w1, b1, w2, b2, w3, b3, out_ref):
+    out_ref[...] = _nn_forward(
+        f_ref[...], g_ref[...][0],
+        w1[...], b1[...][0], w2[...], b2[...][0], w3[...], b3[...][0],
+    )[None]
+
+
+def nn_scores(pos, vel, *, use_pallas=None, interpret=False):
+    """Detection probabilities [N] for all targets — the physics hook.
+
+    ``use_pallas=True`` executes the stack as one Pallas kernel (all
+    operands in VMEM, matmuls on the MXU); ``False`` is the identical
+    plain-jnp trace (the oracle for the equivalence test).  ``None``
+    auto-selects Pallas on TPU.  The kernel is always pure f32 — detection
+    scores need no f64 regardless of the active profile.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and not config.KERNEL_MODE
+    feats, g = _nn_features(pos, vel)
+    w1, b1, w2, b2, w3, b3 = _NN_WEIGHTS
+    if not use_pallas:
+        return _nn_forward(feats, g, w1, b1, w2, b2, w3, b3)
+    n = feats.shape[0]
+    npad = max(128, -(-n // 128) * 128)  # lane-width multiple; pad rows
+    feats = jnp.pad(feats, ((0, npad - n), (0, 0)))
+    g = jnp.pad(g, (0, npad - n))
+    # rank-2 at the kernel boundary (1D vectors ride as [1, k])
+    out = pl.pallas_call(
+        _nn_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, npad), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(feats, g[None], w1, b1[None], w2, b2[None], w3, b3[None])
+    return out[0, :n]
+
+
+def build(n_targets: int, scoring: str = "nn"):
+    """``scoring="nn"`` (default) runs the Pallas/MLP detection scorer —
+    the reference's GPU physics hook (`tut_5_3.cu`) as a TPU matmul stack;
+    ``"threshold"`` keeps the closed-form linear-falloff score (the
+    tut_5_1 CPU model and the legacy behavior)."""
+    if scoring not in ("nn", "threshold"):
+        raise ValueError(f"scoring must be 'nn' or 'threshold': {scoring}")
+    m = Model(
+        "awacs",
+        # the general event table holds only timers/user events (process
+        # holds and resumes live in the dense per-pid wake table) and
+        # this model schedules neither — a token capacity suffices where
+        # 2*n_targets+8 slots were needed before the wake-table split,
+        # and the per-event table scan cost scales with it
+        event_cap=8,
+        guard_cap=2,
+    )
+
+    @m.user_state
+    def user_init(params):
+        (t_end,) = params
+        return {
+            "t_end": jnp.asarray(t_end, _R),
+            "pos": jnp.zeros((n_targets, 2), _R),
+            "vel": jnp.zeros((n_targets, 2), _R),
+            "t_mark": jnp.zeros((n_targets,), _R),
+            "detections": sm.empty(),  # per-dwell detection counts
+            "dwells": jnp.zeros((), _I),
+        }
+
+    def _current_positions(sim):
+        dt = sim.clock - sim.user["t_mark"]
+        return sim.user["pos"] + sim.user["vel"] * dt[:, None]
+
+    @m.block
+    def tgt_leg(sim, p, sig):
+        """Start a new straight leg: random heading, exponential duration."""
+        # target index within the type (targets are pids 0..N-1)
+        idx = p
+        # fold the position forward to now, then draw a new velocity
+        # one-hot dynamic reads (dyn.dget): a raw traced-index gather has
+        # no Mosaic lowering for the kernel path
+        pos_now = dyn.dget(sim.user["pos"], idx) + dyn.dget(
+            sim.user["vel"], idx
+        ) * (sim.clock - dyn.dget(sim.user["t_mark"], idx))
+        # soft-bounce: if outside the arena, head back toward the center.
+        # Directions are selected as unit VECTORS, not heading angles:
+        # cos/sin(arctan2(-y,-x)) in closed form is just -pos/|pos|, and
+        # atan2 has no Pallas TPU lowering (the kernel path compiles this
+        # block through Mosaic).
+        sim, heading = api.draw(sim, cr.uniform, 0.0, 2.0 * jnp.pi)
+        rand_dir = jnp.stack([jnp.cos(heading), jnp.sin(heading)])
+        r = jnp.sqrt(jnp.sum(pos_now * pos_now))
+        outside = r > ARENA
+        center_dir = -pos_now / jnp.maximum(r, 1e-6)
+        vel = SPEED * jnp.where(outside, center_dir, rand_dir)
+        u = sim.user
+        sim = api.set_user(
+            sim,
+            {
+                **u,
+                "pos": dyn.dset(u["pos"], idx, pos_now),
+                "vel": dyn.dset(u["vel"], idx, vel),
+                "t_mark": dyn.dset(u["t_mark"], idx, sim.clock),
+            },
+        )
+        sim, leg = api.draw(sim, cr.exponential, LEG_MEAN)
+        done = sim.clock >= sim.user["t_end"]
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(leg, next_pc=tgt_leg.pc)
+        )
+
+    @m.boundary_block
+    def sensor_dwell(sim, p, sig):
+        """One radar dwell: vectorized detection over ALL targets — the
+        physics hook (CUDA kernel in the reference, jax/Pallas here).
+
+        A BOUNDARY block: on the kernel path this dispatch runs host-side
+        between Pallas chunks as plain XLA, so the [N,32] NN stack rides
+        the MXU batched over lanes instead of executing masked on every
+        kernel event (it is only needed once per dwell — ~1 in 2N
+        events).  Entered only via hold resumes and process entry, as
+        the boundary contract requires."""
+        pos = _current_positions(sim)
+        # detection scores for every target, plus one uniform draw for the
+        # whole dwell (scan noise)
+        sim, noise = api.draw(sim, cr.uniform01)
+        if scoring == "nn":
+            p_det = nn_scores(pos, sim.user["vel"]).astype(_R)
+        else:
+            r2 = jnp.sum(pos * pos, axis=1)
+            p_det = jnp.clip(1.2 - jnp.sqrt(r2) / DETECT_RANGE, 0.0, 1.0)
+        detected = jnp.sum((p_det > noise).astype(_R))
+        u = sim.user
+        sim = api.set_user(
+            sim,
+            {
+                **u,
+                "detections": sm.add(u["detections"], detected),
+                "dwells": u["dwells"] + 1,
+            },
+        )
+        done = sim.clock >= sim.user["t_end"]
+        sim = api.stop(sim, done)
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(DWELL, next_pc=sensor_dwell.pc)
+        )
+
+    m.process("target", entry=tgt_leg, count=n_targets)  # pids 0..N-1
+    m.process("sensor", entry=sensor_dwell, prio=1)      # pid N
+    return m.build(), {}
+
+
+def params(t_end: float):
+    return (t_end,)

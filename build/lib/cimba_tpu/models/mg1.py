@@ -1,0 +1,129 @@
+"""M/G/1 queue with lognormal service — the parameter-sweep model.
+
+Reference parity: the M/G/1 sweep benchmark (`README.md:283-294`,
+BASELINE.json configs[2]): 4 service CVs x 5 utilizations x 10 replications
+= 200 trials in one experiment, each trial's parameters coming from its
+slot in the experiment array.  Here the sweep is a params pytree with
+leading axis R — the TPU experiment array.
+
+Theory (Pollaczek–Khinchine): with utilization rho = lambda*E[S] and
+service SCV cs2 = Var[S]/E[S]^2,
+    Wq = rho * E[S] * (1 + cs2) / (2 * (1 - rho)),   W = Wq + E[S].
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+import cimba_tpu.random as cr
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+_R = config.REAL
+_I = INDEX_DTYPE
+
+L_PRODUCED = 0
+
+
+def build(queue_cap: int = 512):
+    """M/G/1: exponential arrivals, lognormal service of given mean/CV."""
+    m = Model("mg1", n_ilocals=1, event_cap=8, guard_cap=4)
+    q = m.objectqueue("buffer", capacity=queue_cap)
+
+    @m.user_state
+    def user_init(params):
+        arr_mean, srv_mean, srv_cv, n_objects = params
+        # lognormal parameters from mean m_s and coefficient of variation
+        sigma2 = jnp.log1p(jnp.asarray(srv_cv, _R) ** 2)
+        mu = jnp.log(jnp.asarray(srv_mean, _R)) - 0.5 * sigma2
+        return {
+            "arr_mean": jnp.asarray(arr_mean, _R),
+            "ln_mu": mu,
+            "ln_sigma": jnp.sqrt(sigma2),
+            "n_objects": jnp.asarray(n_objects, _I),
+            "wait": sm.empty(),
+        }
+
+    @m.block
+    def a_hold(sim, p, sig):
+        produced = api.local_i(sim, p, L_PRODUCED)
+        finished = produced >= sim.user["n_objects"]
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.select(
+            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+        )
+
+    @m.block
+    def a_put(sim, p, sig):
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
+        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+
+    @m.block
+    def s_get(sim, p, sig):
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    @m.block
+    def s_hold(sim, p, sig):
+        sim, t = api.draw(
+            sim, cr.lognormal, sim.user["ln_mu"], sim.user["ln_sigma"]
+        )
+        return sim, cmd.hold(t, next_pc=s_record.pc)
+
+    @m.block
+    def s_record(sim, p, sig):
+        t_sys = api.clock(sim) - api.got(sim, p)
+        wait = sm.add(sim.user["wait"], t_sys)
+        sim = api.set_user(sim, {**sim.user, "wait": wait})
+        sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
+        # return the next blocking command directly (not cmd.jump(s_get)):
+        # a jump tail costs one extra full chain iteration per service in
+        # the kernel, where every iteration re-executes the masked body
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    m.process("arrival", entry=a_hold)
+    m.process("service", entry=s_get)
+    return m.build(), {"queue": q}
+
+
+def sweep_params(
+    n_objects: int,
+    cvs=(0.25, 0.5, 1.0, 2.0),
+    utilizations=(0.5, 0.6, 0.7, 0.8, 0.9),
+    reps_per_cell: int = 10,
+    srv_mean: float = 1.0,
+):
+    """The reference's 4x5x10 experiment array: one row per replication.
+
+    Returns (params tuple of [R] arrays, cells) where cells[i] = (cv, rho)
+    of replication i.
+    """
+    cells = [
+        (cv, rho)
+        for cv in cvs
+        for rho in utilizations
+        for _ in range(reps_per_cell)
+    ]
+    cv_arr = np.asarray([c for c, _ in cells])
+    rho_arr = np.asarray([r for _, r in cells])
+    arr_mean = srv_mean / rho_arr  # lambda = rho/E[S]
+    return (
+        (
+            jnp.asarray(arr_mean),
+            jnp.full(len(cells), srv_mean),
+            jnp.asarray(cv_arr),
+            jnp.full(len(cells), n_objects, jnp.int32),
+        ),
+        cells,
+    )
+
+
+def pk_sojourn(rho: float, cv: float, srv_mean: float = 1.0) -> float:
+    """Pollaczek–Khinchine mean sojourn time."""
+    wq = rho * srv_mean * (1.0 + cv * cv) / (2.0 * (1.0 - rho))
+    return wq + srv_mean

@@ -1,0 +1,105 @@
+"""M/M/1 queue — the flagship model and north-star benchmark.
+
+Reference parity: ``benchmark/MM1_multi.c`` — an arrival process holds
+exp(1/lambda) then puts a timestamp object into an unlimited FIFO; a service
+process gets, holds exp(1/mu), and records the sojourn time
+(`benchmark/MM1_multi.c:52-90`).  The trial ends after ``n_objects``
+served objects.  Theory: mean sojourn = 1/(mu - lambda).
+
+State per replication: two processes, one queue, one sojourn-time Summary.
+Parameters travel in the user pytree (the reference's trial struct).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+_R = config.REAL
+_I = INDEX_DTYPE
+
+#: ilocal 0 of the arrival process: number of objects produced
+L_PRODUCED = 0
+
+
+def build(
+    queue_cap: int = 256,
+    event_cap: int = 8,
+    guard_cap: int = 4,
+    record: bool = True,
+):
+    """Construct the M/M/1 model; returns (spec, refs dict).
+
+    ``queue_cap`` bounds the FIFO (the reference uses CMB_UNLIMITED; a
+    fixed capacity with overflow-as-failure is the jit trade — at rho=0.9
+    P(len > 256) ~ 0.9^256 ~ 2e-12 per event, masked if ever hit).
+    ``record=False`` drops queue-length recording from the hot loop (the
+    benchmark configuration, like the reference's NLOGINFO build).
+    """
+    m = Model(
+        "mm1",
+        n_ilocals=1,
+        event_cap=event_cap,
+        guard_cap=guard_cap,
+    )
+    q = m.objectqueue("buffer", capacity=queue_cap, record=record)
+
+    @m.user_state
+    def user_init(params):
+        arr_mean, srv_mean, n_objects = params
+        return {
+            "arr_mean": jnp.asarray(arr_mean, _R),
+            "srv_mean": jnp.asarray(srv_mean, _R),
+            "n_objects": jnp.asarray(n_objects, _I),
+            "wait": sm.empty(),
+        }
+
+    @m.block
+    def a_hold(sim, p, sig):
+        produced = api.local_i(sim, p, L_PRODUCED)
+        finished = produced >= sim.user["n_objects"]
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.select(
+            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+        )
+
+    @m.block
+    def a_put(sim, p, sig):
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
+        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+
+    @m.block
+    def s_get(sim, p, sig):
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    @m.block
+    def s_hold(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
+        return sim, cmd.hold(t, next_pc=s_record.pc)
+
+    @m.block
+    def s_record(sim, p, sig):
+        t_sys = api.clock(sim) - api.got(sim, p)
+        wait = sm.add(sim.user["wait"], t_sys)
+        sim = api.set_user(sim, {**sim.user, "wait": wait})
+        sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
+        # return the next blocking command directly (not cmd.jump(s_get)):
+        # a jump tail costs one extra full chain iteration per service in
+        # the kernel, where every iteration re-executes the masked body
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    m.process("arrival", entry=a_hold, prio=0)
+    m.process("service", entry=s_get, prio=0)
+    return m.build(), {"queue": q}
+
+
+def params(n_objects: int, arr_rate: float = 0.9, srv_rate: float = 1.0):
+    """Per-replication parameter tuple (matches reference constants,
+    `benchmark/MM1_multi.c:26-29`)."""
+    return (1.0 / arr_rate, 1.0 / srv_rate, n_objects)

@@ -1,0 +1,105 @@
+"""M/M/c queue — c parallel servers fed by one FIFO.
+
+Reference parity: the "M/M/c resource-pool queue" benchmark config
+(BASELINE.json configs[1]).  Here the c servers are ``count=c`` instances
+of one service process type sharing the arrival queue — the process-
+interaction rendition; the machine-repair model in tests exercises
+cmb_resourcepool semantics directly.
+
+Theory: Erlang-C.  With a = lambda/mu and rho = a/c,
+P_wait = ErlangC(c, a), mean wait in queue Wq = P_wait / (c*mu - lambda),
+mean sojourn W = Wq + 1/mu.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.stats import summary as sm
+
+_R = config.REAL
+_I = INDEX_DTYPE
+
+L_PRODUCED = 0
+
+
+def build(c: int, queue_cap: int = 256):
+    """M/M/c with ``c`` server-process instances."""
+    m = Model(
+        "mmc",
+        n_ilocals=1,
+        event_cap=8 + 2 * c,
+        guard_cap=max(4, c + 2),
+    )
+    q = m.objectqueue("buffer", capacity=queue_cap)
+
+    @m.user_state
+    def user_init(params):
+        arr_mean, srv_mean, n_objects = params
+        return {
+            "arr_mean": jnp.asarray(arr_mean, _R),
+            "srv_mean": jnp.asarray(srv_mean, _R),
+            "n_objects": jnp.asarray(n_objects, _I),
+            "wait": sm.empty(),
+        }
+
+    @m.block
+    def a_hold(sim, p, sig):
+        produced = api.local_i(sim, p, L_PRODUCED)
+        finished = produced >= sim.user["n_objects"]
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.select(
+            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+        )
+
+    @m.block
+    def a_put(sim, p, sig):
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
+        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+
+    @m.block
+    def s_get(sim, p, sig):
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    @m.block
+    def s_hold(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
+        return sim, cmd.hold(t, next_pc=s_record.pc)
+
+    @m.block
+    def s_record(sim, p, sig):
+        t_sys = api.clock(sim) - api.got(sim, p)
+        wait = sm.add(sim.user["wait"], t_sys)
+        sim = api.set_user(sim, {**sim.user, "wait": wait})
+        sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
+        # return the next blocking command directly (not cmd.jump(s_get)):
+        # a jump tail costs one extra full chain iteration per service in
+        # the kernel, where every iteration re-executes the masked body
+        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+
+    m.process("arrival", entry=a_hold, prio=0)
+    m.process("server", entry=s_get, prio=0, count=c)
+    return m.build(), {"queue": q}
+
+
+def params(n_objects: int, arr_rate: float, srv_rate: float):
+    return (1.0 / arr_rate, 1.0 / srv_rate, n_objects)
+
+
+def erlang_c_sojourn(c: int, arr_rate: float, srv_rate: float) -> float:
+    """Closed-form mean sojourn time for M/M/c (Erlang-C)."""
+    a = arr_rate / srv_rate
+    rho = a / c
+    assert rho < 1.0
+    inv_b = sum(a**k / math.factorial(k) for k in range(c))
+    last = a**c / (math.factorial(c) * (1.0 - rho))
+    p_wait = last / (inv_b + last)
+    wq = p_wait / (c * srv_rate - arr_rate)
+    return wq + 1.0 / srv_rate

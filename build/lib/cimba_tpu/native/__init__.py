@@ -1,0 +1,142 @@
+"""ctypes bindings for the native runtime pieces (native/cimba_native.cpp).
+
+Builds on demand with the in-tree Makefile (g++; no pybind11 — plain
+extern "C" + ctypes per the environment's binding constraints).  Absent a
+C++ toolchain the import still succeeds and ``available()`` returns False;
+everything native has a Python fallback (utils/seed.py, the Python oracle
+in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.normpath(os.path.join(_HERE, "..", "..", "native"))
+_SO = os.path.join(_NATIVE_DIR, "build", "libcimba_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO):
+        src = os.path.join(_NATIVE_DIR, "cimba_native.cpp")
+        if not os.path.exists(src) or not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.cimba_hwseed.restype = ctypes.c_uint64
+    lib.cimba_threefry2x32.argtypes = [ctypes.c_uint32] * 4 + [
+        ctypes.POINTER(ctypes.c_uint32)
+    ] * 2
+    lib.cimba_oracle_mm1.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.cimba_oracle_mmc.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def hwseed() -> int:
+    """RDSEED/RDRAND-backed 64-bit seed (parity: cmb_random_hwseed);
+    falls back to utils.seed.hwseed without the native library."""
+    lib = load()
+    if lib is None:
+        from cimba_tpu.utils.seed import hwseed as py_hwseed
+
+        return py_hwseed()
+    return int(lib.cimba_hwseed())
+
+
+def threefry2x32(k0: int, k1: int, c0: int, c1: int) -> tuple[int, int]:
+    lib = load()
+    assert lib is not None
+    o0 = ctypes.c_uint32()
+    o1 = ctypes.c_uint32()
+    lib.cimba_threefry2x32(k0, k1, c0, c1, ctypes.byref(o0), ctypes.byref(o1))
+    return o0.value, o1.value
+
+
+def oracle_mm1(
+    seed: int, rep: int, n_objects: int, arr_mean: float, srv_mean: float
+) -> dict:
+    """Run the scalar C++ M/M/1 oracle; returns the summary dict."""
+    lib = load()
+    assert lib is not None
+    out = (ctypes.c_double * 7)()
+    lib.cimba_oracle_mm1(seed, rep, n_objects, arr_mean, srv_mean, out)
+    return {
+        "clock": out[0],
+        "n": out[1],
+        "mean": out[2],
+        "m2": out[3],
+        "min": out[4],
+        "max": out[5],
+        "events": int(out[6]),
+    }
+
+
+def oracle_mmc(
+    seed: int,
+    rep: int,
+    n_objects: int,
+    arr_mean: float,
+    srv_mean: float,
+    c: int,
+) -> dict:
+    """Run the scalar C++ M/M/c oracle; returns the summary dict."""
+    lib = load()
+    assert lib is not None
+    out = (ctypes.c_double * 7)()
+    lib.cimba_oracle_mmc(seed, rep, n_objects, arr_mean, srv_mean, c, out)
+    return {
+        "clock": out[0],
+        "n": out[1],
+        "mean": out[2],
+        "m2": out[3],
+        "min": out[4],
+        "max": out[5],
+        "events": int(out[6]),
+    }

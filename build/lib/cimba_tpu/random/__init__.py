@@ -1,0 +1,57 @@
+"""cimba-tpu random subsystem: counter-based streams + distribution catalogue.
+
+See :mod:`cimba_tpu.random.bits` for the Threefry stream design and
+:mod:`cimba_tpu.random.distributions` for the samplers (parity with the
+reference's ``include/cmb_random.h``).
+"""
+
+from cimba_tpu.random.bits import (
+    RandomState,
+    fmix64,
+    initialize,
+    next_bits64,
+    threefry2x32,
+)
+from cimba_tpu.random.alias import AliasTable, alias_create, alias_sample
+from cimba_tpu.random.distributions import (
+    bernoulli,
+    beta,
+    binomial,
+    cauchy,
+    chisquared,
+    dice,
+    discrete_nonuniform,
+    discrete_uniform,
+    erlang,
+    exponential,
+    f_dist,
+    flip,
+    gamma,
+    geometric,
+    hyperexponential,
+    hypoexponential,
+    loaded_dice,
+    logistic,
+    lognormal,
+    negative_binomial,
+    normal,
+    pareto,
+    pascal,
+    pert,
+    pert_mod,
+    poisson,
+    rayleigh,
+    std_beta,
+    std_exponential,
+    std_gamma,
+    std_normal,
+    std_t_dist,
+    t_dist,
+    triangular,
+    uniform,
+    uniform01,
+    uniform01_53,
+    weibull,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

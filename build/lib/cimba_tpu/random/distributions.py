@@ -1,0 +1,468 @@
+"""Random variate generation: the full cimba distribution catalogue.
+
+Reference parity: ``include/cmb_random.h`` / ``src/cmb_random.c`` expose ~30
+distributions built on a thread-local sfc64 generator.  This module provides
+the same catalogue on top of the counter-based Threefry streams in
+:mod:`cimba_tpu.random.bits`.
+
+Design (TPU-first, intentionally different from the reference):
+
+* Every sampler is **scalar-style, stateful and functional**:
+  ``fn(state, *params) -> (state, sample)``.  Vectorize with ``jax.vmap``
+  over the replication axis; the framework's event loop does exactly that.
+* Continuous samplers default to **inversion / transform methods**, not the
+  reference's ziggurat: the VPU evaluates ``log``/``erfinv`` in a handful of
+  cycles with no divergence, whereas a vectorized ziggurat pays the rare
+  overhang path on *every* batched draw (with R lanes the probability some
+  lane rejects is ~1).  The ziggurat tables and samplers still exist in
+  :mod:`cimba_tpu.random.ziggurat` for parity and for the Pallas kernel.
+* Rejection samplers (gamma, Poisson PTRS) use ``lax.while_loop`` per draw;
+  the RNG counter travels in the carry, so each replication's draw sequence
+  stays deterministic regardless of how many rounds its neighbours needed.
+
+Draws consume one 64-bit counter tick each unless noted.  All samples are
+float64 (see config.py rationale).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cimba_tpu import config
+from cimba_tpu.random.bits import RandomState, next_bits64, to_u64
+
+_R = config.REAL
+_INV_2_53 = 1.0 / 9007199254740992.0  # 2**-53
+
+
+def uniform01(st: RandomState):
+    """Standard uniform on [0, 1) with 32-bit resolution (1 draw).
+
+    Parity: ``cmb_random()`` (`include/cmb_random.h:150`), which assembles a
+    53-bit significand from a u64.  Here the significand is deliberately
+    32-bit: ``b1 * 2**-32`` uses only a u32->f64 conversion and a power-of-2
+    scale, both of which are exactly computed on every backend — whereas the
+    TPU's software-emulated float64 *addition* is not always correctly
+    rounded (observed: low 2 bits lost for some operand patterns), so any
+    multi-word mantissa assembly would break cross-backend bit-identity of
+    the stream.  The 2**-32 granularity biases means by ~2**-33, far below
+    Monte-Carlo error at any realistic replication count; the second word
+    ``b0`` is reserved for samplers that need extra bits.
+    """
+    st, _, b1 = next_bits64(st)
+    if _R.dtype.itemsize == 4:
+        # f32 profile (Pallas kernel path): a full-width u32->f32 convert
+        # rounds values near 2**32 up to exactly 1.0 (fatal for -log1p(-u));
+        # 24 bits is the widest exact significand, same one-draw contract.
+        # u32->i32 first: the value fits in 24 bits, and Mosaic's
+        # u32->f32 convert rule recurses forever (i32->f32 is native)
+        u = (b1 >> jnp.uint32(8)).astype(jnp.int32).astype(_R) * _R(2.0**-24)
+    else:
+        u = b1.astype(_R) * _R(2.0**-32)
+    return st, u
+
+
+def uniform01_53(st: RandomState):
+    """Standard uniform on [0, 1) with full 53-bit resolution (1 draw).
+
+    Used by continuous transform samplers (exponential, normal) whose tail
+    extent depends on uniform granularity: 53 bits puts the inversion tail
+    cap at ~36.7 for the exponential and ~8.2 sigma for the normal, matching
+    the reference ziggurat's practical support.  The final addition is not
+    bit-exact across backends (TPU f64 add rounding, see uniform01) — which
+    is already true of the downstream ``log``/``erf_inv``, so these samplers
+    carry a tolerance contract, not a bit-identity one.
+    """
+    st, b0, b1 = next_bits64(st)
+    if _R.dtype.itemsize == 4:
+        # f32 profile: 24 bits IS full resolution; tail cap ~16.6 for the
+        # exponential / ~5.7 sigma for the normal (documented envelope).
+        # Consumes the same one counter tick as the f64 path so draw
+        # streams stay aligned across profiles.
+        return st, (b1 >> jnp.uint32(8)).astype(jnp.int32).astype(_R) * _R(
+            2.0**-24
+        )
+    hi = b1.astype(_R) * _R(2.0**-32)
+    lo = (b0 >> jnp.uint32(11)).astype(_R) * _R(_INV_2_53)
+    return st, hi + lo
+
+
+def uniform(st, lo, hi):
+    """Uniform on [lo, hi). Parity: ``cmb_random_uniform``."""
+    st, u = uniform01(st)
+    return st, lo + (hi - lo) * u
+
+
+def triangular(st, lo, mode, hi):
+    """Triangular on [lo, hi] with the given mode (inversion)."""
+    st, u = uniform01(st)
+    fc = (mode - lo) / (hi - lo)
+    left = lo + jnp.sqrt(u * (hi - lo) * (mode - lo))
+    right = hi - jnp.sqrt((1.0 - u) * (hi - lo) * (hi - mode))
+    return st, jnp.where(u < fc, left, right)
+
+
+def std_exponential(st):
+    """Unit-mean exponential via inversion (1 draw, 1 log).
+
+    The reference's hot path is a ziggurat (`include/cmb_random.h:324-347`);
+    on TPU the branch-free inversion wins (see module docstring).
+    """
+    st, u = uniform01_53(st)
+    return st, -jnp.log1p(-u)
+
+
+def exponential(st, mean):
+    st, x = std_exponential(st)
+    return st, mean * x
+
+
+def std_normal(st):
+    """Standard normal via inverse-CDF: sqrt(2) * erfinv(2u - 1) (1 draw,
+    53-bit uniform so the practical tail support reaches ~8.2 sigma)."""
+    st, u = uniform01_53(st)
+    # map u in [0,1) to (-1, 1); u==0 gives -1 -> erfinv(-1) = -inf, so
+    # nudge by one representable step of the active profile's dtype (a
+    # fixed 1e-16 would round to exactly -1 in f32 and leak -inf samples)
+    tiny = float(jnp.finfo(_R.dtype).eps) / 2.0
+    x = 2.0 * u - 1.0
+    x = jnp.clip(x, -1.0 + tiny, 1.0 - tiny)
+    return st, jnp.sqrt(_R(2.0)) * lax.erf_inv(x)
+
+
+def normal(st, mu, sigma):
+    st, z = std_normal(st)
+    return st, mu + sigma * z
+
+
+def lognormal(st, m, s):
+    """exp(N(m, s)): mean exp(m + s^2/2), median exp(m)."""
+    st, z = normal(st, m, s)
+    return st, jnp.exp(z)
+
+
+def logistic(st, m, s):
+    st, u = uniform01(st)
+    u = jnp.clip(u, 1e-300, 1.0 - 1e-16)
+    return st, m + s * jnp.log(u / (1.0 - u))
+
+
+def cauchy(st, mode, scale):
+    st, u = uniform01(st)
+    return st, mode + scale * jnp.tan(jnp.pi * (u - 0.5))
+
+
+def erlang(st, k, mean):
+    """Sum of k exponentials, each of mean ``mean`` (k draws).
+
+    ``k`` may be a traced integer; the loop is a ``lax.while_loop``.
+    """
+    k = jnp.asarray(k, jnp.int32)
+
+    def body(carry):
+        st, i, acc = carry
+        st, x = std_exponential(st)
+        return st, i + 1, acc + x
+
+    st, _, total = lax.while_loop(lambda c: c[1] < k, body, (st, jnp.int32(0), _R(0.0)))
+    return st, mean * total
+
+
+def hypoexponential(st, means):
+    """Series of exponential stages with per-stage means (len(means) draws).
+
+    ``means`` is a fixed-size array (the reference takes n + double[]).
+    """
+    means = jnp.asarray(means, _R)
+
+    def body(i, carry):
+        st, acc = carry
+        st, x = std_exponential(st)
+        return st, acc + means[i] * x
+
+    from cimba_tpu.core import dyn
+
+    st, total = dyn.kfori(0, means.shape[0], body, (st, _R(0.0)))
+    return st, total
+
+
+def hyperexponential(st, probs, means):
+    """Mixture of exponentials: pick stage by probs, then exp(means[i])."""
+    probs = jnp.asarray(probs, _R)
+    means = jnp.asarray(means, _R)
+    st, i = discrete_nonuniform(st, probs)
+    st, x = std_exponential(st)
+    return st, means[i] * x
+
+
+def std_gamma(st, shape):
+    """Gamma(shape, 1) via Marsaglia–Tsang squeeze (rejection while_loop).
+
+    Same algorithm family as the reference (`src/cmb_random.c:465-497`),
+    minus the thread-local parameter cache (stateless fits the counter
+    design).  Shapes < 1 use the boosting identity
+    gamma(a) = gamma(a+1) * U^(1/a).
+    """
+    shape = jnp.asarray(shape, _R)
+    boosted = shape < 1.0
+    d_shape = jnp.where(boosted, shape + 1.0, shape)
+    d = d_shape - 1.0 / 3.0
+    c = 1.0 / jnp.sqrt(9.0 * d)
+
+    def cond(carry):
+        _, accepted, _ = carry
+        return ~accepted
+
+    def body(carry):
+        st, _, _ = carry
+        st, z = std_normal(st)
+        st, u = uniform01(st)
+        v = (1.0 + c * z) ** 3
+        ok_v = v > 0.0
+        lhs = jnp.log(jnp.maximum(u, 1e-300))
+        rhs = 0.5 * z * z + d - d * v + d * jnp.log(jnp.maximum(v, 1e-300))
+        accepted = ok_v & (lhs < rhs)
+        return st, accepted, d * v
+
+    st, _, x = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
+    st, u = uniform01(st)
+    u = jnp.maximum(u, 1e-300)
+    boost = jnp.where(boosted, u ** (1.0 / jnp.maximum(shape, 1e-12)), 1.0)
+    return st, x * boost
+
+
+def gamma(st, shape, scale):
+    st, x = std_gamma(st, shape)
+    return st, scale * x
+
+
+def std_beta(st, a, b):
+    """Beta(a, b) from two gammas: X/(X+Y)."""
+    st, x = std_gamma(st, a)
+    st, y = std_gamma(st, b)
+    return st, x / (x + y)
+
+
+def beta(st, a, b, lo, hi):
+    st, z = std_beta(st, a, b)
+    return st, lo + (hi - lo) * z
+
+
+def pert_mod(st, lo, mode, hi, lam):
+    """Modified-PERT: scaled beta with peakiness ``lam`` (4.0 = classic)."""
+    span = hi - lo
+    a = 1.0 + lam * (mode - lo) / span
+    b = 1.0 + lam * (hi - mode) / span
+    return beta(st, a, b, lo, hi)
+
+
+def pert(st, lo, mode, hi):
+    """Classic PERT: mean (lo + 4 mode + hi)/6."""
+    return pert_mod(st, lo, mode, hi, 4.0)
+
+
+def weibull(st, shape, scale):
+    st, x = std_exponential(st)
+    return st, scale * x ** (1.0 / shape)
+
+
+def pareto(st, shape, mode):
+    """Pareto on [mode, inf): mode / U^(1/shape)."""
+    st, u = uniform01(st)
+    u = jnp.maximum(1.0 - u, _R(_INV_2_53))  # (0, 1]
+    return st, mode / u ** (1.0 / shape)
+
+
+def chisquared(st, k):
+    """Chi-squared with (possibly non-integer) dof k = 2 * Gamma(k/2, 1)."""
+    st, x = std_gamma(st, k * 0.5)
+    return st, 2.0 * x
+
+
+def f_dist(st, a, b):
+    st, x = chisquared(st, a)
+    st, y = chisquared(st, b)
+    return st, (x / a) / (y / b)
+
+
+def std_t_dist(st, v):
+    st, z = std_normal(st)
+    st, x = chisquared(st, v)
+    return st, z / jnp.sqrt(x / v)
+
+
+def t_dist(st, m, s, v):
+    st, t = std_t_dist(st, v)
+    return st, m + s * t
+
+
+def rayleigh(st, s):
+    st, x = std_exponential(st)
+    return st, s * jnp.sqrt(2.0 * x)
+
+
+# --- discrete ---------------------------------------------------------------
+
+
+def flip(st):
+    """Fair coin in {0, 1} (1 draw; the reference amortizes one draw over 64
+    flips via a bit cache — stateless streams spend the whole draw)."""
+    st, b0, _ = next_bits64(st)
+    return st, (b0 & jnp.uint32(1)).astype(jnp.int32)
+
+
+def bernoulli(st, p):
+    st, u = uniform01(st)
+    return st, (u < p).astype(jnp.int32)
+
+
+def geometric(st, p):
+    """Trials up to and including first success; support [1, inf), mean 1/p.
+
+    Inversion: ceil(ln(1-u) / ln(1-p)) — the reference simulates the trials;
+    inversion is branch-free and exact in distribution.
+    """
+    st, u = uniform01(st)
+    ratio = jnp.log1p(-u) / jnp.log1p(-p)
+    return st, jnp.maximum(jnp.ceil(ratio), 1.0).astype(jnp.int64)
+
+
+def binomial(st, n, p):
+    """Successes in n Bernoulli trials (simulated, n draws — like the
+    reference; fine for the moderate n used in models)."""
+    n = jnp.asarray(n, jnp.int64)
+
+    def body(carry):
+        st, i, acc = carry
+        st, b = bernoulli(st, p)
+        return st, i + 1, acc + jnp.asarray(b, jnp.int64)
+
+    st, _, total = lax.while_loop(
+        lambda c: c[1] < n, body, (st, jnp.int64(0), jnp.int64(0))
+    )
+    return st, total
+
+
+def negative_binomial(st, m, p):
+    """Failures before the m-th success; mean m(1-p)/p (m geometric draws)."""
+    m = jnp.asarray(m, jnp.int64)
+
+    def body(carry):
+        st, i, acc = carry
+        st, g = geometric(st, p)
+        return st, i + 1, acc + g - 1  # failures = trials - 1 per success
+
+    st, _, total = lax.while_loop(
+        lambda c: c[1] < m, body, (st, jnp.int64(0), jnp.int64(0))
+    )
+    return st, total
+
+
+def pascal(st, m, p):
+    """Trials to reach the m-th success = negative_binomial + m."""
+    st, nb = negative_binomial(st, m, p)
+    return st, nb + jnp.asarray(m, jnp.int64)
+
+
+def poisson(st, rate):
+    """Poisson(rate) — Knuth product-of-uniforms for rate < 10, Hörmann's
+    PTRS transformed rejection for larger rates (both loop per draw)."""
+    rate = jnp.asarray(rate, _R)
+
+    # Each branch clamps the rate to its own valid domain: under vmap with
+    # per-lane rates, lax.cond lowers to "run both branches masked", so each
+    # branch must terminate even for rates it will never be selected for
+    # (PTRS constants go negative below ~10 and its loop would never accept;
+    # Knuth needs ~rate iterations).
+
+    # Knuth: count uniforms until product drops below exp(-rate).  The
+    # loop condition is >= so that rate == 0 (limit 1.0) still runs one
+    # iteration and yields k = 0, not the -1 initializer.
+    def knuth(st):
+        limit = jnp.exp(-jnp.minimum(rate, 10.0))
+
+        def body(carry):
+            st, prod, k = carry
+            st, u = uniform01(st)
+            return st, prod * u, k + 1
+
+        st, _, k = lax.while_loop(
+            lambda c: c[1] >= limit, body, (st, _R(1.0), jnp.int64(-1))
+        )
+        return st, k
+
+    # PTRS (Hörmann 1993, "The transformed rejection method for generating
+    # Poisson random variables").
+    def ptrs(st):
+        r = jnp.maximum(rate, 10.0)  # clamped local; see note above
+        b = 0.931 + 2.53 * jnp.sqrt(r)
+        a = -0.059 + 0.02483 * b
+        inv_alpha = 1.1239 + 1.1328 / (b - 3.4)
+        v_r = 0.9277 - 3.6224 / (b - 2.0)
+        log_rate = jnp.log(r)
+
+        def cond(carry):
+            _, accepted, _ = carry
+            return ~accepted
+
+        def body(carry):
+            st, _, _ = carry
+            st, u = uniform01(st)
+            u = u - 0.5
+            st, v = uniform01(st)
+            us = 0.5 - jnp.abs(u)
+            k = jnp.floor((2.0 * a / us + b) * u + r + 0.43)
+            fast_accept = (us >= 0.07) & (v <= v_r)
+            bad = (k < 0.0) | ((us < 0.013) & (v > us))
+            lhs = jnp.log(v * inv_alpha / (a / (us * us) + b))
+            rhs = -r + k * log_rate - lax.lgamma(k + 1.0)
+            slow_accept = lhs <= rhs
+            accepted = fast_accept | (~bad & slow_accept)
+            return st, accepted, k
+
+        st, _, k = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
+        return st, k.astype(jnp.int64)
+
+    # lax.cond picks the right branch for scalar rates; under vmap with
+    # per-lane rates BOTH branches still run masked, which is why each
+    # branch clamps the rate to its own valid domain above.
+    return lax.cond(rate < 10.0, knuth, ptrs, st)
+
+
+def discrete_uniform(st, n):
+    """Integer in [0, n) (1 draw; 64-bit modulo, bias < 2^-32 for n < 2^32 —
+    the reference uses Lemire's nearly-divisionless trick which exists to
+    avoid CPU division, irrelevant here)."""
+    st, b0, b1 = next_bits64(st)
+    return st, (to_u64(b0, b1) % jnp.asarray(n, jnp.uint64)).astype(jnp.int64)
+
+
+def dice(st, a, b):
+    """Integer in [a, b] inclusive."""
+    st, i = discrete_uniform(st, jnp.asarray(b - a + 1, jnp.uint64))
+    return st, a + i
+
+
+def discrete_nonuniform(st, probs):
+    """Index i with probability probs[i]/sum(probs) (O(n) scan, 1 draw)."""
+    probs = jnp.asarray(probs, _R)
+    cdf = jnp.cumsum(probs)
+    st, u = uniform01(st)
+    target = u * cdf[-1]
+    idx = jnp.sum((cdf <= target).astype(jnp.int64))
+    return st, jnp.minimum(idx, probs.shape[0] - 1)
+
+
+def loaded_dice(st, a, b, probs):
+    """Integer in [a, b] with per-face weights; len(probs) must be b-a+1."""
+    probs = jnp.asarray(probs)
+    if isinstance(a, int) and isinstance(b, int):
+        if probs.shape[0] != b - a + 1:
+            raise ValueError(
+                f"loaded_dice needs {b - a + 1} weights for [{a}, {b}], "
+                f"got {probs.shape[0]}"
+            )
+    st, i = discrete_nonuniform(st, probs)
+    return st, a + i

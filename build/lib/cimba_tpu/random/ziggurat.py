@@ -1,0 +1,148 @@
+"""Ziggurat samplers for the standard exponential and normal.
+
+Reference parity: the reference's hot-path samplers
+(`include/cmb_random.h:207-216,325-335`, cold path `src/cmb_random.c:216-451`)
+are McFarland-variant ziggurats over 256-entry codegen tables.  This module
+is the TPU rendition over the tables from
+:mod:`cimba_tpu.codegen.make_ziggurat`.
+
+These are NOT the framework defaults: on TPU the branch-free inversion in
+:mod:`cimba_tpu.random.distributions` wins, because a vectorized ziggurat
+pays its rare-path cost on every batched draw (with R lanes, some lane
+rejects almost surely).  They exist for (a) component parity, (b) statistical
+cross-validation of the inversion samplers against an independent method,
+and (c) the Pallas kernel path, where the table lookups live in VMEM.
+
+Layer geometry (see make_ziggurat.py): X[j] increases with j, X[0]=0,
+X[255]=r, Y[j]=f(X[j]).  Layer j>=1 is the rectangle of width X[j] spanning
+y in [Y[j], Y[j-1]]; layer 0 is the base rectangle [0,r]x[0,f(r)] plus the
+tail beyond r.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from cimba_tpu import config
+from cimba_tpu.random import _ziggurat_tables as _t
+from cimba_tpu.random.bits import RandomState, next_bits64
+from cimba_tpu.random.distributions import std_exponential as _inv_exp
+from cimba_tpu.random.distributions import uniform01, uniform01_53
+
+_R = config.REAL
+
+def _tables():
+    """Trace-time table construction: the profile's dtype must be read at
+    trace time, not import time, or use_profile('f32') would silently mix
+    f64 tables into the computation."""
+    return (
+        jnp.asarray(_t.X_EXP, _R),
+        jnp.asarray(_t.Y_EXP, _R),
+        jnp.asarray(_t.X_NOR, _R),
+        jnp.asarray(_t.Y_NOR, _R),
+    )
+
+
+def _zig_draw(st, xtab, ytab, r, v, f, tail_sample):
+    """One ziggurat round-trip as a rejection while_loop (scalar-style).
+
+    Batched-execution model: every round computes ALL paths — hot accept,
+    y-test, and ``tail_sample`` — and selects, so each round consumes the
+    draws of every path (2 bits-draws + the tail's).  That is the price of
+    branch-free vectorization and exactly why the inversion samplers in
+    ``distributions.py`` are the TPU defaults; this sampler exists for
+    parity and cross-validation (see module docstring).
+    """
+
+    def cond(carry):
+        _, accepted, _ = carry
+        return ~accepted
+
+    def body(carry):
+        st, _, _ = carry
+        st, b0, b1 = next_bits64(st)
+        layer = (b0 & jnp.uint32(0xFF)).astype(jnp.int32)
+        u1 = b1.astype(_R) * _R(2.0**-32)
+
+        xj = xtab[layer]
+        # layer 0: base rectangle [0, r] x [0, f(r)] plus tail, sampled by
+        # the width trick: x uniform on [0, v/f(r)] accepts iff x < r.
+        base_w = _R(v) / ytab[255]
+        width = jnp.where(layer == 0, base_w, xj)
+        x = u1 * width
+
+        hot = x < jnp.where(layer == 0, _R(r), xtab[layer - 1])
+        # y test for interior layers (layer>=1, x between X[j-1] and X[j])
+        st, u2 = uniform01(st)
+        ylo = ytab[layer]
+        yhi = jnp.where(layer == 0, ytab[255], ytab[layer - 1])
+        y = ylo + u2 * (yhi - ylo)
+        interior_ok = (layer > 0) & (y < f(x))
+
+        # layer 0 miss -> tail sample (always accepted)
+        st, xt = tail_sample(st)
+        is_tail = (layer == 0) & ~hot
+
+        accepted = hot | interior_ok | is_tail
+        out = jnp.where(is_tail, xt, x)
+        return st, accepted, out
+
+    st, _, x = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
+    return st, x
+
+
+def std_exponential_zig(st: RandomState):
+    """Unit-mean exponential via 256-layer ziggurat."""
+
+    def tail(st):
+        # memoryless: tail beyond r is r + Exp(1), exactly
+        st, e = _inv_exp(st)
+        return st, _R(_t.R_EXP) + e
+
+    x_exp, y_exp, _, _ = _tables()
+    return _zig_draw(
+        st,
+        x_exp,
+        y_exp,
+        _t.R_EXP,
+        _t.V_EXP,
+        lambda x: jnp.exp(-x),
+        tail,
+    )
+
+
+def std_normal_zig(st: RandomState):
+    """Standard normal via 256-layer ziggurat (half-normal + random sign)."""
+
+    def tail(st):
+        # Marsaglia's tail method: x = -ln(u1)/r, y = -ln(u2),
+        # accept when 2y > x^2; result r + x.
+        def cond(carry):
+            _, accepted, _ = carry
+            return ~accepted
+
+        def body(carry):
+            st, _, _ = carry
+            st, u1 = uniform01_53(st)
+            st, u2 = uniform01_53(st)
+            x = -jnp.log(jnp.maximum(u1, 1e-300)) / _R(_t.R_NOR)
+            y = -jnp.log(jnp.maximum(u2, 1e-300))
+            return st, 2.0 * y > x * x, _R(_t.R_NOR) + x
+
+        st, _, x = lax.while_loop(cond, body, (st, jnp.bool_(False), _R(0.0)))
+        return st, x
+
+    _, _, x_nor, y_nor = _tables()
+    st, x = _zig_draw(
+        st,
+        x_nor,
+        y_nor,
+        _t.R_NOR,
+        _t.V_NOR,
+        lambda x: jnp.exp(-0.5 * x * x),
+        tail,
+    )
+    st, b0, _ = next_bits64(st)
+    sign = jnp.where((b0 & jnp.uint32(1)) == 0, _R(1.0), _R(-1.0))
+    return st, sign * x

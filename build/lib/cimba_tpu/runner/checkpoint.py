@@ -92,14 +92,7 @@ def restore(path: str, like: Any, *, tag: Optional[str] = None) -> Any:
     leaves, treedef = _flatten(like)
     with np.load(path) as data:
         names = [f for f in data.files if f != "__spec__"]
-        if "__spec__" not in data.files:
-            if tag is not None:
-                raise ValueError(
-                    "checkpoint has no spec fingerprint (written by a "
-                    "pre-fingerprint save?) but tag verification was "
-                    "requested — cannot prove it matches this spec"
-                )
-        else:
+        if "__spec__" in data.files:
             saved = json.loads(bytes(data["__spec__"]).decode())
             if saved.get("format") != _FORMAT:
                 raise ValueError(

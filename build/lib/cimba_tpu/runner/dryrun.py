@@ -1,0 +1,123 @@
+"""In-process body of the multi-chip dry run (see ``__graft_entry__``).
+
+This module is imported by a *subprocess* whose environment already forces
+the CPU backend with ``--xla_force_host_platform_device_count=N`` — the
+dry run is a correctness check of the sharded program on a virtual mesh,
+and must stay green regardless of real-accelerator/tunnel state.  Keep jax
+imports inside the function so importing this module never touches a
+backend.
+"""
+
+from __future__ import annotations
+
+
+def run_dryrun(n_devices: int) -> None:
+    """Full experiment step over an ``n_devices`` mesh: replications shard
+    over the 'rep' axis (the DES analog of data parallelism — a discrete-
+    event simulator has no tensor/pipeline dims; its scale axes are
+    replications across chips and, later, intra-trial agents across lanes),
+    with per-shard Pébay statistics merged via all_gather and scalar
+    counters via psum over the mesh.  One step on tiny shapes.
+    """
+    import jax
+
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    mesh = ex.make_mesh(n_devices)
+    spec, _ = mm1.build()
+    # volume matters: 32 reps/device x 50 objects is enough to catch a
+    # cross-shard statistics bug (wrong merge weights, shard overlap,
+    # dropped shard) that a smoke-sized run would slip past
+    reps = 32 * n_devices
+    fn = ex.make_sharded_experiment(spec, reps, mesh)
+    pooled, n_failed, events = jax.block_until_ready(
+        fn(mm1.params(50), seed=1)
+    )
+    assert int(n_failed) == 0, f"dryrun had failed replications: {n_failed}"
+    assert int(pooled.n) == reps * 50, int(pooled.n)
+    mean = float(sm.mean(pooled))
+    assert mean > 0.0
+    if n_devices == 8:
+        # golden pooled mean for the canonical driver configuration
+        # (f64 path, seed=1, 256 reps x 50 objects): device placement
+        # must not leak into pooled statistics
+        golden = 4.342174158607185
+        assert abs(mean - golden) <= 1e-9 * golden, (mean, golden)
+
+    # the Pallas kernel path over the same mesh (interpret mode on the
+    # virtual devices; Mosaic-compiled on real chips): per-device chunk
+    # kernels under shard_map must agree with the XLA path's event counts
+    kernel_events = _dryrun_kernel_mesh(mesh, n_devices)
+    # the flagship (AWACS) through kernel + boundary blocks over the
+    # mesh: DES chunks shard per device, the MXU dwell scorer applies
+    # between chunks on the sharded batch — the full v5e-8 shape
+    awacs_events = _dryrun_awacs_mesh(mesh, n_devices)
+    print(
+        f"dryrun_multichip OK: {n_devices} devices, "
+        f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}, "
+        f"kernel-mesh events {kernel_events}, "
+        f"awacs-boundary-mesh events {awacs_events}",
+        flush=True,
+    )
+
+
+def _dryrun_model_mesh(mesh, n_devices: int, build, params, label) -> int:
+    """Sharded mega-kernel dry run for one model: f32 profile, lanes
+    split over the mesh, bitwise-compared against the single-device
+    kernel run."""
+    import jax
+    import jax.numpy as jnp
+
+    from cimba_tpu import config
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.core import pallas_run as pr
+
+    with config.profile("f32"):
+        spec, _ = build()
+
+        def one(rep):
+            return cl.init_sim(spec, 2026, rep, params)
+
+        sims = jax.jit(jax.vmap(one))(jnp.arange(2 * n_devices))
+        interp = jax.default_backend() != "tpu"
+        single = pr.make_kernel_run(
+            spec, chunk_steps=32, interpret=interp
+        )(sims)
+        sharded = pr.make_kernel_run(
+            spec, chunk_steps=32, interpret=interp, mesh=mesh
+        )(sims)
+        assert bool((single.n_events == sharded.n_events).all()), label
+        assert bool((single.clock == sharded.clock).all()), label
+        assert int(sharded.err.sum()) == 0, f"{label} dryrun errors"
+        return int(sharded.n_events.sum())
+
+
+def _dryrun_kernel_mesh(mesh, n_devices: int) -> int:
+    from cimba_tpu.models import mm1
+
+    return _dryrun_model_mesh(
+        mesh, n_devices,
+        build=lambda: mm1.build(record=False),
+        params=(1.0 / 0.9, 1.0, 20),
+        label="kernel-mesh",
+    )
+
+
+def _dryrun_awacs_mesh(mesh, n_devices: int) -> int:
+    """Flagship: AWACS (boundary-block NN physics) sharded over the mesh."""
+    from cimba_tpu.models import awacs
+
+    return _dryrun_model_mesh(
+        mesh, n_devices,
+        build=lambda: awacs.build(8),
+        params=awacs.params(1.0),
+        label="awacs-mesh",
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    run_dryrun(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

@@ -132,7 +132,7 @@ def run_experiment_regrow(
 ):
     """``run_experiment`` with the capacity escape hatch: if any
     replication died with ``ERR_EVENT_OVERFLOW``/``ERR_GUARD_OVERFLOW``,
-    double the event cap and re-run the WHOLE batch under the grown
+    double both capacities and re-run the WHOLE batch under the grown
     spec (a re-jit at the larger shapes).
 
     Reference parity: the reference's hashheap grows amortized-doubling
@@ -152,8 +152,7 @@ def run_experiment_regrow(
 
     from cimba_tpu.core import loop as _cl
 
-    # dense guards cannot overflow; the event table is the one growable cap
-    grow_errs = (_cl.ERR_EVENT_OVERFLOW,)
+    grow_errs = (_cl.ERR_EVENT_OVERFLOW, _cl.ERR_GUARD_OVERFLOW)
     for n_regrows in range(max_regrows + 1):
         result = run_experiment(
             spec, params, n_replications, seed=seed, mesh=mesh, t_end=t_end
@@ -163,13 +162,15 @@ def run_experiment_regrow(
             return result, spec, n_regrows
         if n_regrows < max_regrows:
             spec = dataclasses.replace(
-                spec, event_cap=2 * spec.event_cap,
+                spec,
+                event_cap=2 * spec.event_cap,
+                guard_cap=2 * spec.guard_cap,
             )
     raise RuntimeError(
         f"run_experiment_regrow: capacity overflow persists after "
-        f"{max_regrows} doublings (last run at event_cap={spec.event_cap}) "
-        "— the model schedules unboundedly or the cap estimate is "
-        "pathologically low"
+        f"{max_regrows} doublings (last run at event_cap={spec.event_cap}, "
+        f"guard_cap={spec.guard_cap}) — the model schedules unboundedly "
+        "or the cap estimate is pathologically low"
     )
 
 

@@ -1,0 +1,31 @@
+"""cimba-tpu statistics subsystem.
+
+Parity with the reference's L0 statistics components (SURVEY.md §2 #21-24):
+``cmb_datasummary`` / ``cmb_wtdsummary`` -> :mod:`cimba_tpu.stats.summary`
+(one weighted-merge implementation serves both), ``cmb_dataset`` ->
+:mod:`cimba_tpu.stats.dataset`, ``cmb_timeseries`` ->
+:mod:`cimba_tpu.stats.timeseries` (plus the streaming StepAccum used by the
+jitted event loop).
+"""
+
+from cimba_tpu.stats import dataset, summary, timeseries
+from cimba_tpu.stats.summary import (
+    Summary,
+    add,
+    empty,
+    kurtosis,
+    mean,
+    merge,
+    merge_tree,
+    skewness,
+    stddev,
+    variance,
+)
+from cimba_tpu.stats.timeseries import (
+    StepAccum,
+    step_create,
+    step_finalize,
+    step_record,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,215 @@
+"""Fixed-capacity sample datasets: order statistics, histograms, ACF/PACF.
+
+Reference parity: ``cmb_dataset`` (`src/cmb_dataset.c`, header
+`include/cmb_dataset.h:258-307`): growable array of doubles with sort,
+median, five-number summary, text histogram, ACF/PACF correlogram, copy,
+merge, summarize.
+
+TPU redesign: the array is **fixed capacity** (no realloc under jit — the
+same constraint that shapes the event heap, SURVEY.md §7 hard part (b));
+``n`` tracks fill, overflow sets a flag and drops samples (counted).  Device
+math is jit/vmap-friendly; the ``*_print`` renderings are host-side NumPy,
+mirroring the reference's debug-print layer.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu import config
+from cimba_tpu.stats import summary as _sm
+
+_R = config.REAL
+
+
+class Dataset(NamedTuple):
+    values: jnp.ndarray   # [CAP] f64; slots >= n hold +inf (sort-friendly)
+    n: jnp.ndarray        # i32 fill count
+    dropped: jnp.ndarray  # i32 samples lost to overflow
+
+
+def create(capacity: int) -> Dataset:
+    return Dataset(
+        values=jnp.full((capacity,), jnp.inf, _R),
+        n=jnp.zeros((), jnp.int32),
+        dropped=jnp.zeros((), jnp.int32),
+    )
+
+
+def add(ds: Dataset, x) -> Dataset:
+    cap = ds.values.shape[0]
+    ok = ds.n < cap
+    idx = jnp.minimum(ds.n, cap - 1)
+    vals = ds.values.at[idx].set(
+        jnp.where(ok, jnp.asarray(x, _R), ds.values[idx])
+    )
+    return Dataset(
+        values=vals,
+        n=ds.n + jnp.where(ok, 1, 0).astype(jnp.int32),
+        dropped=ds.dropped + jnp.where(ok, 0, 1).astype(jnp.int32),
+    )
+
+
+def merge(a: Dataset, b: Dataset) -> Dataset:
+    """Concatenate b's samples into a (capacity permitting)."""
+    cap = a.values.shape[0]
+    # Scatter b's first b.n values after a's fill point.
+    idx_b = jnp.arange(b.values.shape[0])
+    dest = a.n + idx_b
+    takes = (idx_b < b.n) & (dest < cap)
+    vals = a.values.at[jnp.minimum(dest, cap - 1)].set(
+        jnp.where(takes, b.values, a.values[jnp.minimum(dest, cap - 1)]),
+        mode="drop",
+    )
+    n_new = jnp.minimum(a.n + b.n, cap)
+    dropped = a.dropped + b.dropped + (a.n + b.n - n_new)
+    return Dataset(vals, n_new.astype(jnp.int32), dropped.astype(jnp.int32))
+
+
+def _mask(ds: Dataset):
+    return jnp.arange(ds.values.shape[0]) < ds.n
+
+
+def sort(ds: Dataset) -> Dataset:
+    """Ascending sort; empty slots are +inf so they stay at the tail."""
+    return ds._replace(values=jnp.sort(ds.values))
+
+
+def mean(ds: Dataset):
+    m = _mask(ds)
+    return jnp.sum(jnp.where(m, ds.values, 0.0)) / jnp.maximum(ds.n, 1)
+
+
+def quantile(ds: Dataset, q):
+    """Linear-interpolated quantile of the filled prefix (expects any order;
+    sorts internally)."""
+    v = jnp.sort(ds.values)
+    pos = q * (ds.n.astype(_R) - 1.0)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, ds.values.shape[0] - 1)
+    hi = jnp.clip(lo + 1, 0, jnp.maximum(ds.n - 1, 0))
+    frac = pos - lo.astype(_R)
+    return v[lo] * (1.0 - frac) + v[hi] * frac
+
+
+def median(ds: Dataset):
+    return quantile(ds, 0.5)
+
+
+def fivenum(ds: Dataset):
+    """(min, Q1, median, Q3, max) of the filled prefix."""
+    v = jnp.sort(ds.values)
+    mx = v[jnp.maximum(ds.n - 1, 0)]
+    return (
+        v[0],
+        quantile(ds, 0.25),
+        quantile(ds, 0.5),
+        quantile(ds, 0.75),
+        mx,
+    )
+
+
+def summarize(ds: Dataset) -> _sm.Summary:
+    """Fold the dataset into a moment Summary (one vectorized pass)."""
+    m = _mask(ds)
+    v = jnp.where(m, ds.values, 0.0)
+    w = m.astype(_R)
+    n = ds.n.astype(_R)
+    safe_n = jnp.maximum(n, 1.0)
+    mu = jnp.sum(v) / safe_n
+    c = jnp.where(m, ds.values - mu, 0.0)
+    return _sm.Summary(
+        n=n,
+        w=n,
+        mn=jnp.min(jnp.where(m, ds.values, jnp.inf)),
+        mx=jnp.max(jnp.where(m, ds.values, -jnp.inf)),
+        m1=mu,
+        m2=jnp.sum(c * c),
+        m3=jnp.sum(c**3),
+        m4=jnp.sum(c**4),
+    )
+
+
+def acf(ds: Dataset, max_lag: int):
+    """Autocorrelation function for lags 0..max_lag (biased estimator,
+    standard for correlograms).  Parity: ``cmb_dataset_ACF``."""
+    m = _mask(ds)
+    n = jnp.maximum(ds.n.astype(_R), 1.0)
+    mu = jnp.sum(jnp.where(m, ds.values, 0.0)) / n
+    c = jnp.where(m, ds.values - mu, 0.0)
+    denom = jnp.maximum(jnp.sum(c * c), 1e-300)
+
+    def lag_corr(k):
+        shifted = jnp.roll(c, -k)
+        # zero the wrapped tail: positions >= n - k are invalid
+        valid = jnp.arange(c.shape[0]) < (ds.n - k)
+        return jnp.sum(jnp.where(valid, c * shifted, 0.0)) / denom
+
+    return jnp.stack([lag_corr(k) for k in range(max_lag + 1)])
+
+
+def pacf(ds: Dataset, max_lag: int):
+    """Partial autocorrelations for lags 1..max_lag via Durbin–Levinson.
+    Parity: ``cmb_dataset_PACF``.  ``max_lag`` is static, so the recursion
+    unrolls at trace time over scalar tracers."""
+    rho = acf(ds, max_lag)
+    phi = {}  # phi[(k, j)]: AR(k) coefficient j
+    pacfs = []
+    for k in range(1, max_lag + 1):
+        if k == 1:
+            phi_kk = rho[1]
+        else:
+            num = rho[k] - sum(
+                phi[(k - 1, j)] * rho[k - j] for j in range(1, k)
+            )
+            den = 1.0 - sum(
+                phi[(k - 1, j)] * rho[j] for j in range(1, k)
+            )
+            phi_kk = num / jnp.where(jnp.abs(den) > 1e-300, den, 1e-300)
+        for j in range(1, k):
+            phi[(k, j)] = phi[(k - 1, j)] - phi_kk * phi[(k - 1, k - j)]
+        phi[(k, k)] = phi_kk
+        pacfs.append(phi_kk)
+    return jnp.stack(pacfs)
+
+
+# --- host-side text rendering (parity: cmb_dataset_*_print) -----------------
+
+
+def histogram_str(ds: Dataset, bins: int = 20, width: int = 50) -> str:
+    v = np.asarray(ds.values)[: int(ds.n)]
+    if v.size == 0:
+        return "(empty dataset)"
+    counts, edges = np.histogram(v, bins=bins)
+    peak = max(counts.max(), 1)
+    lines = []
+    for c, lo, hi in zip(counts, edges[:-1], edges[1:]):
+        bar = "#" * int(round(width * c / peak))
+        lines.append(f"[{lo:12.5g}, {hi:12.5g}) {c:8d} {bar}")
+    return "\n".join(lines)
+
+
+def fivenum_str(ds: Dataset) -> str:
+    mn, q1, md, q3, mx = (float(x) for x in fivenum(ds))
+    return (
+        f"min {mn:.6g}  Q1 {q1:.6g}  median {md:.6g}  "
+        f"Q3 {q3:.6g}  max {mx:.6g}"
+    )
+
+
+def correlogram_str(ds: Dataset, max_lag: int = 20, width: int = 40) -> str:
+    rho = np.asarray(acf(ds, max_lag))
+    lines = []
+    half = width // 2
+    for k, r in enumerate(rho):
+        pos = int(round(half + r * half))
+        line = [" "] * (width + 1)
+        line[half] = "|"
+        lo, hi = sorted((half, pos))
+        for i in range(lo, hi + 1):
+            line[i] = "*" if i != half else "|"
+        lines.append(f"lag {k:3d} {r:+.4f} {''.join(line)}")
+    return "\n".join(lines)

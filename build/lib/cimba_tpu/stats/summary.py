@@ -1,0 +1,158 @@
+"""Streaming moment summaries with associative merge.
+
+Reference parity: ``cmb_datasummary`` (`src/cmb_datasummary.c:77-166`) and
+``cmb_wtdsummary`` (`src/cmb_wtdsummary.c:83-195`) — one-pass streaming
+count/min/max/M1..M4 with Pébay's pairwise merge, which the reference uses
+to combine per-pthread results and this framework uses to combine
+per-replication results across lanes and chips.
+
+Design notes (TPU-first):
+
+* One implementation serves both: the unweighted summary is the weighted
+  one with unit weights.  A single sample is a degenerate summary
+  ``(w, x, 0, 0, 0)``, so ``add`` is ``merge`` with a singleton — the Pébay
+  weighted-merge formulas (2008 for counts, 2016 for weights) are the only
+  moment math in the framework.
+* Central-moment accumulation (not raw power sums) so within-replication
+  streams stay numerically stable even when mean >> stddev.
+* ``merge`` is associative and commutative up to float rounding.  Across
+  lanes use :func:`merge_tree` (binary reduction, log2 steps under jit);
+  across devices ``all_gather`` the tiny summaries and fold — ``psum``
+  only sums, and moment merging is not a plain sum.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu import config
+
+_R = config.REAL
+
+
+class Summary(NamedTuple):
+    """Moment summary — weighted (``w`` = total weight) or unweighted
+    (``w`` = count); ``n`` tracks the number of samples in either case."""
+
+    n: jnp.ndarray      # sample count (f64 for pytree homogeneity)
+    w: jnp.ndarray      # total weight (== n for unweighted use)
+    mn: jnp.ndarray     # min sample value
+    mx: jnp.ndarray     # max sample value
+    m1: jnp.ndarray     # weighted mean
+    m2: jnp.ndarray     # sum of w * (x - m1)^2
+    m3: jnp.ndarray     # sum of w * (x - m1)^3
+    m4: jnp.ndarray     # sum of w * (x - m1)^4
+
+
+def empty() -> Summary:
+    z = jnp.zeros((), _R)
+    return Summary(z, z, jnp.asarray(jnp.inf, _R), jnp.asarray(-jnp.inf, _R), z, z, z, z)
+
+
+def merge(a: Summary, b: Summary) -> Summary:
+    """Pébay pairwise merge; exact for empty operands."""
+    w = a.w + b.w
+    # Guard the empty-side divisions; jnp.where keeps it branch-free.
+    safe_w = jnp.where(w > 0.0, w, _R(1.0))
+    d = b.m1 - a.m1
+    frac_b = b.w / safe_w
+    m1 = a.m1 + d * frac_b
+    wa_wb = a.w * b.w
+    m2 = a.m2 + b.m2 + d * d * wa_wb / safe_w
+    m3 = (
+        a.m3
+        + b.m3
+        + d**3 * wa_wb * (a.w - b.w) / safe_w**2
+        + 3.0 * d * (a.w * b.m2 - b.w * a.m2) / safe_w
+    )
+    m4 = (
+        a.m4
+        + b.m4
+        + d**4 * wa_wb * (a.w * a.w - wa_wb + b.w * b.w) / safe_w**3
+        + 6.0 * d * d * (a.w * a.w * b.m2 + b.w * b.w * a.m2) / safe_w**2
+        + 4.0 * d * (a.w * b.m3 - b.w * a.m3) / safe_w
+    )
+    # An empty side must not perturb the other (d may involve junk m1=0).
+    take_a = b.w == 0.0
+    take_b = a.w == 0.0
+    pick = lambda ma, mb, mm: jnp.where(take_a, ma, jnp.where(take_b, mb, mm))
+    return Summary(
+        n=a.n + b.n,
+        w=w,
+        mn=jnp.minimum(a.mn, b.mn),
+        mx=jnp.maximum(a.mx, b.mx),
+        m1=pick(a.m1, b.m1, m1),
+        m2=pick(a.m2, b.m2, m2),
+        m3=pick(a.m3, b.m3, m3),
+        m4=pick(a.m4, b.m4, m4),
+    )
+
+
+def add(s: Summary, x, weight=1.0) -> Summary:
+    """Add one (weighted) sample: merge with a singleton summary."""
+    x = jnp.asarray(x, _R)
+    w = jnp.asarray(weight, _R)
+    z = jnp.zeros((), _R)
+    single = Summary(jnp.asarray(1.0, _R), w, x, x, x, z, z, z)
+    return merge(s, single)
+
+
+def merge_tree(summaries: Summary) -> Summary:
+    """Reduce a batched Summary (leading axis R) to one via binary tree.
+
+    R need not be a power of two; odd tails fold into element 0.  Runs in
+    log2(R) vectorized merge steps under jit — the TPU analog of the
+    reference merging per-thread summaries on the main thread.
+    """
+    import jax
+
+    r = jax.tree.leaves(summaries)[0].shape[0]
+    while r > 1:
+        half = r // 2
+        lo = jax.tree.map(lambda x: x[:half], summaries)
+        hi = jax.tree.map(lambda x: x[half : 2 * half], summaries)
+        merged = jax.vmap(merge)(lo, hi)
+        if r % 2:
+            odd = jax.tree.map(lambda x: x[r - 1], summaries)
+            first = jax.tree.map(lambda x: x[0], merged)
+            folded = merge(first, odd)
+            merged = jax.tree.map(
+                lambda m, f: m.at[0].set(f), merged, folded
+            )
+        summaries = merged
+        r = half
+    return jax.tree.map(lambda x: x[0], summaries)
+
+
+# --- derived statistics (parity: cmb_datasummary_* accessors) ---------------
+
+
+def mean(s: Summary):
+    return s.m1
+
+
+def variance(s: Summary):
+    """Sample variance with frequency weights: m2 / (w - 1)."""
+    return s.m2 / jnp.maximum(s.w - 1.0, 1e-300)
+
+
+def pop_variance(s: Summary):
+    return s.m2 / jnp.maximum(s.w, 1e-300)
+
+
+def stddev(s: Summary):
+    return jnp.sqrt(variance(s))
+
+
+def skewness(s: Summary):
+    """Population skewness g1 = (m3/w) / (m2/w)^1.5."""
+    w = jnp.maximum(s.w, 1e-300)
+    return (s.m3 / w) / jnp.maximum((s.m2 / w) ** 1.5, 1e-300)
+
+
+def kurtosis(s: Summary):
+    """Population kurtosis g2 = (m4/w) / (m2/w)^2 (3.0 for a normal)."""
+    w = jnp.maximum(s.w, 1e-300)
+    return (s.m4 / w) / jnp.maximum((s.m2 / w) ** 2, 1e-300)

@@ -1,0 +1,132 @@
+"""Time-weighted series recording (piecewise-constant signals).
+
+Reference parity: ``cmb_timeseries`` (`src/cmb_timeseries.c:106-188`) —
+a dataset plus parallel time/duration arrays where each recorded value is
+assumed to hold until the next record; ``finalize(t)`` closes the last
+interval and ``summarize`` produces a weighted summary.  Used by every
+L5 component for utilization / queue-length statistics.
+
+Two TPU renditions:
+
+* :class:`StepAccum` — the hot-loop form.  Streams segments directly into
+  a weighted :class:`~cimba_tpu.stats.summary.Summary` (O(1) state).  This
+  is what resources/queues carry inside the jitted event loop.
+* :class:`Timeseries` — the full recorder with fixed-capacity (time, value)
+  arrays for post-analysis (histograms, inspection), mirroring the
+  reference's array-of-everything layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.stats import summary as _sm
+
+_R = config.REAL
+
+
+class StepAccum(NamedTuple):
+    """Streaming time-weighted accumulator for a piecewise-constant signal."""
+
+    summary: _sm.Summary
+    last_t: jnp.ndarray
+    last_v: jnp.ndarray
+    started: jnp.ndarray  # bool: has any record happened
+
+
+def step_create(t0=0.0, v0=0.0) -> StepAccum:
+    return StepAccum(
+        summary=_sm.empty(),
+        last_t=jnp.asarray(t0, _R),
+        last_v=jnp.asarray(v0, _R),
+        started=jnp.asarray(False),
+    )
+
+
+def step_record(acc: StepAccum, t, v) -> StepAccum:
+    """Record signal value ``v`` effective at time ``t``; the previous value
+    is credited with weight (t - last_t)."""
+    t = jnp.asarray(t, _R)
+    dur = jnp.maximum(t - acc.last_t, 0.0)
+    new_sum = _sm.add(acc.summary, acc.last_v, dur)
+    # zero-duration segments contribute nothing but must not corrupt moments
+    summary = _sm.Summary(*[
+        jnp.where(dur > 0.0, a, b) for a, b in zip(new_sum, acc.summary)
+    ])
+    return StepAccum(
+        summary=summary,
+        last_t=t,
+        last_v=jnp.asarray(v, _R),
+        started=jnp.asarray(True),
+    )
+
+
+def step_finalize(acc: StepAccum, t_end) -> _sm.Summary:
+    """Close the last interval at ``t_end`` and return the weighted summary."""
+    closed = _sm.add(acc.summary, acc.last_v, jnp.maximum(jnp.asarray(t_end, _R) - acc.last_t, 0.0))
+    return closed
+
+
+class Timeseries(NamedTuple):
+    times: jnp.ndarray    # [CAP]
+    values: jnp.ndarray   # [CAP]
+    n: jnp.ndarray        # i32
+    dropped: jnp.ndarray  # i32
+
+
+def create(capacity: int, t0=0.0) -> Timeseries:
+    return Timeseries(
+        times=jnp.full((capacity,), jnp.asarray(t0, _R)),
+        values=jnp.zeros((capacity,), _R),
+        n=jnp.zeros((), jnp.int32),
+        dropped=jnp.zeros((), jnp.int32),
+    )
+
+
+def add(ts: Timeseries, t, v) -> Timeseries:
+    cap = ts.times.shape[0]
+    ok = ts.n < cap
+    idx = jnp.minimum(ts.n, cap - 1)
+    return Timeseries(
+        times=ts.times.at[idx].set(jnp.where(ok, jnp.asarray(t, _R), ts.times[idx])),
+        values=ts.values.at[idx].set(jnp.where(ok, jnp.asarray(v, _R), ts.values[idx])),
+        n=ts.n + jnp.where(ok, 1, 0).astype(jnp.int32),
+        dropped=ts.dropped + jnp.where(ok, 0, 1).astype(jnp.int32),
+    )
+
+
+def durations(ts: Timeseries, t_end):
+    """Piecewise-constant durations: value i holds from times[i] to
+    times[i+1] (last until t_end).  Parity: `src/cmb_timeseries.c:106-157`."""
+    cap = ts.times.shape[0]
+    idx = jnp.arange(cap)
+    nxt = jnp.where(
+        idx + 1 < ts.n,
+        jnp.roll(ts.times, -1),
+        jnp.asarray(t_end, _R),
+    )
+    dur = jnp.where(idx < ts.n, nxt - ts.times, 0.0)
+    return jnp.maximum(dur, 0.0)
+
+
+def summarize(ts: Timeseries, t_end) -> _sm.Summary:
+    """Weighted summary of the recorded signal over [times[0], t_end]."""
+    dur = durations(ts, t_end)
+    mask = dur > 0.0
+    w = jnp.sum(dur)
+    safe_w = jnp.maximum(w, 1e-300)
+    mu = jnp.sum(ts.values * dur) / safe_w
+    c = jnp.where(mask, ts.values - mu, 0.0)
+    return _sm.Summary(
+        n=ts.n.astype(_R),
+        w=w,
+        mn=jnp.min(jnp.where(mask, ts.values, jnp.inf)),
+        mx=jnp.max(jnp.where(mask, ts.values, -jnp.inf)),
+        m1=mu,
+        m2=jnp.sum(dur * c * c),
+        m3=jnp.sum(dur * c**3),
+        m4=jnp.sum(dur * c**4),
+    )

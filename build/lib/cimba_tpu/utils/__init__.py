@@ -1,0 +1,5 @@
+"""cimba-tpu utilities: logging, contracts, seeding, debug dumps."""
+
+from cimba_tpu.utils import dbc, debug, logger, seed
+
+__all__ = ["dbc", "debug", "logger", "seed"]

@@ -1,0 +1,62 @@
+"""Design-by-contract assertions, three tiers, statically gated.
+
+Reference parity: ``cmb_assert`` (`include/cmb_assert.h:45-84`) —
+``cmb_assert_debug`` (off at NDEBUG), ``cmb_assert_release`` (off at
+NASSERT), ``cmb_assert_always``; ~13% of the reference's lines are asserts
+and disabling the debug tier is a documented ~2x speedup.
+
+TPU rendition: tiers are trace-time flags (env ``CIMBA_NDEBUG`` /
+``CIMBA_NASSERT`` or :func:`configure`), so a disabled tier traces to
+nothing — the same zero-cost compile-out, per jit instead of per build.
+An enabled assertion folds its predicate into the replication's failure
+flag (`sim.err`), which freezes that replication and is counted by the
+runner — batch-safe "abort", no host sync in the hot loop.
+
+For Python-time (model construction) invariants use plain ``assert`` /
+``raise`` — those run eagerly anyway.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cimba_tpu.core.loop import ERR_USER, Sim
+
+_ndebug = bool(int(os.environ.get("CIMBA_NDEBUG", "0")))
+_nassert = bool(int(os.environ.get("CIMBA_NASSERT", "0")))
+
+
+def configure(*, ndebug: bool | None = None, nassert: bool | None = None):
+    """Flip assertion tiers (re-jit afterwards, like a rebuild)."""
+    global _ndebug, _nassert
+    if ndebug is not None:
+        _ndebug = ndebug
+    if nassert is not None:
+        _nassert = nassert
+
+
+def _check(sim: Sim, pred) -> Sim:
+    from cimba_tpu.core import api
+
+    return api.fail(sim, ~pred)
+
+
+def assert_debug(sim: Sim, pred) -> Sim:
+    """Heavyweight invariant checks; off under CIMBA_NDEBUG (parity:
+    cmb_assert_debug)."""
+    if _ndebug:
+        return sim
+    return _check(sim, pred)
+
+
+def assert_release(sim: Sim, pred) -> Sim:
+    """Precondition checks; off under CIMBA_NASSERT (parity:
+    cmb_assert_release)."""
+    if _nassert:
+        return sim
+    return _check(sim, pred)
+
+
+def assert_always(sim: Sim, pred) -> Sim:
+    """Never compiled out (parity: cmb_assert_always)."""
+    return _check(sim, pred)

@@ -1,0 +1,74 @@
+"""Host-side state dumps for debugging models.
+
+Reference parity: ``cmb_event_queue_print`` (`src/cmb_event.c:510-532`),
+``cmi_hashheap_print`` (`src/cmi_hashheap.c:895-937`) and the golden-file
+event dumps in `test/reference/event.txt`.  These render a (single
+replication's) Sim — fetch one lane with
+``jax.tree.map(lambda x: x[r], sims)`` first if batched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import ModelSpec
+
+
+_KIND_NAMES = {0: "PROC", 1: "TIMER"}
+_STATUS = {0: "CREATED", 1: "RUNNING", 2: "FINISHED"}
+
+
+def eventset_str(sim, spec: ModelSpec | None = None) -> str:
+    """Pending events in firing order (parity: cmb_event_queue_print)."""
+    es = sim.events
+    t = np.asarray(es.time)
+    live = np.isfinite(t)
+    rows = []
+    order = sorted(
+        np.nonzero(live)[0],
+        key=lambda i: (t[i], -int(es.prio[i]), int(es.seq[i])),
+    )
+    for i in order:
+        kind = int(es.kind[i])
+        kname = _KIND_NAMES.get(kind, f"user{kind}")
+        subj = int(es.subj[i])
+        name = (
+            spec.proc_names[subj]
+            if spec and kind <= 1 and subj < len(spec.proc_names)
+            else str(subj)
+        )
+        rows.append(
+            f"  t={t[i]:<14.6f} prio={int(es.prio[i]):<4d} "
+            f"seq={int(es.seq[i]):<6d} {kname:<6s} subj={name} "
+            f"arg={int(es.arg[i])}"
+        )
+    head = f"event set: {len(rows)} pending, next_seq={int(es.next_seq)}"
+    return "\n".join([head] + rows)
+
+
+def procs_str(sim, spec: ModelSpec | None = None) -> str:
+    """Process table (parity: the per-process state the logger prints)."""
+    ps = sim.procs
+    rows = ["pid name            status    pc   prio pend  guard await"]
+    for p in range(ps.pc.shape[0]):
+        name = spec.proc_names[p] if spec else f"p{p}"
+        pend = int(ps.pend_tag[p])
+        rows.append(
+            f"{p:<3d} {name:<15s} {_STATUS.get(int(ps.status[p]), '?'):<9s} "
+            f"{int(ps.pc[p]):<4d} {int(ps.prio[p]):<4d} "
+            f"{pend if pend != int(pr.NO_PEND) else '-':<5} "
+            f"{int(ps.pend_guard[p]):<5d} {int(ps.await_pid[p])}"
+        )
+    return "\n".join(rows)
+
+
+def sim_str(sim, spec: ModelSpec | None = None) -> str:
+    """One-replication overview."""
+    return (
+        f"clock={float(sim.clock):.6f} err={int(sim.err)} "
+        f"done={bool(sim.done)} events_dispatched={int(sim.n_events)}\n"
+        + eventset_str(sim, spec)
+        + "\n"
+        + procs_str(sim, spec)
+    )

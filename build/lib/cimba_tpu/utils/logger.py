@@ -1,0 +1,177 @@
+"""Flag-mask logging from inside jitted simulation code.
+
+Reference parity: ``cmb_logger`` (`src/cmb_logger.c`) — a 32-bit flag mask
+(4 reserved levels + 28 user bits), line format
+``[trial] [seed] time process func: msg``, INFO compiled out by
+``-DNLOGINFO``, ``error`` triggering per-trial recovery.
+
+TPU rendition: the mask is *trace-time* state.  A disabled level costs
+literally nothing (the call traces to no ops — the NLOGINFO story without
+a rebuild of the library, just a re-jit); an enabled level lowers to
+``jax.debug.print`` host callbacks carrying the replication clock and pid.
+``error`` additionally sets the replication's failure flag — the analog of
+the reference's longjmp-to-worker recovery (§3.5), minus the longjmp.
+
+Changing flags affects subsequently *traced* code: re-jit (or clear jit
+caches) after flipping levels, exactly as the reference requires a
+recompile for NLOGINFO.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# reserved level bits (parity: CMB_LOGGER_* flag values)
+FATAL = 1 << 0
+ERROR = 1 << 1
+WARNING = 1 << 2
+INFO = 1 << 3
+#: first free user bit (28 available, parity with the reference's layout)
+USER = 1 << 4
+
+_mask = FATAL | ERROR | WARNING  # INFO off by default, like release builds
+
+# settable time formatter (parity: cmb_logger_timeformatter_set,
+# `src/cmb_logger.c:94-112`): a host-side ``fn(float) -> str``; None = the
+# default fixed-width rendering
+_timeformatter = None
+
+# process-name table (parity: the reference line carries the process NAME
+# and func(line), `src/cmb_logger.c:149-227`).  Names are static model
+# structure, so the table binds host-side: Model.build() registers the
+# per-pid names and log lines render ``name(pid)`` in a host callback.
+_proc_names = None
+
+
+def names_set(names) -> None:
+    """Register per-pid process names for log rendering (called by
+    ``Model.build``; last built model wins, like the reference's one
+    TLS process context per thread)."""
+    global _proc_names
+    _proc_names = list(names) if names else None
+
+
+def _pid_str(names, p) -> str:
+    if names is not None and 0 <= int(p) < len(names):
+        return f"{names[int(p)]}({int(p)})"
+    return str(int(p))
+
+
+def _caller_src() -> str:
+    """Trace-time call-site tag ``func(line)`` (parity: the reference's
+    __func__/__LINE__ in every line) — resolved once per trace, free at
+    run time.  Walks raw frames (no inspect.stack(): that materializes
+    source context for the entire, hundreds-deep tracing stack)."""
+    import sys
+
+    f = sys._getframe(2)
+    for _ in range(4):
+        if f is None:
+            break
+        if f.f_code.co_filename != __file__:
+            return f"{f.f_code.co_name}({f.f_lineno})"
+        f = f.f_back
+    return "?"
+
+
+def flags_on(bits: int) -> None:
+    """Enable levels (parity: cmb_logger_flags_on)."""
+    global _mask
+    _mask |= bits
+
+
+def flags_off(bits: int) -> None:
+    """Disable levels (parity: cmb_logger_flags_off)."""
+    global _mask
+    _mask &= ~bits
+
+
+def flags() -> int:
+    return _mask
+
+
+def timeformatter_set(fn) -> None:
+    """Replace the time rendering on every subsequently *traced* log call
+    (parity: cmb_logger_timeformatter_set; the reference swaps a function
+    pointer at runtime — here, as with flags, it binds at trace time).
+    ``fn(t: float) -> str`` runs host-side; pass None to restore the
+    default."""
+    global _timeformatter
+    _timeformatter = fn
+
+
+def _stream_id(sim):
+    """Reproduction context (parity: the seed printed on warning+ lines,
+    `src/cmb_logger.c:149-227`): the counter-based RNG means (key, ctr)
+    replays the stream exactly — stronger than the reference's curseed."""
+    import jax.numpy as jnp
+
+    key = (jnp.asarray(sim.rng.key1, jnp.uint64) << jnp.uint64(32)) | (
+        jnp.asarray(sim.rng.key0, jnp.uint64)
+    )
+    return key, sim.rng.n_draws
+
+
+def _emit(level_name, sim, p, fmt, *args, **kwargs):
+    """One host-callback line: ``[level] r t process func(line) err | msg``
+    (parity: the reference's `[trial] [seed] time process func(line): msg`,
+    `src/cmb_logger.c:149-227`).  Process names and the call-site tag are
+    trace-time constants; only the numeric payload crosses the boundary."""
+    rep = getattr(sim, "rep", -1)
+    src = _caller_src()
+    tff = _timeformatter
+    names = _proc_names  # snapshot at trace time, like tff/src — a later
+    # Model.build() must not relabel an already-jitted model's lines
+
+    def host(r, t, p_, e, *a, **kw):
+        ts = tff(float(t)) if tff is not None else f"{float(t):.6f}"
+        print(
+            f"[{level_name}] r={int(r)} t={ts} p={_pid_str(names, p_)} "
+            f"{src} err={int(e)} | " + fmt.format(*a, **kw),
+            flush=True,
+        )
+
+    jax.debug.callback(host, rep, sim.clock, p, sim.err, *args, **kwargs)
+
+
+def _emit_with_seed(level_name, sim, p, fmt, *args, **kwargs):
+    """warning+ lines carry the stream id for reproduction (parity:
+    `src/cmb_logger.c:214-218`): rebuild the failing replication's RNG with
+    RandomState(key0, key1, ctr) and replay."""
+    key, ctr = _stream_id(sim)
+    _emit(
+        level_name, sim, p,
+        fmt + "  [replay: key=0x{_key:016x} ctr={_ctr}]",
+        *args, _key=key, _ctr=ctr, **kwargs,
+    )
+
+
+def info(sim, p, fmt: str, *args, **kwargs):
+    """Log at INFO if enabled at trace time; returns sim unchanged."""
+    if _mask & INFO:
+        _emit("info", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def warning(sim, p, fmt: str, *args, **kwargs):
+    if _mask & WARNING:
+        _emit_with_seed("warn", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def user(bit: int, sim, p, fmt: str, *args, **kwargs):
+    """Log on a user-defined flag bit (parity: the 28 user bits)."""
+    if _mask & bit:
+        _emit(f"u{bit:x}", sim, p, fmt, *args, **kwargs)
+    return sim
+
+
+def error(sim, p, fmt: str, *args, **kwargs):
+    """Log AND mark the replication failed (parity: cmb_logger_error's
+    abandon-this-trial recovery — the runner counts it, the batch
+    continues)."""
+    from cimba_tpu.core import api
+
+    if _mask & ERROR:
+        _emit_with_seed("error", sim, p, fmt, *args, **kwargs)
+    return api.fail(sim)

@@ -1,0 +1,26 @@
+"""Hardware-entropy seeding.
+
+Reference parity: ``cmb_random_hwseed`` (`src/port/x86-64/linux/
+cmi_random_hwseed.asm`) — RDSEED with RDRAND retry fallback and a
+clock/TSC mashup last resort.  Host Python reaches the same kernel entropy
+pool through ``os.urandom`` (which itself is fed by RDSEED/RDRAND where
+available), so the asm layer's job is done by the OS; the time-based
+fallback mirrors the reference's.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def hwseed() -> int:
+    """A 64-bit hardware-entropy seed (parity: cmb_random_hwseed)."""
+    try:
+        return int.from_bytes(os.urandom(8), "little")
+    except NotImplementedError:  # no OS entropy: clock mashup fallback
+        t = time.time_ns()
+        m = time.monotonic_ns()
+        return (t * 0x9E3779B97F4A7C15 ^ (m << 17) ^ os.getpid()) & (
+            (1 << 64) - 1
+        )

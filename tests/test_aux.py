@@ -51,6 +51,53 @@ def test_checkpoint_structure_mismatch_rejected(tmp_path):
         ckpt.restore(path, {"different": jnp.zeros(3)})
 
 
+def test_checkpoint_regrow_between_save_restore_rejected(tmp_path):
+    """A capacity regrow between save and restore keeps the leaf COUNT
+    but changes leaf shapes — the per-leaf shape check must name it,
+    not hand back garbage."""
+    import pytest
+
+    spec, _ = mm1.build(queue_cap=256)
+    grown, _ = mm1.build(queue_cap=512)
+    sim = cl.init_sim(spec, 0, 0, mm1.params(10))
+    sim_g = cl.init_sim(grown, 0, 0, mm1.params(10))
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, sim, tag=ckpt.spec_tag(spec))
+    # shape check alone catches it ...
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.restore(path, sim_g)
+    # ... and the spec fingerprint catches it even before shapes
+    with pytest.raises(ValueError, match="fingerprint"):
+        ckpt.restore(path, sim_g, tag=ckpt.spec_tag(grown))
+
+
+def test_checkpoint_dtype_mismatch_rejected(tmp_path):
+    """A dtype profile switch between save and restore is a loud error."""
+    import pytest
+
+    from cimba_tpu import config
+
+    spec, _ = mm1.build()
+    sim = cl.init_sim(spec, 0, 0, mm1.params(10))
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, sim)
+    with config.profile("f32"):
+        spec32, _ = mm1.build()
+        sim32 = cl.init_sim(spec32, 0, 0, mm1.params(10))
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.restore(path, sim32)
+
+
+def test_checkpoint_matching_tag_roundtrips(tmp_path):
+    spec, _ = mm1.build()
+    sim = cl.init_sim(spec, 0, 0, mm1.params(10))
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, sim, tag=ckpt.spec_tag(spec))
+    back = ckpt.restore(path, sim, tag=ckpt.spec_tag(spec))
+    for a, b in zip(jax.tree.leaves(sim), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_logger_error_fails_replication():
     m = Model("logerr", event_cap=8, guard_cap=2)
 

@@ -1,0 +1,145 @@
+"""Model-facing event verbs: reschedule / reprioritize / pattern query +
+cancel (parity: the public handle surface of `include/cmb_event.h:75-323`
+— cmb_event_reschedule, cmb_event_reprioritize, cmb_event_pattern_*).
+
+The key contract driven here: ``reschedule`` KEEPS the event's FIFO
+sequence — a cancel+schedule to the same (time, prio) would re-enter at
+the back of its tie class, which is exactly the reordering the verb
+exists to avoid.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+
+
+def run1(m, params=None, t_end=None):
+    spec = m.build()
+    run = cl.make_run(spec, t_end=t_end)
+    sim = cl.init_sim(spec, 0, 0, params)
+    out = jax.jit(run)(sim)
+    assert int(out.err) == 0, f"replication failed: err={int(out.err)}"
+    return out, spec
+
+
+def _order_model():
+    """Two user events recording their dispatch order into user state."""
+    m = Model("evapi", event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {
+            "h1": jnp.asarray(-1, jnp.int32),
+            "h2": jnp.asarray(-1, jnp.int32),
+            "order": jnp.zeros((2,), jnp.int32),
+            "times": jnp.zeros((2,), jnp.float64),
+            "n": jnp.asarray(0, jnp.int32),
+        }
+
+    @m.handler
+    def mark(sim, subj, arg):
+        u = sim.user
+        n = u["n"]
+        return api.set_user(sim, {
+            **u,
+            "order": u["order"].at[n].set(jnp.asarray(arg, jnp.int32)),
+            "times": u["times"].at[n].set(api.clock(sim)),
+            "n": n + 1,
+        })
+
+    return m, mark
+
+
+def test_reschedule_keeps_fifo_seq():
+    """e1 scheduled before e2; e1 is rescheduled ONTO e2's (time, prio).
+    Its earlier FIFO seq survives the move, so e1 still dispatches
+    first.  (A cancel+schedule would have given e1 a fresh, later seq
+    and flipped the order.)"""
+    m, mark = _order_model()
+
+    @m.block
+    def driver(sim, p, sig):
+        sim, h1 = api.schedule(sim, 20.0, 0, mark, arg=1)
+        sim, h2 = api.schedule(sim, 30.0, 0, mark, arg=2)
+        sim, ok = api.event_reschedule(sim, h1, 30.0)
+        sim = api.set_user(sim, {**sim.user, "h1": h1, "h2": h2})
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.exit_()
+
+    m.process("driver", entry=driver, prio=0)
+    out, _ = run1(m)
+    assert out.user["order"].tolist() == [1, 2]
+    assert out.user["times"].tolist() == [30.0, 30.0]
+
+
+def test_reschedule_dead_handle_reports_missing():
+    m, mark = _order_model()
+
+    @m.block
+    def driver(sim, p, sig):
+        sim, h1 = api.schedule(sim, 20.0, 0, mark, arg=1)
+        sim, h1b = api.event_cancel(sim, h1)
+        sim, ok = api.event_reschedule(sim, h1, 10.0)
+        # report through ilocals-free channel: fail iff ok (must NOT be)
+        sim = api.fail(sim, ok)
+        return sim, cmd.exit_()
+
+    m.process("driver", entry=driver, prio=0)
+    out, _ = run1(m)
+    assert int(out.user["n"]) == 0
+
+
+def test_reprioritize_reorders_same_time():
+    """Two events tied on time; raising the later one's priority makes it
+    dispatch first (prio DESC within a time tie)."""
+    m, mark = _order_model()
+
+    @m.block
+    def driver(sim, p, sig):
+        sim, h1 = api.schedule(sim, 20.0, 0, mark, arg=1)
+        sim, h2 = api.schedule(sim, 20.0, 0, mark, arg=2)
+        sim, ok = api.event_reprioritize(sim, h2, 5)
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.exit_()
+
+    m.process("driver", entry=driver, prio=0)
+    out, _ = run1(m)
+    assert out.user["order"].tolist() == [2, 1]
+
+
+def test_pattern_count_find_cancel():
+    """Count by kind wildcard, find the soonest match, cancel by pattern;
+    the found handle round-trips through event_reschedule."""
+    m, mark = _order_model()
+
+    @m.handler
+    def other(sim, subj, arg):
+        return sim
+
+    @m.block
+    def driver(sim, p, sig):
+        sim, h1 = api.schedule(sim, 20.0, 0, mark, subj=3, arg=1)
+        sim, h2 = api.schedule(sim, 10.0, 0, mark, subj=4, arg=2)
+        sim, h3 = api.schedule(sim, 5.0, 0, other, subj=3)
+        # counts: by kind, by subj, wildcard
+        n_mark = api.event_pattern_count(sim, kind=mark)
+        n_s3 = api.event_pattern_count(sim, subj=3)
+        n_all = api.event_pattern_count(sim)
+        ok = (n_mark == 2) & (n_s3 == 2) & (n_all == 3)
+        # soonest mark event is h2 (t=10): push it behind h1
+        h = api.event_pattern_find(sim, kind=mark)
+        ok = ok & (h == h2)
+        sim, ok2 = api.event_reschedule(sim, h, 40.0)
+        # cancel the `other` family; only the two marks remain
+        sim, n_cancelled = api.event_pattern_cancel(sim, kind=other)
+        ok = ok & ok2 & (n_cancelled == 1) & (api.event_pattern_count(sim) == 2)
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.exit_()
+
+    m.process("driver", entry=driver, prio=0)
+    out, _ = run1(m)
+    assert out.user["order"].tolist() == [1, 2]  # h1 @20 before h2 @40
+    assert out.user["times"].tolist() == [20.0, 40.0]

@@ -94,4 +94,31 @@ EOF
 
 run_cell "multichip dryrun" python __graft_entry__.py 8
 
+# packaging: build the wheel, install it into a scratch --target, and
+# drive a model from OUTSIDE the repo checkout — catches a subpackage or
+# data file missing from the install the way the reference CI's install
+# verification does (`.github/workflows/ci.yml:37-59` /
+# `test/tools/verify_install.sh`).  --no-index: CI runs with zero
+# egress; a nested venv would not see this image's /opt/venv packages,
+# so the smoke runs the ambient python against only the installed tree.
+run_cell "packaging" bash -c '
+  set -e
+  tmp=$(mktemp -d)
+  trap "rm -rf \"$tmp\"" EXIT
+  pip wheel --no-build-isolation --no-index --no-deps -q -w "$tmp" .
+  pip install --no-index --no-deps -q --target "$tmp/site" "$tmp"/cimba_tpu-*.whl
+  cd "$tmp"
+  PYTHONPATH="$tmp/site" python - <<PYEOF
+import cimba_tpu, jax
+assert "/site/cimba_tpu/" in cimba_tpu.__file__.replace("\\\\", "/"), cimba_tpu.__file__
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mm1
+spec, _ = mm1.build(record=False)
+sim = cl.init_sim(spec, 1, 0, (1.0/0.9, 1.0, 50))
+out = jax.jit(cl.make_run(spec))(sim)
+assert int(out.err) == 0 and int(out.n_events) > 0
+print("packaged import+run OK:", int(out.n_events), "events")
+PYEOF
+'
+
 exit $fail

@@ -107,6 +107,23 @@ def main():
     for shp, w in c.most_common(10):
         print(f"  {shp}: {w} el / {ops[shp]} ops  ({w * 100 // total}%)")
 
+    # Audit rules (BENCH_NOTES round 3/4): shapes this metric UNDERWEIGHTS.
+    # (a) any [P, K] 2-D term (P = process count) is the waiter-scan shape
+    #     class — e.g. the wait_event [P, CAP] one-hot validation — a
+    #     P-proportional per-event cost easy to miss at small test P;
+    # (b) counted loops (kfori) weight their body ONCE but run it
+    #     trip-count times — a body touching K-wide arrays is O(K^2).
+    P = int(sim.procs.pc.shape[0])
+    px = [
+        (shp, w)
+        for shp, w in c.items()
+        if len(shp) == 2 and P > 1 and P in shp and w >= 8 * P
+    ]
+    if px:
+        print(f"  AUDIT [P,K] (P={P}): scales with process count —")
+        for shp, w in sorted(px, key=lambda kv: -kv[1]):
+            print(f"    {shp}: {w} el / {ops[shp]} ops")
+
 
 if __name__ == "__main__":
     main()
