@@ -316,13 +316,15 @@ def pattern_count(es: EventSet, kind=WILDCARD, subj=WILDCARD):
     return jnp.sum(_match(es, kind, subj).astype(_I))
 
 
-def pattern_cancel(es: EventSet, kind=WILDCARD, subj=WILDCARD):
-    """Cancel all matching events; returns (es, n_cancelled)."""
+def pattern_cancel(es: EventSet, kind=WILDCARD, subj=WILDCARD, pred=True):
+    """Cancel all matching events; returns (es, n_cancelled).  ``pred``
+    gates the cancellation (n_cancelled still reports the match count)."""
     m = _match(es, kind, subj)
+    mw = m if pred is True else (m & pred)
     return (
         es._replace(
-            time=jnp.where(m, NEVER, es.time),
-            gen=es.gen + m.astype(_I),
+            time=jnp.where(mw, NEVER, es.time),
+            gen=es.gen + mw.astype(_I),
         ),
         jnp.sum(m.astype(_I)),
     )

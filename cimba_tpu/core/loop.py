@@ -316,16 +316,51 @@ def _bounded_while(cond, body, init, bound: int):
     return lax.while_loop(wcond, wbody, (jnp.int32(0), init))[1]
 
 
+def _and(pred, gate):
+    """AND a handler's write predicate with its branch gate; ``True``
+    short-circuits so ungated paths trace zero extra ops."""
+    if gate is True:
+        return pred
+    if pred is True:
+        return gate
+    return pred & gate
+
+
+def _gated(fn):
+    """Mark a branch as SELF-GATED for :func:`_vswitch`: it accepts a
+    ``gate`` keyword and guarantees its output Sim is identical to its
+    input Sim wherever ``gate`` is false (every write pred-gated).
+    _vswitch then composes gated branches sequentially under their
+    exclusive selection predicates instead of select-merging their
+    outputs — zero merge ops for the Sim."""
+    fn.self_gated = True
+    return fn
+
+
 def _vswitch(idx, branches, *args):
-    """``lax.switch`` for the vmapped interpreter: evaluate every branch and
-    fold with binary tree-selects.  Under vmap a lax.switch executes every
-    traced branch anyway, but lowers to an N-ary ``select_n`` which Mosaic
-    rejects (only 2-way selects); the explicit fold emits the same work as
-    2-way selects and costs nothing extra because ``_tree_select`` passes
-    untouched leaves through.  Outside kernel mode the real lax.switch is
-    kept: an *unbatched* run then executes only the selected branch
-    (side effects like debug callbacks fire once, and scalar oracle runs
-    stay cheap)."""
+    """``lax.switch`` for the vmapped interpreter.  Under vmap a
+    lax.switch executes every traced branch anyway, but lowers to an
+    N-ary ``select_n`` which Mosaic rejects (only 2-way selects).  Two
+    strategies replace the merge:
+
+    * branches marked with :func:`_gated` (the internal command
+      handlers) are composed SEQUENTIALLY, each fully pred-gated by its
+      exclusive selection predicate — the inactive handlers' writes are
+      runtime no-ops, so the chain needs no Sim merge at all.  A later
+      branch's reads can see an earlier branch's traced writes, but
+      whenever the later branch is the selected one those writes were
+      gated off — the composition is exact.
+    * unmarked branches (user blocks) are evaluated against the base
+      args and select-merged per leaf, folding only over the branches
+      that actually changed each leaf (identity test).  A table is
+      either all-gated or all-ungated; mixing raises.
+
+    The branch predicates are exclusive and exhaustive (every caller
+    clips or LUT-maps ``idx`` into range).  Outside kernel mode the real
+    lax.switch is kept: an *unbatched* run then executes only the
+    selected branch (side effects like debug callbacks fire once, and
+    scalar oracle runs stay cheap; gated handlers see gate=True there).
+    """
     if not config.KERNEL_MODE:
         return lax.switch(idx, branches, *args)
     # dedupe identical branch callables: the dispatch table aliases the
@@ -343,25 +378,29 @@ def _vswitch(idx, branches, *args):
         else:
             uniq.append(b)
             index_sets.append([j])
-    outs = [b(*args) for b in uniq]
     idx = jnp.asarray(idx, _I)
-    if len(outs) == 1:
-        return outs[0]
-    # Per-LEAF merge instead of a sequential whole-tree fold: the old
-    # fold re-selected every already-touched leaf at every later fold
-    # step (a leaf written by one branch out of five collected up to four
-    # full-width selects — on a queue ring that was 4x256 elements per
-    # chain iteration for one masked write).  The branch predicates are
-    # exclusive and exhaustive (idx is clipped/LUT-mapped into range), so
-    # each leaf can instead fold only over the branches that *changed* it
-    # — identity-distinct from the other branches' value — with branches
-    # sharing an unchanged value grouped under one OR'd predicate.
+    if len(uniq) == 1:
+        # exhaustive single branch: always selected (gate stays True)
+        return uniq[0](*args)
     sels = []
     for idxs in index_sets:
         s = idx == idxs[0]
         for j in idxs[1:]:
             s = s | (idx == j)
         sels.append(s)
+
+    is_gated = [getattr(b, "self_gated", False) for b in uniq]
+    base_sim = args[0]
+    cur = base_sim
+    outs = []
+    for u, b in enumerate(uniq):
+        if is_gated[u]:
+            o = b(cur, *args[1:], gate=sels[u])
+            cur = o[0] if isinstance(o, tuple) else o
+            outs.append(o)
+        else:
+            outs.append(b(*args))
+
     flat0, treedef = jax.tree.flatten(outs[0])
     flats = [flat0]
     for u, o in enumerate(outs[1:], 1):
@@ -372,8 +411,29 @@ def _vswitch(idx, branches, *args):
                 f"structure than branch 0:\n{td}\nvs\n{treedef}"
             )
         flats.append(fl)
+
+    if any(is_gated):
+        # gated tables must be all-gated: the Sim result is the chain's
+        # output and needs NO merge; non-Sim positions (yielded flags)
+        # still select over every branch below.  (A mixed gated/ungated
+        # table has no call site — fail loudly rather than run an
+        # unexercised merge semantics.)
+        if not all(is_gated):
+            raise TypeError(
+                "_vswitch: mixed gated/ungated branch table is not "
+                "supported — gate all branches or none"
+            )
+        flat_cur = jax.tree.flatten(cur)[0]
+        n_sim = len(flat_cur)
+    else:
+        flat_cur = []
+        n_sim = 0
+
     merged = []
-    for leaf_vals in zip(*flats):
+    for pos, leaf_vals in enumerate(zip(*flats)):
+        if pos < n_sim:
+            merged.append(flat_cur[pos])
+            continue
         groups: list = []  # (value, [branch indices]) by identity
         for u, v in enumerate(leaf_vals):
             for gv, gus in groups:
@@ -479,11 +539,11 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False,
     return sim._replace(procs=procs, guards=g2)
 
 
-def _clear_pend(sim: Sim, p) -> Sim:
+def _clear_pend(sim: Sim, p, pred=True) -> Sim:
     return sim._replace(
         procs=sim.procs._replace(
-            pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND),
-            pend_guard=dyn.dset(sim.procs.pend_guard, p, -1),
+            pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND, pred),
+            pend_guard=dyn.dset(sim.procs.pend_guard, p, -1, pred),
         )
     )
 
@@ -511,23 +571,23 @@ def _record_row_if(flags, acc, row, t, v, pred=True):
     return _tree_select(mask, rec, acc)
 
 
-def _cancel_wake(sim: Sim, p) -> Sim:
+def _cancel_wake(sim: Sim, p, pred=True) -> Sim:
     """Cancel p's outstanding resume (a no-op if none is armed).  The
     analog of cancelling a stale hold timer (`src/cmb_process.c:344-349`)."""
-    return sim._replace(wakes=ev.wake_clear(sim.wakes, p))
+    return sim._replace(wakes=ev.wake_clear(sim.wakes, p, pred))
 
 
-def _unwait(sim: Sim, p) -> Sim:
+def _unwait(sim: Sim, p, pred=True) -> Sim:
     """Detach p from whatever it waits on: guard membership, pending
     command, wake event (parity: cmi_process_cancel_awaiteds,
     `src/cmb_process.c:694-748`).  Dense guards: clearing ``pend_guard``
     (done by _clear_pend) IS the guard removal."""
-    sim = _clear_pend(sim, p)
-    sim = _cancel_wake(sim, p)
+    sim = _clear_pend(sim, p, pred)
+    sim = _cancel_wake(sim, p, pred)
     return sim._replace(
         procs=sim.procs._replace(
-            await_pid=dyn.dset(sim.procs.await_pid, p, -1),
-            await_evt=dyn.dset(sim.procs.await_evt, p, -1),
+            await_pid=dyn.dset(sim.procs.await_pid, p, -1, pred),
+            await_evt=dyn.dset(sim.procs.await_evt, p, -1, pred),
         )
     )
 
@@ -623,15 +683,23 @@ def _mass_wake(sim: Sim, mask, sig) -> Sim:
     )
 
 
-def _wake_waiters(sim: Sim, target, sig) -> Sim:
+def _wake_waiters(spec: ModelSpec, sim: Sim, target, sig, pred=True) -> Sim:
     """Wake every process waiting on `target` finishing (WAIT_PROC) — one
     vectorized mass-arm of the dense wake table.  (The per-pid loop this
     replaces cost O(P^2) per event at AWACS scale: its [P]-wide body ran
     P masked iterations inside every chain step.)  Seqs are assigned in
-    pid order among the woken, exactly as the loop did."""
+    pid order among the woken, exactly as the loop did.
+
+    Statically absent from models that never issue C_WAIT_PROC:
+    ``await_pid`` is then always -1, so the scan plus its prefix-rank
+    seq assignment (~45 [P]-wide ops per exit) can wake no one."""
+    if not _may_wait_procs(spec, sim):
+        return sim
     waiting = (sim.procs.await_pid == jnp.asarray(target, _I)) & (
         sim.procs.status == pr.RUNNING
     )
+    if pred is not True:
+        waiting = waiting & pred
     sim = _mass_wake(sim, waiting, sig)
     return sim._replace(
         procs=sim.procs._replace(
@@ -642,7 +710,8 @@ def _wake_waiters(sim: Sim, target, sig) -> Sim:
     )
 
 
-def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
+def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig,
+                   pred=True) -> Sim:
     """Command-specific cleanup when a pended wait is aborted:
 
     * pool acquire: roll the holding back to its pre-call amount and
@@ -660,6 +729,8 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
         k = jnp.clip(pend.i, 0, len(spec.pools) - 1)
         is_pool = (pend.tag == pr.C_POOL_ACQ) | (pend.tag == pr.C_POOL_PRE)
         do_rb = is_pool & (sig != pr.PREEMPTED)
+        if pred is not True:
+            do_rb = do_rb & pred
         excess = jnp.maximum(dyn.dget2(sim.pools.held, k, p) - pend.f2, 0.0)
         rb = sim._replace(
             pools=sim.pools._replace(
@@ -675,10 +746,12 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
         sim = _tree_select(do_rb, rb, sim)
     if spec.buffers:
         is_buf = (pend.tag == pr.C_BUF_GET) | (pend.tag == pr.C_BUF_PUT)
+        if pred is not True:
+            is_buf = is_buf & pred
         obtained = pend.f2 - pend.f
         sim = sim._replace(
             procs=sim.procs._replace(
-                got=dyn.dset(sim.procs.got, p, 
+                got=dyn.dset(sim.procs.got, p,
                     jnp.where(is_buf, obtained, dyn.dget(sim.procs.got, p))
                 )
             )
@@ -686,7 +759,7 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig) -> Sim:
     return sim
 
 
-def _abort_wait(spec: ModelSpec, sim: Sim, p, sig) -> Sim:
+def _abort_wait(spec: ModelSpec, sim: Sim, p, sig, pred=True) -> Sim:
     """Abort whatever p is waiting on AND run the command-specific abort
     cleanup (pool rollback, buffer partial-fulfillment report).  Every
     wait-aborting path — timer/interrupt delivery, preemption, mugging,
@@ -700,13 +773,16 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig) -> Sim:
         dyn.dget(sim.procs.pend_pc, p),
     )
     # _abort_cleanup self-gates on pend.tag, so NO_PEND is a clean no-op
-    return _abort_cleanup(spec, _unwait(sim, p), p, pend, sig)
+    return _abort_cleanup(
+        spec, _unwait(sim, p, pred), p, pend, sig, pred=pred
+    )
 
 
-def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
+def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig, pred=True) -> Sim:
     """Terminate process p: status, waiter wakeup, resource cleanup
     (parity: kill semantics — drop resources, cancel awaits, wake waiters,
-    `src/cmb_process.c:776-828`)."""
+    `src/cmb_process.c:776-828`).  Every write is gated by ``pred`` so
+    h_exit can run straight-line under its branch gate."""
     r_guard = _ConstTable([r.guard for r in spec.resources] or [0], _I)
     p_guard = _ConstTable([pl.guard for pl in spec.pools] or [0], _I)
     p_cap = _ConstTable([pl.capacity for pl in spec.pools] or [0.0], _R)
@@ -714,54 +790,50 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig) -> Sim:
     r_rec = [r.record for r in spec.resources]
     p_rec = [pl.record for pl in spec.pools]
 
-    sim = _abort_wait(spec, sim, p, exit_sig)
+    sim = _abort_wait(spec, sim, p, exit_sig, pred=pred)
     # cancel any outstanding timers aimed at p
-    es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p)
+    es2, _ = ev.pattern_cancel(sim.events, kind=K_TIMER, subj=p, pred=pred)
     sim = sim._replace(events=es2)
     sim = sim._replace(
         procs=sim.procs._replace(
-            status=dyn.dset(sim.procs.status, p, pr.FINISHED),
-            exit_sig=dyn.dset(sim.procs.exit_sig, p, jnp.asarray(exit_sig, _I)),
+            status=dyn.dset(sim.procs.status, p, pr.FINISHED, pred),
+            exit_sig=dyn.dset(
+                sim.procs.exit_sig, p, jnp.asarray(exit_sig, _I), pred
+            ),
         )
     )
-    sim = _wake_waiters(sim, p, exit_sig)
+    sim = _wake_waiters(spec, sim, p, exit_sig, pred=pred)
 
     # drop binary resources held by p (holdable drop protocol)
     def drop_res(rid, sim):
         held = dyn.dget(sim.resources.holder, rid) == p
+        if pred is not True:
+            held = held & pred
         r2 = Resources(
-            holder=dyn.dset(sim.resources.holder, rid, 
-                jnp.where(held, -1, dyn.dget(sim.resources.holder, rid))
-            ),
-            acc=_tree_select(
-                held,
-                _record_row_if(r_rec, sim.resources.acc, rid, sim.clock, 0.0),
-                sim.resources.acc,
+            holder=dyn.dset(sim.resources.holder, rid, -1, held),
+            acc=_record_row_if(
+                r_rec, sim.resources.acc, rid, sim.clock, 0.0, held
             ),
         )
         sim = sim._replace(resources=r2)
-        g2sim = _guard_signal(sim, r_guard[rid])
-        return _tree_select(held, g2sim, sim)
+        return _guard_signal(sim, r_guard[rid], pred=held)
 
     # pool units held by p return to the pool
     def drop_pool(k, sim):
         amt = dyn.dget2(sim.pools.held, k, p)
         has = amt > 0.0
+        if pred is not True:
+            has = has & pred
         p2 = sim.pools._replace(
-            level=dyn.dadd(sim.pools.level, k, jnp.where(has, amt, 0.0)),
-            held=dyn.dset2(sim.pools.held, k, p, 0.0),
-            acc=_tree_select(
-                has,
-                _record_row_if(
-                    p_rec, sim.pools.acc, k, sim.clock,
-                    p_cap[k] - (dyn.dget(sim.pools.level, k) + amt),
-                ),
-                sim.pools.acc,
+            level=dyn.dadd(sim.pools.level, k, amt, has),
+            held=dyn.dset2(sim.pools.held, k, p, 0.0, has),
+            acc=_record_row_if(
+                p_rec, sim.pools.acc, k, sim.clock,
+                p_cap[k] - (dyn.dget(sim.pools.level, k) + amt), has,
             ),
         )
         sim = sim._replace(pools=p2)
-        g2sim = _guard_signal(sim, p_guard[k])
-        return _tree_select(has, g2sim, sim)
+        return _guard_signal(sim, p_guard[k], pred=has)
 
     if spec.resources:
         sim = _kfori(0, sim.resources.holder.shape[0], drop_res, sim)
@@ -778,9 +850,8 @@ def interrupt(spec: ModelSpec, sim: Sim, target, sig) -> Sim:
     on (parity: cmb_process_interrupt, `include/cmb_process.h:406`)."""
     target = jnp.asarray(target, _I)
     alive = dyn.dget(sim.procs.status, target) == pr.RUNNING
-    intr = _abort_wait(spec, sim, target, sig)
-    intr = _schedule_wake(intr, alive, target, jnp.asarray(sig, _I))
-    return _tree_select(alive, intr, sim)
+    sim = _abort_wait(spec, sim, target, sig, pred=alive)
+    return _schedule_wake(sim, alive, target, jnp.asarray(sig, _I))
 
 
 def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
@@ -789,8 +860,7 @@ def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
     STOPPED."""
     target = jnp.asarray(target, _I)
     alive = dyn.dget(sim.procs.status, target) == pr.RUNNING
-    stopped = finish_process(spec, sim, target, pr.STOPPED)
-    return _tree_select(alive, stopped, sim)
+    return finish_process(spec, sim, target, pr.STOPPED, pred=alive)
 
 
 def timer_add(sim: Sim, p, dur, sig):
@@ -921,6 +991,13 @@ def _may_wait_events(spec: ModelSpec, sim: Sim) -> bool:
     return used is None or pr.C_WAIT_EVT in used
 
 
+def _may_wait_procs(spec: ModelSpec, sim: Sim) -> bool:
+    """Static: can this model issue C_WAIT_PROC?  Gates the exit-time
+    waiter mass-wake out of models that never wait on processes."""
+    used = _used_tags_for(spec, sim)
+    return used is None or pr.C_WAIT_PROC in used
+
+
 def _make_apply(spec: ModelSpec, used_tags=None):
     q_cap = _ConstTable([q.capacity for q in spec.queues] or [1], _I)
     q_front = _ConstTable([q.front_guard for q in spec.queues] or [0], _I)
@@ -941,25 +1018,32 @@ def _make_apply(spec: ModelSpec, used_tags=None):
     b_rec = [b.record for b in spec.buffers]
     pq_rec = [q.record for q in spec.pqueues]
 
-    def set_pc(sim, p, pc):
+    def set_pc(sim, p, pc, pred=True):
         return sim._replace(
-            procs=sim.procs._replace(pc=dyn.dset(sim.procs.pc, p, pc))
+            procs=sim.procs._replace(pc=dyn.dset(sim.procs.pc, p, pc, pred))
         )
 
-    def h_hold(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_hold(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         dur = jnp.maximum(cmd.f, 0.0)
         sim = _schedule_wake(
-            sim, True, p, pr.SUCCESS, t=sim.clock + dur
+            sim, gate, p, pr.SUCCESS, t=sim.clock + dur
         )
-        return set_pc(sim, p, cmd.next_pc), jnp.asarray(True)
+        return set_pc(sim, p, cmd.next_pc, gate), jnp.asarray(True)
 
-    def h_exit(sim: Sim, p, cmd: pr.Command, is_retry):
-        return finish_process(spec, sim, p, pr.SUCCESS), jnp.asarray(True)
+    @_gated
+    def h_exit(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return (
+            finish_process(spec, sim, p, pr.SUCCESS, pred=gate),
+            jnp.asarray(True),
+        )
 
-    def h_jump(sim: Sim, p, cmd: pr.Command, is_retry):
-        return set_pc(sim, p, cmd.next_pc), jnp.asarray(False)
+    @_gated
+    def h_jump(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return set_pc(sim, p, cmd.next_pc, gate), jnp.asarray(False)
 
-    def h_put(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_put(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         # straight-line with pred-gated writes: the ok and blocked paths
         # touch disjoint state under complementary predicates, so no
         # whole-Sim branch select is needed (each saved select is a full
@@ -972,7 +1056,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # IS the dequeued front and may proceed despite others behind it
         may = is_retry | gd.is_empty(sim.procs.pend_guard, q_rear[qid])
         full = (size >= cap) | ~may
-        ok = ~full
+        ok = _and(~full, gate)
 
         col = (dyn.dget(sim.queues.head, qid) + size) % cap
         sim = sim._replace(queues=Queues(
@@ -988,17 +1072,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # newly be satisfiable
         sim = _guard_signal(sim, q_front[qid], pred=ok)
         # both outcomes continue at next_pc (the blocked path's signals
-        # deliver there), so the pc write is unconditional
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, q_rear[qid], cmd, is_retry, pred=full)
+        # deliver there), so the pc write is gated only by the branch
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, q_rear[qid], cmd, is_retry, pred=_and(full, gate)
+        )
         return sim, full
 
-    def h_get(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         qid = cmd.i
         size = dyn.dget(sim.queues.size, qid)
         may = is_retry | gd.is_empty(sim.procs.pend_guard, q_front[qid])
         empty = (size <= 0) | ~may
-        ok = ~empty
+        ok = _and(~empty, gate)
         cap = q_cap[qid]
 
         head = dyn.dget(sim.queues.head, qid)
@@ -1019,8 +1106,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         sim = _guard_signal(sim, q_rear[qid], pred=ok)   # space for putters
         sim = _guard_signal(sim, q_front[qid], pred=ok)  # leftover items
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, q_front[qid], cmd, is_retry, pred=empty)
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, q_front[qid], cmd, is_retry, pred=_and(empty, gate)
+        )
         return sim, empty
 
     def _grab_resource(sim, p, rid, pred=True):
@@ -1032,76 +1121,86 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         return sim._replace(resources=r2)
 
-    def h_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_acquire(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         rid = cmd.i
         free = dyn.dget(sim.resources.holder, rid) < 0
         may_grab = is_retry | gd.is_empty(sim.procs.pend_guard, r_guard[rid])
         ok = free & may_grab
 
-        sim = _grab_resource(sim, p, rid, ok)
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry, pred=~ok)
+        sim = _grab_resource(sim, p, rid, _and(ok, gate))
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, r_guard[rid], cmd, is_retry, pred=_and(~ok, gate)
+        )
         return sim, ~ok
 
-    def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         """Parity: cmb_resource_preempt (`src/cmb_resource.c:275-325`) —
         grab if free; kick a holder of <= priority (it resumes with
-        PREEMPTED, its pending waits cancelled); else wait like acquire."""
+        PREEMPTED, its pending waits cancelled); else wait like acquire.
+        Straight-line: the three outcomes write disjoint state under
+        exclusive predicates."""
         rid = cmd.i
         holder = dyn.dget(sim.resources.holder, rid)
         free = holder < 0
         victim = jnp.maximum(holder, 0)
         can_kick = ~free & (dyn.dget(sim.procs.prio, p) >= dyn.dget(sim.procs.prio, victim))
+        g_free = _and(free, gate)
+        g_kick = _and(can_kick, gate)
+        blocked = ~free & ~can_kick
 
         # kick path: cancel victim's awaits (incl. pool rollback /
-        # buffer partial report if it was waiting on one), deliver PREEMPTED
-        kick_sim = _abort_wait(spec, sim, victim, pr.PREEMPTED)
-        kick_sim = _schedule_wake(kick_sim, can_kick, victim, pr.PREEMPTED)
-        # holder switch: no utilization record needed (still in use)
-        kick_sim = kick_sim._replace(
-            resources=kick_sim.resources._replace(
-                holder=dyn.dset(kick_sim.resources.holder, rid, p)
+        # buffer partial report if it was waiting on one), deliver
+        # PREEMPTED
+        sim = _abort_wait(spec, sim, victim, pr.PREEMPTED, pred=g_kick)
+        sim = _schedule_wake(sim, g_kick, victim, pr.PREEMPTED)
+        # holder switch on kick: no utilization record (still in use);
+        # fresh grab on free records
+        sim = sim._replace(
+            resources=sim.resources._replace(
+                holder=dyn.dset(sim.resources.holder, rid, p, g_kick)
             )
         )
-        kick_sim = set_pc(kick_sim, p, cmd.next_pc)
-
-        free_sim = set_pc(_grab_resource(sim, p, rid), p, cmd.next_pc)
-        blocked_sim = _guard_wait(sim, p, r_guard[rid], cmd, is_retry)
-
-        out = _tree_select(
-            free, free_sim, _tree_select(can_kick, kick_sim, blocked_sim)
+        sim = _grab_resource(sim, p, rid, g_free)
+        sim = set_pc(sim, p, cmd.next_pc, _and(free | can_kick, gate))
+        sim = _guard_wait(
+            sim, p, r_guard[rid], cmd, is_retry, pred=_and(blocked, gate)
         )
-        return out, ~free & ~can_kick
+        return sim, blocked
 
-    def h_release(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_release(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         rid = cmd.i
         owner_ok = dyn.dget(sim.resources.holder, rid) == p
         r2 = Resources(
-            holder=dyn.dset(sim.resources.holder, rid, -1),
+            holder=dyn.dset(sim.resources.holder, rid, -1, gate),
             acc=_record_row_if(
-                r_rec, sim.resources.acc, rid, sim.clock, 0.0
+                r_rec, sim.resources.acc, rid, sim.clock, 0.0, gate
             ),
         )
         sim2 = sim._replace(resources=r2)
-        sim2 = _guard_signal(sim2, r_guard[rid])
-        sim2 = set_pc(sim2, p, cmd.next_pc)
-        sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
+        sim2 = _guard_signal(sim2, r_guard[rid], pred=gate)
+        sim2 = set_pc(sim2, p, cmd.next_pc, gate)
+        sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
-    def _pool_stamp(sim, k, q):
+    def _pool_stamp(sim, k, q, pred=True):
         """Stamp q's grab order on its first units (LIFO victim order)."""
         fresh = dyn.dget2(sim.pools.held, k, q) <= 0.0
+        if pred is not True:
+            fresh = fresh & pred
         pools = sim.pools._replace(
-            held_seq=dyn.dset2(sim.pools.held_seq, k, q, 
-                jnp.where(fresh, dyn.dget(sim.pools.next_seq, k), dyn.dget2(sim.pools.held_seq, k, q))
+            held_seq=dyn.dset2(sim.pools.held_seq, k, q,
+                dyn.dget(sim.pools.next_seq, k), fresh
             ),
-            next_seq=dyn.dadd(sim.pools.next_seq, k, 
-                jnp.where(fresh, 1, 0).astype(_I)
-            ),
+            next_seq=dyn.dadd(sim.pools.next_seq, k, 1, fresh),
         )
         return sim._replace(pools=pools)
 
-    def _pool_acquire_impl(sim: Sim, p, cmd: pr.Command, is_retry, mug):
+    def _pool_acquire_impl(sim: Sim, p, cmd: pr.Command, is_retry, mug,
+                           gate=True):
         """Greedy acquire (parity: cmi_pool_acquire_inner,
         `src/cmb_resourcepool.c:362-533`): take available units NOW, then
         (preempt variant) mug strictly-lower-priority holders lowest-prio-
@@ -1116,11 +1215,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # greedy grab (the reference pool has no no-jump-ahead gate: new
         # callers race for available units; FIFO applies to the wait line)
         take = jnp.clip(rem, 0.0, dyn.dget(sim.pools.level, k))
-        sim = _pool_stamp(sim, k, p)
+        sim = _pool_stamp(sim, k, p, pred=gate)
         sim = sim._replace(
             pools=sim.pools._replace(
-                level=dyn.dadd(sim.pools.level, k, -take),
-                held=dyn.dadd2(sim.pools.held, k, p, take),
+                level=dyn.dadd(sim.pools.level, k, -take, gate),
+                held=dyn.dadd2(sim.pools.held, k, p, take, gate),
             )
         )
         rem = rem - take
@@ -1136,7 +1235,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                     & (sim.procs.prio < dyn.dget(sim.procs.prio, p))
                     & (pididx != p)
                 )
-                return (rem > 0.0) & jnp.any(vmask)
+                return _and((rem > 0.0) & jnp.any(vmask), gate)
 
             def mug_one(carry):
                 sim, rem = carry
@@ -1176,31 +1275,37 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         in_use = p_cap[k] - dyn.dget(sim.pools.level, k)
         sim = sim._replace(
             pools=sim.pools._replace(
-                acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use)
+                acc=_record_row_if(
+                    p_rec, sim.pools.acc, k, sim.clock, in_use, gate
+                )
             )
         )
         # leftovers may satisfy the next waiter — signaled ONLY on success
         # (parity: cmi_pool_acquire_inner signals after completing a grab;
         # signaling from a still-blocked partial grab would ping-pong
         # wakes between starved waiters forever)
-        ok_sim = _guard_signal(sim, p_guard[k])
-        ok_sim = set_pc(ok_sim, p, cmd.next_pc)
-        blocked_sim = _guard_wait(
+        sim = _guard_signal(sim, p_guard[k], pred=_and(done, gate))
+        sim = set_pc(sim, p, cmd.next_pc, _and(done, gate))
+        sim = _guard_wait(
             sim,
             p,
             p_guard[k],
             cmd._replace(f=rem, f2=init_held),
             is_retry,
+            pred=_and(~done, gate),
         )
-        return _tree_select(done, ok_sim, blocked_sim), ~done
+        return sim, ~done
 
-    def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry):
-        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=False)
+    @_gated
+    def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=False, gate=gate)
 
-    def h_pool_preempt(sim: Sim, p, cmd: pr.Command, is_retry):
-        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=True)
+    @_gated
+    def h_pool_preempt(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return _pool_acquire_impl(sim, p, cmd, is_retry, mug=True, gate=gate)
 
-    def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         k = cmd.i
         amt = jnp.minimum(cmd.f, dyn.dget2(sim.pools.held, k, p))  # partial ok
         # profile-scaled ownership tolerance: held amounts accumulate in
@@ -1219,17 +1324,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         owner_ok = dyn.dget2(sim.pools.held, k, p) >= cmd.f - tol
         in_use = p_cap[k] - (dyn.dget(sim.pools.level, k) + amt)
         p2 = sim.pools._replace(
-            level=dyn.dadd(sim.pools.level, k, amt),
-            held=dyn.dadd2(sim.pools.held, k, p, -amt),
-            acc=_record_row_if(p_rec, sim.pools.acc, k, sim.clock, in_use),
+            level=dyn.dadd(sim.pools.level, k, amt, gate),
+            held=dyn.dadd2(sim.pools.held, k, p, -amt, gate),
+            acc=_record_row_if(
+                p_rec, sim.pools.acc, k, sim.clock, in_use, gate
+            ),
         )
         sim2 = sim._replace(pools=p2)
-        sim2 = _guard_signal(sim2, p_guard[k])
-        sim2 = set_pc(sim2, p, cmd.next_pc)
-        sim2 = _set_err(sim2, ~owner_ok, ERR_BAD_RELEASE)
+        sim2 = _guard_signal(sim2, p_guard[k], pred=gate)
+        sim2 = set_pc(sim2, p, cmd.next_pc, gate)
+        sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
-    def _buffer_xfer_impl(sim: Sim, p, cmd: pr.Command, is_retry, getting):
+    def _buffer_xfer_impl(sim: Sim, p, cmd: pr.Command, is_retry, getting,
+                          gate=True):
         """Greedy partial-fulfillment transfer shared by get/put (parity:
         cmb_buffer_get/_put, `src/cmb_buffer.c:194-346`): move what fits
         now, wait for the remainder; an aborted wait keeps the partial
@@ -1252,39 +1360,46 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         other_guard = b_rear[b] if getting else b_front[b]
         sim = sim._replace(
             buffers=Buffers(
-                level=dyn.dset(sim.buffers.level, b, level2),
+                level=dyn.dset(sim.buffers.level, b, level2, gate),
                 acc=_record_row_if(
-                    b_rec, sim.buffers.acc, b, sim.clock, level2
+                    b_rec, sim.buffers.acc, b, sim.clock, level2, gate
                 ),
             )
         )
-        sim = _guard_signal(sim, other_guard, pred=moved > 0.0)
+        sim = _guard_signal(sim, other_guard, pred=_and(moved > 0.0, gate))
         # pass leftover wake along on completion only
-        sim = _guard_signal(sim, my_guard, pred=done)
+        sim = _guard_signal(sim, my_guard, pred=_and(done, gate))
         sim = sim._replace(
             procs=sim.procs._replace(
-                got=dyn.dset(sim.procs.got, p, total, done)
+                got=dyn.dset(sim.procs.got, p, total, _and(done, gate))
             )
         )
-        sim = set_pc(sim, p, cmd.next_pc)
+        sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, my_guard, cmd._replace(f=rem2, f2=total), is_retry,
-            pred=~done,
+            pred=_and(~done, gate),
         )
         return sim, ~done
 
-    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry):
-        return _buffer_xfer_impl(sim, p, cmd, is_retry, getting=True)
+    @_gated
+    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return _buffer_xfer_impl(
+            sim, p, cmd, is_retry, getting=True, gate=gate
+        )
 
-    def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry):
-        return _buffer_xfer_impl(sim, p, cmd, is_retry, getting=False)
+    @_gated
+    def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        return _buffer_xfer_impl(
+            sim, p, cmd, is_retry, getting=False, gate=gate
+        )
 
-    def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_pq_put(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         qid = cmd.i
         n_live = jnp.sum(dyn.dget(sim.pqueues.live, qid).astype(_I))
         may = is_retry | gd.is_empty(sim.procs.pend_guard, pq_rear[qid])
         full = (n_live >= pq_cap[qid]) | ~may
-        ok = ~full
+        ok = _and(~full, gate)
         free_col = _argmax32(~dyn.dget(sim.pqueues.live, qid)).astype(_I)
         pq2 = PQueues(
             items=dyn.dset2(sim.pqueues.items, qid, free_col, cmd.f, ok),
@@ -1303,11 +1418,14 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         sim = sim._replace(pqueues=pq2)
         # put frees no slots: only the getter side can newly proceed
         sim = _guard_signal(sim, pq_front[qid], pred=ok)
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, pq_rear[qid], cmd, is_retry, pred=full)
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, pq_rear[qid], cmd, is_retry, pred=_and(full, gate)
+        )
         return sim, full
 
-    def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         qid = cmd.i
         live = dyn.dget(sim.pqueues.live, qid)
         may = is_retry | gd.is_empty(sim.procs.pend_guard, pq_front[qid])
@@ -1322,7 +1440,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         col = _argmax32(m & (dyn.dget(sim.pqueues.seq, qid) == s_min)).astype(_I)
         item = dyn.dget2(sim.pqueues.items, qid, col)
-        ok = ~empty
+        ok = _and(~empty, gate)
         pq2 = sim.pqueues._replace(
             live=dyn.dset2(sim.pqueues.live, qid, col, False, ok),
             acc=_record_row_if(
@@ -1338,11 +1456,14 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         sim = _guard_signal(sim, pq_rear[qid], pred=ok)
         sim = _guard_signal(sim, pq_front[qid], pred=ok)
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, pq_front[qid], cmd, is_retry, pred=empty)
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, pq_front[qid], cmd, is_retry, pred=_and(empty, gate)
+        )
         return sim, empty
 
-    def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         """First issue always blocks until a signal (parity: the reference's
         guard wait enqueues + yields unconditionally); a signal-driven retry
         re-checks the predicate and re-waits if it no longer holds (the
@@ -1350,59 +1471,59 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         cid = cmd.i
         satisfied = _cond_satisfied(spec, sim, cid, p)
         proceed = is_retry & satisfied
-        sim = set_pc(sim, p, cmd.next_pc)
-        sim = _guard_wait(sim, p, c_guard[cid], cmd, is_retry, pred=~proceed)
+        sim = set_pc(sim, p, cmd.next_pc, gate)
+        sim = _guard_wait(
+            sim, p, c_guard[cid], cmd, is_retry, pred=_and(~proceed, gate)
+        )
         return sim, ~proceed
 
-    def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_wait_proc(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         tgt = cmd.i
         finished = dyn.dget(sim.procs.status, tgt) == pr.FINISHED
         # already finished: yield anyway and deliver the target's exit
         # signal (SUCCESS or STOPPED) through an immediate wakeup, so the
         # continuation sees the same signal either way
-        done_sim = _schedule_wake(
-            set_pc(sim, p, cmd.next_pc), finished, p, dyn.dget(sim.procs.exit_sig, tgt)
+        sim = _schedule_wake(
+            sim, _and(finished, gate), p, dyn.dget(sim.procs.exit_sig, tgt)
         )
-        wait_sim = set_pc(
-            sim._replace(
-                procs=sim.procs._replace(
-                    await_pid=dyn.dset(sim.procs.await_pid, p, tgt)
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                await_pid=dyn.dset(
+                    sim.procs.await_pid, p, tgt, _and(~finished, gate)
                 )
-            ),
-            p,
-            cmd.next_pc,
+            )
         )
-        return _tree_select(finished, done_sim, wait_sim), jnp.asarray(True)
+        return set_pc(sim, p, cmd.next_pc, gate), jnp.asarray(True)
 
-    def h_wait_evt(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_wait_evt(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         """Wait for event handle cmd.i to be dispatched (parity:
         cmb_process_wait_event, `include/cmb_process.h:374`).  A dead
         handle (already fired or cancelled) delivers CANCELLED through an
         immediate wakeup, mirroring wait_process's already-finished path."""
         h = cmd.i
         valid = ev._valid(sim.events, h)
-        dead_sim = _schedule_wake(
-            set_pc(sim, p, cmd.next_pc), ~valid, p,
-            jnp.asarray(pr.CANCELLED, _I),
+        sim = _schedule_wake(
+            sim, _and(~valid, gate), p, jnp.asarray(pr.CANCELLED, _I)
         )
-        wait_sim = set_pc(
-            sim._replace(
-                procs=sim.procs._replace(
-                    await_evt=dyn.dset(sim.procs.await_evt, p, h)
+        sim = sim._replace(
+            procs=sim.procs._replace(
+                await_evt=dyn.dset(
+                    sim.procs.await_evt, p, h, _and(valid, gate)
                 )
-            ),
-            p,
-            cmd.next_pc,
+            )
         )
-        return _tree_select(valid, wait_sim, dead_sim), jnp.asarray(True)
+        return set_pc(sim, p, cmd.next_pc, gate), jnp.asarray(True)
 
-    def h_invalid(sim: Sim, p, cmd: pr.Command, is_retry):
+    @_gated
+    def h_invalid(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         """Stub for commands whose component type the model never declared
         — keeps the traced handler table small (compile time scales with
         it) while turning stray commands into a contained failure."""
-        return _set_err(sim, True, ERR_USER), jnp.asarray(True)
+        return _set_err(sim, gate, ERR_USER), jnp.asarray(True)
 
-    def gate(pred, h):
+    def component_gate(pred, h):
         return h if pred else h_invalid
 
     has_q = bool(spec.queues)
@@ -1411,20 +1532,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         h_hold,                                  # C_HOLD
         h_exit,                                  # C_EXIT
         h_jump,                                  # C_JUMP
-        gate(has_q, h_put),                      # C_PUT
-        gate(has_q, h_get),                      # C_GET
-        gate(has_r, h_acquire),                  # C_ACQUIRE
-        gate(has_r, h_release),                  # C_RELEASE
-        gate(has_r, h_preempt),                  # C_PREEMPT
-        gate(bool(spec.pools), h_pool_acquire),  # C_POOL_ACQ
-        gate(bool(spec.pools), h_pool_release),  # C_POOL_REL
-        gate(bool(spec.buffers), h_buffer_get),  # C_BUF_GET
-        gate(bool(spec.buffers), h_buffer_put),  # C_BUF_PUT
-        gate(bool(spec.pqueues), h_pq_put),      # C_PQ_PUT
-        gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET
-        gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
+        component_gate(has_q, h_put),                      # C_PUT
+        component_gate(has_q, h_get),                      # C_GET
+        component_gate(has_r, h_acquire),                  # C_ACQUIRE
+        component_gate(has_r, h_release),                  # C_RELEASE
+        component_gate(has_r, h_preempt),                  # C_PREEMPT
+        component_gate(bool(spec.pools), h_pool_acquire),  # C_POOL_ACQ
+        component_gate(bool(spec.pools), h_pool_release),  # C_POOL_REL
+        component_gate(bool(spec.buffers), h_buffer_get),  # C_BUF_GET
+        component_gate(bool(spec.buffers), h_buffer_put),  # C_BUF_PUT
+        component_gate(bool(spec.pqueues), h_pq_put),      # C_PQ_PUT
+        component_gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET
+        component_gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
         h_wait_proc,                             # C_WAIT_PROC
-        gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE
+        component_gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE
         h_wait_evt,                              # C_WAIT_EVT
     ]
 
@@ -1497,20 +1618,30 @@ def make_step(spec: ModelSpec):
             sig,
         )
 
-    def resume(sim: Sim, p, sig):
+    def resume(sim: Sim, p, sig, gate=True):
         """Resume process p with a signal: retry or abort a pending
-        command, then chain blocks until something yields."""
+        command, then chain blocks until something yields.
+
+        ``gate`` (scalar bool) disables the resume entirely: every
+        preamble write is pred-gated by it and the chain loop starts
+        pre-yielded, so a gated-off lane's output IS the input — no
+        caller-side merge needed.  (The while loop's own freeze
+        semantics — lanelast's per-lane freeze in the kernel, jax's
+        batched-while carry selects under vmap, a plain false condition
+        unbatched — already guarantee the loop body writes nothing when
+        the condition is false from iteration 0.)"""
         # any remaining wake event is stale once we are resumed
-        sim = _cancel_wake(sim, p)
+        sim = _cancel_wake(sim, p, pred=gate)
         # ANY delivery ends a wait-on-process / wait-on-event: a direct
-        # user-timer wake bypasses _abort_wait, and a surviving await_pid/
-        # await_evt would spuriously re-resume this process when the target
-        # later finishes/fires (parity: cmi_process_cancel_awaiteds runs on
-        # every signal delivery, `src/cmb_process.c:694-748`)
+        # user-timer wake bypasses the abort arm, and a surviving
+        # await_pid/await_evt would spuriously re-resume this process when
+        # the target later finishes/fires (parity:
+        # cmi_process_cancel_awaiteds runs on every signal delivery,
+        # `src/cmb_process.c:694-748`)
         sim = sim._replace(
             procs=sim.procs._replace(
-                await_pid=dyn.dset(sim.procs.await_pid, p, -1),
-                await_evt=dyn.dset(sim.procs.await_evt, p, -1),
+                await_pid=dyn.dset(sim.procs.await_pid, p, -1, gate),
+                await_evt=dyn.dset(sim.procs.await_evt, p, -1, gate),
             )
         )
 
@@ -1523,23 +1654,35 @@ def make_step(spec: ModelSpec):
         )
         has_pend = pend.tag != pr.NO_PEND
         ok_wake = jnp.asarray(sig, _I) == pr.SUCCESS
+        gated = has_pend if gate is True else (has_pend & gate)
 
-        # non-SUCCESS wake of a pended process: abort the wait — remove the
-        # guard entry; the signal flows to the continuation block below.
-        # _unwait must see the original pend_guard, so it runs BEFORE the
-        # pend bookkeeping is cleared (a cleared pend_guard would leave a
-        # zombie guard entry that steals future signals).
-        # A SUCCESS wake re-attempts the pended command as the chain's
-        # first iteration (use_pend) — handlers are traced only here.
-        aborted = _abort_wait(spec, sim, p, sig)
-        # on a SUCCESS wake the guard membership is normally gone (popped
-        # by the signal), but a user timer with sig=SUCCESS can wake a
-        # pended process directly — _clear_pend clears pend_guard, which
-        # IS the dense-guard removal, so no zombie entry can survive
-        sim = _tree_select(
-            has_pend & ~ok_wake, aborted, _clear_pend(sim, p)
+        # Unwait-BEFORE-cleanup, as _abort_wait orders it: _clear_pend
+        # must clear p's guard membership before _abort_cleanup's pool
+        # rollback signals the pool guard, or p steals its own rollback
+        # wake (best_waiter would still see p enrolled) and the waiter
+        # the signal was meant for starves.  _abort_cleanup reads the
+        # pend from the snapshot above, so clearing first is safe.
+        # (_clear_pend also covers the SUCCESS-wake path: a user timer
+        # with sig=SUCCESS can wake a pended process directly, and the
+        # cleared pend_guard IS the dense-guard removal — no zombie
+        # membership can survive.)
+        sim = _clear_pend(sim, p, pred=gate)
+        # non-SUCCESS wake of a pended process: abort the wait — the
+        # signal flows to the continuation block below.  Sequential
+        # predication instead of branch-and-merge: the preamble above
+        # already did the unwait bookkeeping (wake cancel, await clears)
+        # for EVERY path, so the abort arm is just the command-specific
+        # cleanup, pred-gated; for pool/buffer-free models it traces to
+        # nothing.  A SUCCESS wake re-attempts the pended command as the
+        # chain's first iteration (use_pend) — handlers are traced only
+        # there.
+        sim = _abort_cleanup(
+            spec, sim, p, pend, sig, pred=gated & ~ok_wake
         )
         use_pend0 = has_pend & ok_wake
+        yielded0 = (
+            jnp.asarray(False) if gate is True else ~jnp.asarray(gate)
+        )
 
         def cond(carry):
             sim, sig, yielded, n, use_pend = carry
@@ -1589,7 +1732,7 @@ def make_step(spec: ModelSpec):
             (
                 sim,
                 jnp.asarray(sig, _I),
-                jnp.asarray(False),
+                yielded0,
                 jnp.zeros((), _I),
                 use_pend0,
             ),
@@ -1609,14 +1752,14 @@ def make_step(spec: ModelSpec):
         return _set_err(sim, runaway, ERR_CHAIN_RUNAWAY)
 
     def on_proc(sim: Sim, subj, arg, gate):
-        # ONE merge for both gates (no event popped / target not alive):
-        # the chain while carries the whole Sim, so every leaf exits it
-        # as a fresh value and a merge layer costs a select per leaf —
-        # stacking the alive select under step's found select doubled
-        # that for no information
+        # NO merge at all: resume pred-gates every preamble write by
+        # (event-found & target-alive) and starts the chain pre-yielded
+        # when gated off, so a gated-off lane's resume output IS the
+        # input.  (Each merge layer here used to cost a select per Sim
+        # leaf, because the chain while returns every carried leaf as a
+        # fresh value.)
         alive = dyn.dget(sim.procs.status, subj) == pr.RUNNING
-        resumed = resume(sim, subj, arg)
-        return _tree_select(alive & gate, resumed, sim)
+        return resume(sim, subj, arg, gate=alive & gate)
 
     user_handlers = [
         (lambda fn: (

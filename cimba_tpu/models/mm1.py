@@ -29,7 +29,7 @@ L_PRODUCED = 0
 
 
 def build(
-    queue_cap: int = 256,
+    queue_cap: int = 128,
     event_cap: int = 8,
     guard_cap: int = 4,
     record: bool = True,
@@ -37,8 +37,14 @@ def build(
     """Construct the M/M/1 model; returns (spec, refs dict).
 
     ``queue_cap`` bounds the FIFO (the reference uses CMB_UNLIMITED; a
-    fixed capacity with overflow-as-failure is the jit trade — at rho=0.9
-    P(len > 256) ~ 0.9^256 ~ 2e-12 per event, masked if ever hit).
+    fixed capacity with overflow-as-failure is the jit trade).  Every
+    ring touch is a full-width vector op in the kernel, so the cap is
+    sized to the workload, not padded: at rho=0.9 the stationary
+    P(len >= 128) ~ 0.9^128 ~ 1.4e-6 per event — about 140 masked,
+    *counted* replication failures across the reference's entire
+    100M-event headline run (bias ~1e-6 relative), while halving the
+    ring's VMEM per lane vs 256.  Pass a bigger cap (or use
+    run_experiment_regrow) for heavier-tailed loads.
     ``record=False`` drops queue-length recording from the hot loop (the
     benchmark configuration, like the reference's NLOGINFO build).
     """

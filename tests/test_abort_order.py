@@ -1,0 +1,67 @@
+"""Unwait-before-cleanup ordering in resume's abort arm.
+
+Regression (caught in round-4 review): a non-SUCCESS wake of a process
+pended on a pool acquire must clear the process's guard membership
+BEFORE the pool rollback signals the pool guard — otherwise the aborted
+process steals its own rollback wake (it is still the best waiter of
+that guard), the waiter the signal was meant for starves, and the stale
+SUCCESS wake fires the aborted process's continuation immediately
+instead of whatever it blocks on next (parity: cmb_process_interrupt
+runs cmi_process_cancel_awaiteds before the command-specific unwind,
+`src/cmb_process.c:694-748`).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+
+def _build():
+    m = Model("stale", n_flocals=2, event_cap=32)
+    pool = m.resourcepool("units", capacity=3.0)
+
+    @m.block
+    def hog(sim, p, sig):
+        return sim, cmd.pool_acquire(pool.id, 3.0, next_pc=hold_it.pc)
+
+    @m.block
+    def hold_it(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=fin.pc)
+
+    @m.block
+    def fin(sim, p, sig):
+        return sim, cmd.exit_()
+
+    @m.block
+    def greedy(sim, p, sig):
+        sim, _ = api.timer_add(sim, p, 5.0, pr.TIMEOUT)
+        return sim, cmd.pool_acquire(pool.id, 2.0, next_pc=after_to.pc)
+
+    @m.block
+    def after_to(sim, p, sig):
+        # timed out at t=5; now wait for the hog to finish (t=100)
+        return sim, cmd.wait_process(0, next_pc=verdict.pc)
+
+    @m.block
+    def verdict(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim = api.set_local_f(sim, p, 1, sig.astype(jnp.float64))
+        return sim, cmd.exit_()
+
+    m.process("hog", entry=hog)
+    m.process("greedy", entry=greedy)
+    return m.build()
+
+
+def test_pool_abort_does_not_leave_stale_wake():
+    spec = _build()
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 0, 0))
+    assert int(out.err) == 0
+    # greedy's wait_process must resume when the hog exits (t=100), not
+    # via a stolen rollback wake at the timeout (t=5)
+    assert float(out.procs.locals_f[1, 0]) == 100.0
+    assert int(out.procs.locals_f[1, 1]) == pr.SUCCESS
