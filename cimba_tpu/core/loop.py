@@ -751,9 +751,7 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig,
         obtained = pend.f2 - pend.f
         sim = sim._replace(
             procs=sim.procs._replace(
-                got=dyn.dset(sim.procs.got, p,
-                    jnp.where(is_buf, obtained, dyn.dget(sim.procs.got, p))
-                )
+                got=dyn.dset(sim.procs.got, p, obtained, is_buf)
             )
         )
     return sim

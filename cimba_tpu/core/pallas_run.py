@@ -40,6 +40,8 @@ Design:
 
 from __future__ import annotations
 
+import os
+
 from functools import partial
 from typing import Optional
 
@@ -53,6 +55,24 @@ from cimba_tpu import config
 from cimba_tpu.core import bool32, dyn, lanelast
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core.model import ModelSpec
+
+
+def _vmem_limit_bytes() -> int:
+    """Mosaic scoped-vmem budget for the chunk kernel, in bytes.
+
+    Default 96 MiB (v5e has 128 MiB; the 16 MiB Mosaic default rejects
+    the whole-Sim-resident kernel above L≈1024 — measured offline,
+    BENCH_NOTES round 4).  Override with ``CIMBA_KERNEL_VMEM_LIMIT``."""
+    raw = os.environ.get("CIMBA_KERNEL_VMEM_LIMIT", "").strip()
+    if not raw:
+        return 96 * 1024 * 1024
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"CIMBA_KERNEL_VMEM_LIMIT must be an integer byte count, "
+            f"got {raw!r}"
+        ) from e
 
 
 def make_kernel_run(
@@ -208,6 +228,19 @@ def make_kernel_run(
             out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n,
             input_output_aliases={i: i for i in range(n)},
             interpret=interpret,
+            # Mosaic's scoped-vmem budget defaults to 16 MiB; the
+            # whole-Sim-resident kernel's temporaries pass that around
+            # L≈2048 lanes (measured offline: 20.4M @ 2048, 24.0M @
+            # 4096) while v5e has 128 MiB of VMEM.  Budget for the
+            # bench's L=4096 with headroom; harmless when interpret or
+            # on CPU (ignored).
+            compiler_params=(
+                None
+                if interpret
+                else pltpu.CompilerParams(
+                    vmem_limit_bytes=_vmem_limit_bytes()
+                )
+            ),
         )
 
         def chunk_fn(*ls):
