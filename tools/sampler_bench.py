@@ -1,0 +1,97 @@
+"""On-device sampler speed comparison (parity: the reference's sampler
+speed battery, `test/test_random.c:193-245` — it ships measured
+comparisons of its generator variants; this is ours, sized like the
+bench).
+
+Compares, at bulk-bench sizes (R vmapped streams x N draws per stream):
+
+* inversion samplers in plain XLA (`distributions.std_exponential` /
+  `std_normal` scanned per-stream),
+* ziggurat samplers in plain XLA (`ziggurat.std_*_zig`),
+* the Pallas block kernels (`pallas_kernels.*_block[,_zig]`).
+
+Run (auto-selects the default backend; CPU fallback prints backend so a
+wedged tunnel can't masquerade as a TPU number):
+
+    python tools/sampler_bench.py [R] [N]
+
+Prints one JSON line per variant: samples/s, backend, config.  Results
+decide the framework's default sampler per backend (BENCH_NOTES).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    N = int(sys.argv[2]) if len(sys.argv) > 2 else 65_536
+
+    from cimba_tpu.random import bits, distributions as dist, ziggurat as zig
+    from cimba_tpu.random import pallas_kernels as pk
+
+    backend = jax.devices()[0].platform
+    interpret = backend == "cpu"
+    states = jax.vmap(bits.initialize, in_axes=(None, 0))(
+        2026, jnp.arange(R)
+    )
+
+    def scanned(draw):
+        """Per-stream sequential draw loop, vmapped over R streams —
+        the engine's access pattern (one draw per event)."""
+
+        def one(st):
+            def body(st, _):
+                st, x = draw(st)
+                return st, x
+
+            _, xs = lax.scan(body, st, None, length=N)
+            return xs
+
+        return jax.jit(jax.vmap(one))
+
+    def timed(name, fn, *args):
+        out = jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        dt = time.perf_counter() - t0
+        rate = R * N / dt
+        print(json.dumps({
+            "sampler": name, "samples_per_sec": rate, "backend": backend,
+            "R": R, "N": N, "wall_s": round(dt, 4),
+        }), flush=True)
+        return out
+
+    timed("exp_inversion_xla", scanned(dist.std_exponential), states)
+    timed("exp_ziggurat_xla", scanned(zig.std_exponential_zig), states)
+    timed("nor_inversion_xla", scanned(dist.std_normal), states)
+    timed("nor_ziggurat_xla", scanned(zig.std_normal_zig), states)
+    timed(
+        "exp_inversion_pallas",
+        jax.jit(lambda s: pk.exponential_block(s, N, interpret=interpret)),
+        states,
+    )
+    timed(
+        "exp_ziggurat_pallas",
+        jax.jit(
+            lambda s: pk.exponential_block_zig(s, N, interpret=interpret)
+        ),
+        states,
+    )
+    timed(
+        "nor_inversion_pallas",
+        jax.jit(lambda s: pk.normal_block(s, N, interpret=interpret)),
+        states,
+    )
+
+
+if __name__ == "__main__":
+    main()
