@@ -14,7 +14,7 @@ is arbitrary traced compute).  Per-target processes stay as framework
 processes (count=N instances of one type), exercising the engine at the
 reference's process counts.
 
-Model state: user["pos"] [N,2], user["vel"] [N,2] updated lazily — each
+Model state: user["pos_x/y"], user["vel_x/y"] [N] columns updated lazily — each
 target process re-draws its leg at leg-end events; the sensor extrapolates
 positions analytically between updates (pos + vel * (t - t_mark)), so
 movement costs nothing between events, exactly like the reference storing
@@ -177,8 +177,15 @@ def build(n_targets: int, scoring: str = "nn"):
         (t_end,) = params
         return {
             "t_end": jnp.asarray(t_end, _R),
-            "pos": jnp.zeros((n_targets, 2), _R),
-            "vel": jnp.zeros((n_targets, 2), _R),
+            # positions/velocities as split x/y [N] columns, not [N,2]:
+            # per-event one-hot row access on [N,2] pays a rank-expanded
+            # mask (2N elements per op); split columns share the cached
+            # [N] one-hot at exactly matching shape, halving the footprint of the
+            # kernel path's hottest model-side ops
+            "pos_x": jnp.zeros((n_targets,), _R),
+            "pos_y": jnp.zeros((n_targets,), _R),
+            "vel_x": jnp.zeros((n_targets,), _R),
+            "vel_y": jnp.zeros((n_targets,), _R),
             "t_mark": jnp.zeros((n_targets,), _R),
             "detections": sm.empty(),  # per-dwell detection counts
             "dwells": jnp.zeros((), _I),
@@ -186,7 +193,13 @@ def build(n_targets: int, scoring: str = "nn"):
 
     def _current_positions(sim):
         dt = sim.clock - sim.user["t_mark"]
-        return sim.user["pos"] + sim.user["vel"] * dt[:, None]
+        return jnp.stack(
+            [
+                sim.user["pos_x"] + sim.user["vel_x"] * dt,
+                sim.user["pos_y"] + sim.user["vel_y"] * dt,
+            ],
+            axis=1,
+        )
 
     @m.block
     def tgt_leg(sim, p, sig):
@@ -196,27 +209,29 @@ def build(n_targets: int, scoring: str = "nn"):
         # fold the position forward to now, then draw a new velocity
         # one-hot dynamic reads (dyn.dget): a raw traced-index gather has
         # no Mosaic lowering for the kernel path
-        pos_now = dyn.dget(sim.user["pos"], idx) + dyn.dget(
-            sim.user["vel"], idx
-        ) * (sim.clock - dyn.dget(sim.user["t_mark"], idx))
+        dt = sim.clock - dyn.dget(sim.user["t_mark"], idx)
+        px = dyn.dget(sim.user["pos_x"], idx) + dyn.dget(sim.user["vel_x"], idx) * dt
+        py = dyn.dget(sim.user["pos_y"], idx) + dyn.dget(sim.user["vel_y"], idx) * dt
         # soft-bounce: if outside the arena, head back toward the center.
         # Directions are selected as unit VECTORS, not heading angles:
         # cos/sin(arctan2(-y,-x)) in closed form is just -pos/|pos|, and
         # atan2 has no Pallas TPU lowering (the kernel path compiles this
         # block through Mosaic).
         sim, heading = api.draw(sim, cr.uniform, 0.0, 2.0 * jnp.pi)
-        rand_dir = jnp.stack([jnp.cos(heading), jnp.sin(heading)])
-        r = jnp.sqrt(jnp.sum(pos_now * pos_now))
+        r = jnp.sqrt(px * px + py * py)
         outside = r > ARENA
-        center_dir = -pos_now / jnp.maximum(r, 1e-6)
-        vel = SPEED * jnp.where(outside, center_dir, rand_dir)
+        inv_r = 1.0 / jnp.maximum(r, 1e-6)
+        vx = SPEED * jnp.where(outside, -px * inv_r, jnp.cos(heading))
+        vy = SPEED * jnp.where(outside, -py * inv_r, jnp.sin(heading))
         u = sim.user
         sim = api.set_user(
             sim,
             {
                 **u,
-                "pos": dyn.dset(u["pos"], idx, pos_now),
-                "vel": dyn.dset(u["vel"], idx, vel),
+                "pos_x": dyn.dset(u["pos_x"], idx, px),
+                "pos_y": dyn.dset(u["pos_y"], idx, py),
+                "vel_x": dyn.dset(u["vel_x"], idx, vx),
+                "vel_y": dyn.dset(u["vel_y"], idx, vy),
                 "t_mark": dyn.dset(u["t_mark"], idx, sim.clock),
             },
         )
@@ -242,7 +257,10 @@ def build(n_targets: int, scoring: str = "nn"):
         # whole dwell (scan noise)
         sim, noise = api.draw(sim, cr.uniform01)
         if scoring == "nn":
-            p_det = nn_scores(pos, sim.user["vel"]).astype(_R)
+            vel = jnp.stack(
+                [sim.user["vel_x"], sim.user["vel_y"]], axis=1
+            )
+            p_det = nn_scores(pos, vel).astype(_R)
         else:
             r2 = jnp.sum(pos * pos, axis=1)
             p_det = jnp.clip(1.2 - jnp.sqrt(r2) / DETECT_RANGE, 0.0, 1.0)

@@ -62,7 +62,10 @@ def test_awacs_positions_stay_in_arena_neighborhood():
     spec, _ = awacs.build(16)
     run = cl.make_run(spec)
     sim = jax.jit(run)(cl.init_sim(spec, 4, 0, awacs.params(50.0)))
-    pos = np.asarray(sim.user["pos"])
+    pos = np.stack(
+        [np.asarray(sim.user["pos_x"]), np.asarray(sim.user["pos_y"])],
+        axis=1,
+    )
     # soft-bounce keeps targets within arena + one leg's travel
     assert np.linalg.norm(pos, axis=1).max() < awacs.ARENA + awacs.SPEED * 30
 
