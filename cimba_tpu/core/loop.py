@@ -577,19 +577,25 @@ def _cancel_wake(sim: Sim, p, pred=True) -> Sim:
     return sim._replace(wakes=ev.wake_clear(sim.wakes, p, pred))
 
 
-def _unwait(sim: Sim, p, pred=True) -> Sim:
+def _unwait(spec: ModelSpec, sim: Sim, p, pred=True) -> Sim:
     """Detach p from whatever it waits on: guard membership, pending
     command, wake event (parity: cmi_process_cancel_awaiteds,
     `src/cmb_process.c:694-748`).  Dense guards: clearing ``pend_guard``
-    (done by _clear_pend) IS the guard removal."""
-    sim = _clear_pend(sim, p, pred)
+    (done by _clear_pend) IS the guard removal.  Statics: bookkeeping a
+    model's command set cannot populate stays out of the trace."""
+    if _may_pend(spec, sim):
+        sim = _clear_pend(sim, p, pred)
     sim = _cancel_wake(sim, p, pred)
-    return sim._replace(
-        procs=sim.procs._replace(
-            await_pid=dyn.dset(sim.procs.await_pid, p, -1, pred),
-            await_evt=dyn.dset(sim.procs.await_evt, p, -1, pred),
+    procs = sim.procs
+    if _may_wait_procs(spec, sim):
+        procs = procs._replace(
+            await_pid=dyn.dset(procs.await_pid, p, -1, pred)
         )
-    )
+    if _may_wait_events(spec, sim):
+        procs = procs._replace(
+            await_evt=dyn.dset(procs.await_evt, p, -1, pred)
+        )
+    return sim._replace(procs=procs)
 
 
 def _scan_evt_waiters(sim: Sim, decide) -> Sim:
@@ -763,6 +769,10 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig, pred=True) -> Sim:
     wait-aborting path — timer/interrupt delivery, preemption, mugging,
     stop — must come through here; clearing the pend without the cleanup
     silently breaks the rollback/partial-fulfillment contracts."""
+    if not _may_pend(spec, sim):
+        # nothing can ever pend: no snapshot, no command-specific
+        # cleanup — unwait is the whole abort
+        return _unwait(spec, sim, p, pred)
     pend = pr.Command(
         dyn.dget(sim.procs.pend_tag, p),
         dyn.dget(sim.procs.pend_f, p),
@@ -772,7 +782,7 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig, pred=True) -> Sim:
     )
     # _abort_cleanup self-gates on pend.tag, so NO_PEND is a clean no-op
     return _abort_cleanup(
-        spec, _unwait(sim, p, pred), p, pend, sig, pred=pred
+        spec, _unwait(spec, sim, p, pred), p, pend, sig, pred=pred
     )
 
 
@@ -994,6 +1004,25 @@ def _may_wait_procs(spec: ModelSpec, sim: Sim) -> bool:
     waiter mass-wake out of models that never wait on processes."""
     used = _used_tags_for(spec, sim)
     return used is None or pr.C_WAIT_PROC in used
+
+
+#: command tags whose handlers can pend (block through _guard_wait) —
+#: the only writers of procs.pend_tag
+_PENDING_TAGS = frozenset({
+    pr.C_PUT, pr.C_GET, pr.C_ACQUIRE, pr.C_PREEMPT, pr.C_POOL_ACQ,
+    pr.C_POOL_PRE, pr.C_BUF_GET, pr.C_BUF_PUT, pr.C_PQ_PUT, pr.C_PQ_GET,
+    pr.C_COND_WAIT,
+})
+
+
+def _may_pend(spec: ModelSpec, sim: Sim) -> bool:
+    """Static: can ANY command this model emits block through a guard?
+    If not, ``pend_tag`` stays NO_PEND forever and resume's whole
+    retry/abort arm — the pend reads, the per-chain-iteration use_pend
+    merge, the clears — gates out of the trace (hold/exit-only models
+    like AWACS keep only the wake bookkeeping)."""
+    used = _used_tags_for(spec, sim)
+    return used is None or bool(_PENDING_TAGS & set(used))
 
 
 def _make_apply(spec: ModelSpec, used_tags=None):
@@ -1628,6 +1657,11 @@ def make_step(spec: ModelSpec):
         batched-while carry selects under vmap, a plain false condition
         unbatched — already guarantee the loop body writes nothing when
         the condition is false from iteration 0.)"""
+        # statics: machinery a model cannot exercise stays out of the
+        # trace entirely (the flags derive from the inferred command-tag
+        # set, memoized per spec)
+        may_pend = _may_pend(spec, sim)
+
         # any remaining wake event is stale once we are resumed
         sim = _cancel_wake(sim, p, pred=gate)
         # ANY delivery ends a wait-on-process / wait-on-event: a direct
@@ -1635,49 +1669,60 @@ def make_step(spec: ModelSpec):
         # await_pid/await_evt would spuriously re-resume this process when
         # the target later finishes/fires (parity:
         # cmi_process_cancel_awaiteds runs on every signal delivery,
-        # `src/cmb_process.c:694-748`)
-        sim = sim._replace(
-            procs=sim.procs._replace(
-                await_pid=dyn.dset(sim.procs.await_pid, p, -1, gate),
-                await_evt=dyn.dset(sim.procs.await_evt, p, -1, gate),
+        # `src/cmb_process.c:694-748`); statically absent when the model
+        # cannot wait on processes/events
+        procs2 = sim.procs
+        if _may_wait_procs(spec, sim):
+            procs2 = procs2._replace(
+                await_pid=dyn.dset(procs2.await_pid, p, -1, gate)
             )
-        )
+        if _may_wait_events(spec, sim):
+            procs2 = procs2._replace(
+                await_evt=dyn.dset(procs2.await_evt, p, -1, gate)
+            )
+        sim = sim._replace(procs=procs2)
 
-        pend = pr.Command(
-            dyn.dget(sim.procs.pend_tag, p),
-            dyn.dget(sim.procs.pend_f, p),
-            dyn.dget(sim.procs.pend_f2, p),
-            dyn.dget(sim.procs.pend_i, p),
-            dyn.dget(sim.procs.pend_pc, p),
-        )
-        has_pend = pend.tag != pr.NO_PEND
-        ok_wake = jnp.asarray(sig, _I) == pr.SUCCESS
-        gated = has_pend if gate is True else (has_pend & gate)
+        if may_pend:
+            pend = pr.Command(
+                dyn.dget(sim.procs.pend_tag, p),
+                dyn.dget(sim.procs.pend_f, p),
+                dyn.dget(sim.procs.pend_f2, p),
+                dyn.dget(sim.procs.pend_i, p),
+                dyn.dget(sim.procs.pend_pc, p),
+            )
+            has_pend = pend.tag != pr.NO_PEND
+            ok_wake = jnp.asarray(sig, _I) == pr.SUCCESS
+            gated = has_pend if gate is True else (has_pend & gate)
 
-        # Unwait-BEFORE-cleanup, as _abort_wait orders it: _clear_pend
-        # must clear p's guard membership before _abort_cleanup's pool
-        # rollback signals the pool guard, or p steals its own rollback
-        # wake (best_waiter would still see p enrolled) and the waiter
-        # the signal was meant for starves.  _abort_cleanup reads the
-        # pend from the snapshot above, so clearing first is safe.
-        # (_clear_pend also covers the SUCCESS-wake path: a user timer
-        # with sig=SUCCESS can wake a pended process directly, and the
-        # cleared pend_guard IS the dense-guard removal — no zombie
-        # membership can survive.)
-        sim = _clear_pend(sim, p, pred=gate)
-        # non-SUCCESS wake of a pended process: abort the wait — the
-        # signal flows to the continuation block below.  Sequential
-        # predication instead of branch-and-merge: the preamble above
-        # already did the unwait bookkeeping (wake cancel, await clears)
-        # for EVERY path, so the abort arm is just the command-specific
-        # cleanup, pred-gated; for pool/buffer-free models it traces to
-        # nothing.  A SUCCESS wake re-attempts the pended command as the
-        # chain's first iteration (use_pend) — handlers are traced only
-        # there.
-        sim = _abort_cleanup(
-            spec, sim, p, pend, sig, pred=gated & ~ok_wake
-        )
-        use_pend0 = has_pend & ok_wake
+            # Unwait-BEFORE-cleanup, as _abort_wait orders it: _clear_pend
+            # must clear p's guard membership before _abort_cleanup's pool
+            # rollback signals the pool guard, or p steals its own rollback
+            # wake (best_waiter would still see p enrolled) and the waiter
+            # the signal was meant for starves.  _abort_cleanup reads the
+            # pend from the snapshot above, so clearing first is safe.
+            # (_clear_pend also covers the SUCCESS-wake path: a user timer
+            # with sig=SUCCESS can wake a pended process directly, and the
+            # cleared pend_guard IS the dense-guard removal — no zombie
+            # membership can survive.)
+            sim = _clear_pend(sim, p, pred=gate)
+            # non-SUCCESS wake of a pended process: abort the wait — the
+            # signal flows to the continuation block below.  Sequential
+            # predication instead of branch-and-merge: the preamble above
+            # already did the unwait bookkeeping (wake cancel, await
+            # clears) for EVERY path, so the abort arm is just the
+            # command-specific cleanup, pred-gated; for pool/buffer-free
+            # models it traces to nothing.  A SUCCESS wake re-attempts the
+            # pended command as the chain's first iteration (use_pend) —
+            # handlers are traced only there.
+            sim = _abort_cleanup(
+                spec, sim, p, pend, sig, pred=gated & ~ok_wake
+            )
+            use_pend0 = has_pend & ok_wake
+        else:
+            # nothing can ever pend: no retry arm, no use_pend merge in
+            # the chain body, no pend bookkeeping
+            pend = None
+            use_pend0 = jnp.asarray(False)
         yielded0 = (
             jnp.asarray(False) if gate is True else ~jnp.asarray(gate)
         )
@@ -1689,7 +1734,14 @@ def make_step(spec: ModelSpec):
 
         def body(carry):
             sim, sig, _, n, use_pend = carry
-            if config.KERNEL_MODE:
+            if not may_pend:
+                # no retry arm exists: the block always runs and its
+                # command applies directly (no use_pend merge at all)
+                if config.KERNEL_MODE and spec.boundary_pcs:
+                    in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
+                    sim = _set_err(sim, in_b, ERR_BOUNDARY)
+                sim2, cmd = run_block(sim, p, sig)
+            elif config.KERNEL_MODE:
                 if spec.boundary_pcs:
                     # boundary blocks may only be entered by dispatch
                     # (which the kernel defers to the chunk driver) —
@@ -1714,7 +1766,10 @@ def make_step(spec: ModelSpec):
                     lambda s: run_block(s, p, sig),
                     sim,
                 )
-            sim2, yielded = apply_command(sim2, p, cmd, is_retry=use_pend)
+            sim2, yielded = apply_command(
+                sim2, p, cmd,
+                is_retry=use_pend if may_pend else False,
+            )
             return (
                 sim2,
                 jnp.asarray(pr.SUCCESS, _I),
