@@ -32,7 +32,14 @@ L_PRODUCED = 0
 
 
 def build(queue_cap: int = 512):
-    """M/G/1: exponential arrivals, lognormal service of given mean/CV."""
+    """M/G/1: exponential arrivals, lognormal service of given mean/CV.
+
+    ``queue_cap`` stays 512 (unlike mm1's 128): the sweep this model
+    exists for (`sweep_params`) reaches rho=0.9 with CV=2.0, where
+    Lq = rho^2(1+CV^2)/(2(1-rho)) ~ 20 and the (subexponential
+    lognormal-service) tail puts P(len >= 128) near 1e-3 per event —
+    a 128 ring would routinely overflow the heavy cells.  Callers
+    running only light cells can pass a smaller cap."""
     m = Model("mg1", n_ilocals=1, event_cap=8, guard_cap=4)
     q = m.objectqueue("buffer", capacity=queue_cap)
 
