@@ -107,6 +107,16 @@ def queue_length(sim: Sim, q):
     return sim.queues.size[q.id if hasattr(q, "id") else q]
 
 
+def queue_space(sim: Sim, q):
+    """Free slots in an object queue (parity: ``cmb_objectqueue_space``,
+    `include/cmb_objectqueue.h`).  Requires the QueueRef: the declared
+    capacity lives there, and the shared ring width a bare id could
+    read can be wider than this queue's real capacity."""
+    if not hasattr(q, "capacity"):
+        raise TypeError("queue_space needs the QueueRef, not a bare id")
+    return (jnp.asarray(q.capacity, _I) - sim.queues.size[q.id]).astype(_I)
+
+
 def queue_position(sim: Sim, q, item):
     """1-based position of the first item equal to ``item`` (nearest the
     front), 0 if absent (parity: cmb_objectqueue_position,
@@ -161,6 +171,38 @@ def pool_level(sim: Sim, pool):
 def buffer_level(sim: Sim, b):
     """Stored amount in a buffer (parity: cmb_buffer_level)."""
     return sim.buffers.level[b.id if hasattr(b, "id") else b]
+
+
+def buffer_space(sim: Sim, b):
+    """Room left in a buffer (parity: ``cmb_buffer_space``,
+    `include/cmb_buffer.h`).  Requires the BufferRef (capacity is
+    declared there, not stored in the Sim)."""
+    if not hasattr(b, "capacity"):
+        raise TypeError("buffer_space needs the BufferRef, not a bare id")
+    return jnp.asarray(b.capacity, _R) - sim.buffers.level[b.id]
+
+
+def pool_in_use(sim: Sim, pool):
+    """Units currently held out of a pool (parity:
+    ``cmb_resourcepool_in_use``).  Requires the PoolRef (capacity is
+    declared there, not stored in the Sim)."""
+    if not hasattr(pool, "capacity"):
+        raise TypeError("pool_in_use needs the PoolRef, not a bare id")
+    return jnp.asarray(pool.capacity, _R) - sim.pools.level[pool.id]
+
+
+def pool_held(sim: Sim, pool, p):
+    """Units process ``p`` holds from a pool (parity:
+    ``cmb_resourcepool_held_by_process``,
+    `include/cmb_resourcepool.h:118`)."""
+    k = pool.id if hasattr(pool, "id") else pool
+    return dyn.dget2(sim.pools.held, k, p)
+
+
+def proc_priority(sim: Sim, p):
+    """Current process priority (parity: ``cmb_process_priority``;
+    the setter is :func:`priority_set`)."""
+    return dyn.dget(sim.procs.prio, p)
 
 
 def pqueue_length(sim: Sim, q):
@@ -242,6 +284,45 @@ def cond_signal(sim: Sim, spec, condition) -> Sim:
 def proc_status(sim: Sim, p):
     """CREATED/RUNNING/FINISHED (parity: cmb_process_status)."""
     return dyn.dget(sim.procs.status, p)
+
+
+def event_is_scheduled(sim: Sim, handle):
+    """True while ``handle`` names a live scheduled event (parity:
+    ``cmb_event_is_scheduled``, `include/cmb_event.h:196` — generation
+    tags make a fired/cancelled/reused slot report False)."""
+    from cimba_tpu.core import eventset as _ev
+
+    return _ev._valid(sim.events, jnp.asarray(handle, _I))
+
+
+def event_time(sim: Sim, handle):
+    """Scheduled activation time of a live event, ``+inf`` for a dead
+    handle (parity: ``cmb_event_time``, `include/cmb_event.h:205` — the
+    reference errors on a dead handle; here the sentinel composes with
+    jit, and :func:`event_is_scheduled` is the validity check)."""
+    from cimba_tpu.core import eventset as _ev
+
+    h = jnp.asarray(handle, _I)
+    slot = _ev._slot_of(h)
+    return jnp.where(
+        _ev._valid(sim.events, h),
+        dyn.dget(sim.events.time, slot),
+        jnp.asarray(jnp.inf, sim.events.time.dtype),
+    )
+
+
+def event_priority(sim: Sim, handle):
+    """Dispatch priority of a live event, 0 for a dead handle (parity:
+    ``cmb_event_priority``, `include/cmb_event.h:214`)."""
+    from cimba_tpu.core import eventset as _ev
+
+    h = jnp.asarray(handle, _I)
+    slot = _ev._slot_of(h)
+    return jnp.where(
+        _ev._valid(sim.events, h),
+        dyn.dget(sim.events.prio, slot),
+        jnp.zeros((), _I),
+    )
 
 
 def event_reschedule(sim: Sim, handle, new_t):

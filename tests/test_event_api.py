@@ -110,6 +110,56 @@ def test_reprioritize_reorders_same_time():
     assert out.user["order"].tolist() == [2, 1]
 
 
+def test_handle_getters_and_component_space():
+    """event_is_scheduled/time/priority track the handle lifecycle;
+    queue_space/buffer_space/pool_held/pool_in_use/proc_priority read
+    live component state (parity: the cmb_* getter surface)."""
+    from cimba_tpu.core.model import Model as _M
+
+    m = _M("getters", event_cap=16)
+    q = m.objectqueue("q", capacity=8, record=False)
+    b = m.buffer("b", capacity=20.0, initial=5.0)
+    pl = m.resourcepool("pool", capacity=6.0)
+
+    @m.handler
+    def noop(sim, subj, arg):
+        return sim
+
+    @m.block
+    def driver(sim, p, sig):
+        sim, h = api.schedule(sim, 25.0, 3, noop)
+        ok = api.event_is_scheduled(sim, h)
+        ok = ok & (api.event_time(sim, h) == 25.0)
+        ok = ok & (api.event_priority(sim, h) == 3)
+        sim, _ = api.event_cancel(sim, h)
+        ok = ok & ~api.event_is_scheduled(sim, h)
+        ok = ok & jnp.isinf(api.event_time(sim, h))
+        ok = ok & (api.queue_space(sim, q) == 8)
+        ok = ok & (api.buffer_space(sim, b) == 15.0)
+        ok = ok & (api.pool_in_use(sim, pl) == 0.0)
+        ok = ok & (api.proc_priority(sim, p) == 2)
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.put(q.id, 1.5, next_pc=d2.pc)
+
+    @m.block
+    def d2(sim, p, sig):
+        ok = api.queue_space(sim, q) == 7
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.pool_acquire(pl.id, 2.5, next_pc=d3.pc)
+
+    @m.block
+    def d3(sim, p, sig):
+        ok = (api.pool_held(sim, pl, p) == 2.5) & (
+            api.pool_in_use(sim, pl) == 2.5
+        )
+        sim = api.fail(sim, ~ok)
+        return sim, cmd.exit_()
+
+    m.process("driver", entry=driver, prio=2)
+    out, _ = run1(m)
+    assert int(out.err) == 0
+
+
 def test_pattern_count_find_cancel():
     """Count by kind wildcard, find the soonest match, cancel by pattern;
     the found handle round-trips through event_reschedule."""
