@@ -231,6 +231,19 @@ def stop_process(sim: Sim, spec, target) -> Sim:
     return _loop.stop_process(spec, sim, target)
 
 
+def spawn(sim: Sim, ptype, at=None, prio=None):
+    """(sim, pid): activate one row of a spawn pool — a process type
+    declared ``m.process(name, entry, count=N, start=False)``.  Picks
+    the lowest-pid CREATED/FINISHED row, resets its state, and arms its
+    entry wake at ``at`` (default now); pid == -1 when all N rows are
+    RUNNING (parity: runtime ``cmb_process_create``/``start``,
+    `include/cmb_process.h:119-180` — the pool is declared, activation
+    is dynamic)."""
+    from cimba_tpu.core import loop as _loop
+
+    return _loop.spawn_process(sim, ptype, at=at, prio=prio)
+
+
 def timer_add(sim: Sim, p, dur, sig):
     """(sim, handle): deliver ``sig`` to p after ``dur`` unless cancelled
     (parity: cmb_process_timer_add)."""

@@ -163,18 +163,27 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
     procs = pr.create(
         spec.proc_entry, spec.proc_prio, spec.n_flocals, spec.n_ilocals
     )
-    # process starts are dense wakes at t0, consuming seqs 0..P-1 exactly
-    # as the former per-start ev.schedule calls did (golden-stable order)
+    # process starts are dense wakes at t0, consuming seqs 0..n_started-1
+    # in pid order exactly as the former per-start ev.schedule calls did
+    # (golden-stable: all-started models get seq=arange(P)).  Spawn-pool
+    # rows (proc_start False) stay CREATED with no wake until api.spawn.
+    import numpy as _np
+
+    started_np = _np.asarray(spec.proc_start, bool)
+    started = jnp.asarray(started_np)
+    seq0 = _np.cumsum(started_np) - started_np  # rank among started
     wakes = ev.wakes_create(spec.n_procs)._replace(
-        time=jnp.full((spec.n_procs,), t0, config.TIME),
+        time=jnp.where(started, jnp.asarray(t0, config.TIME), ev.NEVER),
         sig=jnp.full((spec.n_procs,), pr.SUCCESS, _I),
-        seq=jnp.arange(spec.n_procs, dtype=_I),
+        seq=jnp.asarray(seq0, _I),
     )
     events = events._replace(
-        next_seq=jnp.asarray(spec.n_procs, _I)
+        next_seq=jnp.asarray(int(started_np.sum()), _I)
     )
     procs = procs._replace(
-        status=jnp.full((spec.n_procs,), pr.RUNNING, _I),
+        status=jnp.where(
+            started, jnp.asarray(pr.RUNNING, _I), jnp.asarray(pr.CREATED, _I)
+        ),
     )
     user = spec.user_init(params) if spec.user_init else jnp.zeros(())
     t0 = jnp.asarray(t0, _T)
@@ -869,6 +878,50 @@ def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
     target = jnp.asarray(target, _I)
     alive = dyn.dget(sim.procs.status, target) == pr.RUNNING
     return finish_process(spec, sim, target, pr.STOPPED, pred=alive)
+
+
+def spawn_process(sim: Sim, pt, at=None, prio=None):
+    """Activate one row of a spawn pool (a process type declared with
+    ``start=False``); returns ``(sim, pid)`` with pid == -1 when every
+    row of the pool is currently RUNNING.
+
+    The jit answer to runtime process creation
+    (`cmb_process_create`/`cmb_process_start`,
+    `include/cmb_process.h:119-180`): the pool's rows are declared
+    statically, activation picks the lowest-pid CREATED-or-FINISHED row,
+    resets its per-process state, and arms its entry wake at ``at``
+    (default: now).  FINISHED rows are recycled — their timers were
+    pattern-cancelled and waiters woken at exit, so reuse is clean."""
+    lo, n = pt.first_pid, pt.count
+    if lo < 0:
+        raise ValueError("spawn_process needs a built model's ProcessType")
+    pididx = jnp.arange(sim.procs.pc.shape[0], dtype=_I)
+    in_pool = (pididx >= lo) & (pididx < lo + n)
+    free = in_pool & (
+        (sim.procs.status == pr.CREATED)
+        | (sim.procs.status == pr.FINISHED)
+    )
+    found = jnp.any(free)
+    slot = _argmax32(free).astype(_I)  # lowest free pid (first True)
+    p = jnp.where(found, slot, 0)
+    new_prio = jnp.asarray(pt.prio if prio is None else prio, _I)
+    procs = sim.procs._replace(
+        status=dyn.dset(sim.procs.status, p, pr.RUNNING, found),
+        pc=dyn.dset(sim.procs.pc, p, pt.entry_pc, found),
+        prio=dyn.dset(sim.procs.prio, p, new_prio, found),
+        got=dyn.dset(sim.procs.got, p, 0.0, found),
+        exit_sig=dyn.dset(sim.procs.exit_sig, p, 0, found),
+        await_pid=dyn.dset(sim.procs.await_pid, p, -1, found),
+        await_evt=dyn.dset(sim.procs.await_evt, p, -1, found),
+        pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND, found),
+        pend_guard=dyn.dset(sim.procs.pend_guard, p, -1, found),
+        locals_f=dyn.dset(sim.procs.locals_f, p, 0.0, found),
+        locals_i=dyn.dset(sim.procs.locals_i, p, 0, found),
+    )
+    sim = sim._replace(procs=procs)
+    t = sim.clock if at is None else jnp.asarray(at, _T)
+    sim = _schedule_wake(sim, found, p, pr.SUCCESS, t=t)
+    return sim, jnp.where(found, slot, jnp.asarray(-1, _I))
 
 
 def timer_add(sim: Sim, p, dur, sig):

@@ -99,6 +99,10 @@ class ProcessType:
     entry_pc: int
     prio: int
     count: int
+    #: False = the rows exist but stay CREATED until api.spawn activates
+    #: them (parity: runtime cmb_process_create/start — under jit the
+    #: process POOL is declared, activation is dynamic)
+    start: bool = True
     first_pid: int = -1  # assigned at build
 
 
@@ -110,6 +114,9 @@ class ModelSpec:
     blocks: List[Callable]
     proc_entry: np.ndarray     # [P] i32
     proc_prio: np.ndarray      # [P] i32
+    #: [P] bool — False rows are spawn-pool members: they stay CREATED
+    #: at init until api.spawn activates them
+    proc_start: np.ndarray
     proc_names: List[str]
     queues: List[QueueRef]
     resources: List[ResourceRef]
@@ -203,10 +210,17 @@ class Model:
         self._boundary_pcs.append(fn.pc)
         return fn
 
-    def process(self, name: str, entry, *, prio: int = 0, count: int = 1):
+    def process(self, name: str, entry, *, prio: int = 0, count: int = 1,
+                start: bool = True):
         """Declare ``count`` instances of a process type starting at block
-        ``entry`` (a function registered with :meth:`block`)."""
-        pt = ProcessType(name, entry.pc, prio, count)
+        ``entry`` (a function registered with :meth:`block`).
+
+        ``start=False`` declares a SPAWN POOL: the rows exist but stay
+        CREATED until a block activates one with ``api.spawn(sim, pt)``
+        — the jit answer to the reference's runtime process creation
+        (`cmb_process_create`/`cmb_process_start`); finished rows are
+        recycled by later spawns."""
+        pt = ProcessType(name, entry.pc, prio, count, start)
         self._types.append(pt)
         return pt
 
@@ -312,12 +326,13 @@ class Model:
     def build(self) -> ModelSpec:
         if not self._types:
             raise ValueError("model has no processes")
-        entries, prios, names = [], [], []
+        entries, prios, names, started = [], [], [], []
         for pt in self._types:
             pt.first_pid = len(entries)
             for k in range(pt.count):
                 entries.append(pt.entry_pc)
                 prios.append(pt.prio)
+                started.append(pt.start)
                 names.append(pt.name if pt.count == 1 else f"{pt.name}[{k}]")
         from cimba_tpu.utils import logger as _logger
 
@@ -327,6 +342,7 @@ class Model:
             blocks=list(self._blocks),
             proc_entry=np.asarray(entries, np.int32),
             proc_prio=np.asarray(prios, np.int32),
+            proc_start=np.asarray(started, np.bool_),
             proc_names=names,
             queues=list(self._queues),
             resources=list(self._resources),

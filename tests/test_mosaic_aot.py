@@ -106,6 +106,42 @@ def _build_wev():
     m.process("sched", entry=s_go, count=3)
     return m.build(), None
 
+# spawn-pool fixture: keeps spawn_process's in-kernel free-row scan
+# (the (status==CREATED)|(status==FINISHED) & in-pool bool chain and
+# the row resets) under real Mosaic coverage
+def _build_spawn():
+    import cimba_tpu.random as cr
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("aot_spawn", n_flocals=1, event_cap=8)
+
+    @m.user_state
+    def init(params):
+        return {{"n": jnp.zeros((), jnp.int32)}}
+
+    @m.block
+    def src(sim, p, sig):
+        sim, pid = api.spawn(sim, pool)
+        sim = api.set_user(sim, {{"n": sim.user["n"] + (pid >= 0)}})
+        sim = api.stop(sim, sim.user["n"] >= 10)
+        sim, t = api.draw(sim, cr.exponential, 1.0)
+        return sim, cmd.hold(t, next_pc=src.pc)
+
+    @m.block
+    def worker(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        sim, t = api.draw(sim, cr.exponential, 0.5)
+        return sim, cmd.hold(t, next_pc=w_done.pc)
+
+    @m.block
+    def w_done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("src", entry=src)
+    pool = m.process("worker", entry=worker, count=3, start=False)
+    return m.build(), None
+
 L = 8
 with config.profile("f32"):
     spec, args = {build}
@@ -134,6 +170,7 @@ _BUILDS = {
     ".build(16)[0], (1.0,)",
     "matmul": "_build_matmul()",
     "wev": "_build_wev()",
+    "spawn": "_build_spawn()",
 }
 
 
@@ -168,6 +205,13 @@ def _aot_compile(model):
 @pytest.mark.slow
 def test_mm1_chunk_compiles_through_mosaic():
     _aot_compile("mm1")
+
+
+@pytest.mark.slow
+def test_spawn_chunk_compiles_through_mosaic():
+    """spawn_process's free-row scan and row resets lower through
+    Mosaic (interpret-mode equivalence says nothing about lowering)."""
+    _aot_compile("spawn")
 
 
 @pytest.mark.slow

@@ -1,0 +1,175 @@
+"""Dynamic process activation: spawn pools (parity: runtime
+cmb_process_create/cmb_process_start, `include/cmb_process.h:119-180`).
+
+The spawn-per-entity modeling style: an arrival process spawns one
+customer PROCESS per arrival from a declared pool; customers contend
+for a resource, record their sojourn, and exit; exited rows are
+recycled by later spawns.  Checks completion counts, FIFO service
+order, pool-exhaustion reporting, state reset on recycle, and
+kernel-path equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import cimba_tpu.random as cr
+from cimba_tpu import config
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import pallas_run
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+N_CUSTOMERS = 30
+POOL = 8  # max concurrently-live customers
+
+
+def _build(track_exhaustion=False):
+    m = Model("spawnmm1", n_flocals=1, n_ilocals=1, event_cap=16)
+    srv = m.resource("server", record=False)
+
+    @m.user_state
+    def init(params):
+        return {
+            "spawned": jnp.asarray(0, jnp.int32),
+            "done": jnp.asarray(0, jnp.int32),
+            "sum_t": jnp.asarray(0.0, config.REAL),
+            "misses": jnp.asarray(0, jnp.int32),
+            "last_start": jnp.asarray(-1.0, config.REAL),
+            "order_ok": jnp.asarray(True),
+        }
+
+    @m.block
+    def arrive(sim, p, sig):
+        u = sim.user
+        fin = u["spawned"] >= N_CUSTOMERS
+        sim, t = api.draw(sim, cr.exponential, 1.0)
+        return sim, cmd.select(
+            fin, cmd.exit_(), cmd.hold(t, next_pc=a_spawn.pc)
+        )
+
+    @m.block
+    def a_spawn(sim, p, sig):
+        sim, pid = api.spawn(sim, customers)
+        ok = pid >= 0
+        u = sim.user
+        sim = api.set_user(sim, {
+            **u,
+            "spawned": u["spawned"] + ok.astype(jnp.int32),
+            "misses": u["misses"] + (~ok).astype(jnp.int32),
+        })
+        return sim, cmd.jump(arrive.pc)
+
+    @m.block
+    def c_start(sim, p, sig):
+        # records its own birth time; fresh rows must see local 0.0
+        zeroed = api.local_f(sim, p, 0) == 0.0
+        sim = api.set_user(
+            sim, {**sim.user, "order_ok": sim.user["order_ok"] & zeroed}
+        )
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.acquire(srv.id, next_pc=c_serve.pc)
+
+    @m.block
+    def c_serve(sim, p, sig):
+        # FIFO check: service begins in birth order (same prio, FIFO guard)
+        u = sim.user
+        birth = api.local_f(sim, p, 0)
+        sim = api.set_user(sim, {
+            **u,
+            "order_ok": u["order_ok"] & (birth >= u["last_start"]),
+            "last_start": birth,
+        })
+        sim, t = api.draw(sim, cr.exponential, 0.8)
+        return sim, cmd.hold(t, next_pc=c_done.pc)
+
+    @m.block
+    def c_done(sim, p, sig):
+        u = sim.user
+        t_sys = api.clock(sim) - api.local_f(sim, p, 0)
+        sim = api.set_user(sim, {
+            **u,
+            "done": u["done"] + 1,
+            "sum_t": u["sum_t"] + t_sys,
+        })
+        sim = api.stop(sim, u["done"] + 1 >= N_CUSTOMERS)
+        # reset the birth local so a recycled row can prove freshness
+        sim = api.set_local_f(sim, p, 0, 0.0)
+        return sim, cmd.release(srv.id, next_pc=c_exit.pc)
+
+    @m.block
+    def c_exit(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("arrival", entry=arrive, prio=1)
+    customers = m.process(
+        "customer", entry=c_start, count=POOL, start=False
+    )
+    return m.build()
+
+
+def test_spawn_per_customer_completes_and_recycles():
+    spec = _build()
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 7, 0))
+    assert int(out.err) == 0
+    # all customers served: 30 spawns through an 8-row pool => recycling
+    assert int(out.user["done"]) == N_CUSTOMERS
+    assert int(out.user["spawned"]) == N_CUSTOMERS
+    assert bool(out.user["order_ok"])  # FIFO service + fresh locals
+    assert float(out.user["sum_t"]) > 0.0
+
+
+def test_spawn_pool_exhaustion_reports_minus_one():
+    """A pool smaller than the burst: spawns during a full pool return
+    pid=-1 and are counted as misses, never corruption."""
+    m = Model("burst", event_cap=16)
+    srv_hold = 50.0
+
+    @m.user_state
+    def init(params):
+        return {"misses": jnp.asarray(0, jnp.int32),
+                "got": jnp.asarray(0, jnp.int32)}
+
+    @m.block
+    def burst(sim, p, sig):
+        sim2 = sim
+        for _ in range(4):  # 4 spawns into a 2-row pool
+            sim2, pid = api.spawn(sim2, pool)
+            miss = (pid < 0).astype(jnp.int32)
+            u = sim2.user
+            sim2 = api.set_user(sim2, {
+                **u, "misses": u["misses"] + miss,
+                "got": u["got"] + (1 - miss),
+            })
+        return sim2, cmd.exit_()
+
+    @m.block
+    def worker(sim, p, sig):
+        return sim, cmd.hold(srv_hold, next_pc=w_done.pc)
+
+    @m.block
+    def w_done(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("burster", entry=burst, prio=0)
+    pool = m.process("workers", entry=worker, count=2, start=False)
+    spec = m.build()
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 1, 0))
+    assert int(out.err) == 0
+    assert int(out.user["got"]) == 2
+    assert int(out.user["misses"]) == 2
+
+
+def test_spawn_kernel_path_bit_identical():
+    with config.profile("f32"):
+        spec = _build()
+        sims = jax.vmap(lambda r: cl.init_sim(spec, 11, r))(jnp.arange(8))
+        xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+        ker = pallas_run.make_kernel_run(spec, interpret=True)(sims)
+    for a, b in zip(jax.tree.leaves(xla), jax.tree.leaves(ker)):
+        a, b = np.asarray(a), np.asarray(b)
+        if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+            np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=5e-6, atol=1e-5)
