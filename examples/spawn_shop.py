@@ -1,0 +1,96 @@
+"""Spawn pools: one PROCESS per customer (docs/03 — the reference's
+runtime `cmb_process_create`/`start` modeling style).
+
+A door process spawns a shopper process per arrival from a declared
+pool; shoppers contend for a clerk and leave.  ``count`` bounds
+concurrently-live shoppers, not total arrivals — exited rows recycle.
+
+Run: ``python examples/spawn_shop.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import cimba_tpu.random as cr
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+
+N_SERVED = 200
+
+
+def build():
+    m = Model("spawn_shop", n_flocals=1, event_cap=16)
+    clerk = m.resource("clerk", record=False)
+
+    @m.user_state
+    def init(params):
+        return {
+            "served": jnp.asarray(0, jnp.int32),
+            "missed": jnp.asarray(0, jnp.int32),
+            "sum_wait": jnp.asarray(0.0, jnp.float64),
+        }
+
+    @m.block
+    def door(sim, p, sig):
+        sim, pid = api.spawn(sim, shoppers)  # -1 if all rows are live
+        u = sim.user
+        sim = api.set_user(
+            sim, {**u, "missed": u["missed"] + (pid < 0).astype(jnp.int32)}
+        )
+        sim, t = api.draw(sim, cr.exponential, 1.0)
+        done = sim.user["served"] >= N_SERVED
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(t, next_pc=door.pc)
+        )
+
+    @m.block
+    def shop(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))  # birth time
+        return sim, cmd.acquire(clerk.id, next_pc=pay.pc)
+
+    @m.block
+    def pay(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, 0.6)
+        return sim, cmd.hold(t, next_pc=leave.pc)
+
+    @m.block
+    def leave(sim, p, sig):
+        u = sim.user
+        wait = api.clock(sim) - api.local_f(sim, p, 0)
+        sim = api.set_user(sim, {
+            **u,
+            "served": u["served"] + 1,
+            "sum_wait": u["sum_wait"] + wait,
+        })
+        sim = api.stop(sim, sim.user["served"] >= N_SERVED)
+        return sim, cmd.release(clerk.id, next_pc=gone.pc)
+
+    @m.block
+    def gone(sim, p, sig):
+        return sim, cmd.exit_()
+
+    m.process("door", entry=door)
+    shoppers = m.process("shopper", entry=shop, count=16, start=False)
+    return m.build()
+
+
+def main():
+    spec = build()
+    out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 42, 0))
+    assert int(out.err) == 0
+    served = int(out.user["served"])
+    mean_wait = float(out.user["sum_wait"]) / max(served, 1)
+    assert served >= N_SERVED
+    return served, int(out.user["missed"]), mean_wait
+
+
+if __name__ == "__main__":
+    served, missed, mean_wait = main()
+    print(f"served {served} shoppers (pool misses: {missed}), "
+          f"mean time in shop {mean_wait:.2f}")

@@ -9,7 +9,9 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from examples import tut_1_mm1, tut_2_park, tut_3_balking, tut_4_harbor  # noqa: E402
+from examples import (  # noqa: E402
+    spawn_shop, tut_1_mm1, tut_2_park, tut_3_balking, tut_4_harbor,
+)
 
 
 def test_tut_1_mm1_matches_theory():
@@ -51,3 +53,9 @@ def test_cookbook_balking_runs_as_printed():
     from examples import cookbook_balking
 
     cookbook_balking.main()
+
+
+def test_spawn_shop_serves_all():
+    served, missed, mean_wait = spawn_shop.main()
+    assert served >= spawn_shop.N_SERVED
+    assert mean_wait > 0.0
