@@ -5,6 +5,11 @@ Run:  python examples/checkpointed_run.py
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import os
 import tempfile
 
 import jax

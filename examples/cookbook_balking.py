@@ -5,6 +5,12 @@ chapter runs as printed.
 
 Run:  python examples/cookbook_balking.py
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

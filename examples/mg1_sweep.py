@@ -5,6 +5,11 @@ replication, results vs Pollaczek-Khinchine theory.
 Run:  python examples/mg1_sweep.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from cimba_tpu.models import mg1

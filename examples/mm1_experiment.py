@@ -7,6 +7,11 @@ is built once and 4096 replications run as one batched program.
 Run:  python examples/mm1_experiment.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from cimba_tpu.models import mm1
 from cimba_tpu.runner import experiment as ex
 from cimba_tpu.stats import summary as sm

@@ -15,6 +15,11 @@ later tutorial builds on:
 Run:  python examples/tut_0_hello.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

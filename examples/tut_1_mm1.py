@@ -18,6 +18,11 @@ cimba-tpu, where the "parallelize" step is one vmap:
 Run:  python examples/tut_1_mm1.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

@@ -19,6 +19,11 @@ through `mousefunc`.
 Run:  python examples/tut_2_park.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

@@ -22,6 +22,11 @@ test "is the other queue shorter than my position?".
 Run:  python examples/tut_3_balking.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

@@ -21,6 +21,11 @@ through the same tug dance.  The cimba-tpu rendition keeps the structure:
 Run:  python examples/tut_4_harbor.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 

@@ -21,6 +21,11 @@ and reports detections per dwell, demonstrating:
 Run:  python examples/tut_5_awacs.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 
