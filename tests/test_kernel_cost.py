@@ -1,0 +1,57 @@
+"""Per-event cost regression gate (BENCH_NOTES round 3-4 campaigns).
+
+Pins the traced element/op weight of the kernel-path step for the
+headline models: the merge-elimination work (per-leaf vswitch, dense
+guards, gate-through-resume, self-gated handlers, static machinery
+gating) took mm1 from 18,159 (round 2) to ~2.5k elements/event/lane —
+a regression here silently costs the same factor in measured
+events/s.  Budgets sit ~8% above current so refactors have headroom;
+a breach means a merge layer or O(P) scan crept back in — audit with
+``tools/kernel_cost.py``.
+"""
+
+from collections import Counter
+
+import jax
+
+from cimba_tpu import config
+from cimba_tpu.core import dyn
+from cimba_tpu.core import loop as cl
+from tools.kernel_cost import hist
+
+
+def _cost(spec, params):
+    """Same ruler as tools/kernel_cost.py: the audit tool's own hist()."""
+    sim = cl.init_sim(spec, 2026, 0, params)
+    config.KERNEL_MODE = True
+    try:
+        step = cl.make_step(spec)
+        with dyn.oh_cache():
+            j = jax.make_jaxpr(step)(sim)
+    finally:
+        config.KERNEL_MODE = False
+    c, ops = Counter(), Counter()
+    hist(j.jaxpr, c, ops)
+    return sum(c.values()), sum(ops.values())
+
+
+def test_mm1_step_cost_budget():
+    from cimba_tpu.models import mm1
+
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+        el, ops = _cost(spec, (1.0 / 0.9, 1.0, 200))
+    # round-4 measured: 2,457 el / 1,047 ops
+    assert el <= 2_700, f"mm1 step cost regressed: {el} elements/event"
+    assert ops <= 1_200, f"mm1 step op count regressed: {ops} ops/event"
+
+
+def test_awacs_step_cost_budget():
+    from cimba_tpu.models import awacs
+
+    with config.profile("f32"):
+        spec, _ = awacs.build(1000)
+        el, ops = _cost(spec, awacs.params(10.0))
+    # round-4 measured: 86,848 el / 604 ops
+    assert el <= 95_000, f"awacs step cost regressed: {el} elements/event"
+    assert ops <= 700, f"awacs step op count regressed: {ops} ops/event"
