@@ -45,9 +45,6 @@ _I = INDEX_DTYPE
 
 NO_PID = jnp.int32(-1)
 
-#: membership sentinel in the wait vector ("waits on no guard")
-NO_GUARD = jnp.int32(-1)
-
 
 class Guards(NamedTuple):
     """Per-replication guard state: only the FIFO sequence counters.

@@ -143,3 +143,22 @@ def test_big_capacity_battery():
     es, e = ev.pop(es)
     assert not bool(e.found)
     assert bool(ev.is_empty(es))
+
+
+def test_pop_merged_is_peek_plus_consume():
+    """pop_merged (the cmb_event_execute_next pop half) unifies the two
+    tables: a sooner dense wake pops before a later general event."""
+    import jax.numpy as jnp
+
+    es = ev.create(8)
+    es, h = ev.schedule(es, 5.0, 0, 2, 1, 9)
+    wk = ev.wakes_create(4)._replace(
+        time=jnp.asarray([3.0, jnp.inf, jnp.inf, jnp.inf])
+    )
+    prio = jnp.zeros((4,), jnp.int32)
+    es2, wk2, e = ev.pop_merged(es, wk, prio, 0)
+    assert bool(e.found) and float(e.time) == 3.0 and int(e.subj) == 0
+    es3, wk3, e2 = ev.pop_merged(es2, wk2, prio, 0)
+    assert float(e2.time) == 5.0 and int(e2.kind) == 2
+    _, _, e3 = ev.pop_merged(es3, wk3, prio, 0)
+    assert not bool(e3.found)
