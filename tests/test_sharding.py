@@ -60,3 +60,23 @@ def test_make_sharded_experiment_merge_is_exact():
     np.testing.assert_allclose(
         float(sm.variance(pooled)), float(sm.variance(ref)), rtol=1e-9
     )
+
+
+def test_spawn_model_mesh_matches_single_device():
+    """Layout invariance holds for spawn pools too: dynamic activation
+    (free-row scans, row recycling) is per-lane state machinery, so the
+    sharded program must reproduce it bit-for-bit."""
+    import sys as _sys
+    import pathlib as _pathlib
+
+    _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent))
+    from test_spawn import _build
+
+    spec = _build()
+    single = ex.run_experiment(spec, None, 32, seed=9)
+    sharded = ex.run_experiment(spec, None, 32, seed=9, mesh=ex.make_mesh(8))
+    assert int(single.n_failed) == 0 and int(sharded.n_failed) == 0
+    for a, b in zip(
+        jax.tree.leaves(single.sims), jax.tree.leaves(sharded.sims)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
