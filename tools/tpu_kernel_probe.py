@@ -2,7 +2,12 @@
 chip and time it against the plain-XLA while-loop path.
 
 Usage: python tools/tpu_kernel_probe.py [R] [N_OBJECTS] [CHUNK]
-Prints one JSON line per phase so a wedged run still leaves evidence.
+       python tools/tpu_kernel_probe.py --sweep [N_OBJECTS]
+
+``--sweep`` produces the (R, chunk_steps) scaling table BENCH_NOTES
+promises, one JSON line per cell, cautious-first (small R compiles
+first so a failure costs the least tunnel time).  Prints one JSON line
+per phase so a wedged run still leaves evidence.
 """
 
 import json
@@ -26,7 +31,42 @@ def log(**kw):
     print(json.dumps(kw), flush=True)
 
 
+def sweep():
+    """(R, chunk) scaling table for the kernel path — run only after
+    a plain probe succeeded (this dispatches many compiles)."""
+    N = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    from cimba_tpu import config
+
+    log(phase="sweep_start", backend=jax.default_backend(), N=N)
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+        for R in (128, 512, 1024, 4096):
+            sims = jax.jit(
+                jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0 / 0.9, 1.0, N)))
+            )(jnp.arange(R))
+            jax.block_until_ready(jax.tree.leaves(sims))
+            for chunk in (128, 512):
+                try:
+                    krun = pr.make_kernel_run(spec, chunk_steps=chunk)
+                    kout = krun(sims)  # compile + first run
+                    jax.block_until_ready(jax.tree.leaves(kout))
+                    t0 = time.perf_counter()
+                    kout = krun(sims)
+                    jax.block_until_ready(jax.tree.leaves(kout))
+                    dt = time.perf_counter() - t0
+                    ev_n = int(kout.n_events.sum())
+                    log(phase="cell", R=R, chunk=chunk, events=ev_n,
+                        wall_s=dt, rate=ev_n / dt,
+                        failed=int((kout.err != 0).sum()))
+                except Exception as e:  # keep sweeping other cells
+                    log(phase="cell_error", R=R, chunk=chunk,
+                        error=str(e)[:300])
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--sweep":
+        sweep()
+        return
     R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     N = int(sys.argv[2]) if len(sys.argv) > 2 else 100
     CHUNK = int(sys.argv[3]) if len(sys.argv) > 3 else 512
