@@ -135,6 +135,21 @@ def queue_position(sim: Sim, q, item):
     return jnp.where(jnp.any(hit), best + 1, 0).astype(_I)
 
 
+def _pq_match(sim: Sim, qid, item):
+    """Earliest-dequeuing live item equal to ``item``: returns
+    ``(one_hot, match, p_best, s_best)`` — the single source of the
+    payload-keyed tie-break rule (max priority, then min seq) shared by
+    position/cancel/reprioritize."""
+    live = sim.pqueues.live[qid]
+    prio = sim.pqueues.prio[qid]
+    seq = sim.pqueues.seq[qid]
+    match = live & (sim.pqueues.items[qid] == jnp.asarray(item, _R))
+    p_best = jnp.max(jnp.where(match, prio, jnp.asarray(-jnp.inf, _R)))
+    m2 = match & (prio == p_best)
+    s_best = jnp.min(jnp.where(m2, seq, jnp.iinfo(jnp.int32).max))
+    return m2 & (seq == s_best), match, p_best, s_best
+
+
 def pqueue_position(sim: Sim, q, item):
     """1-based position in dequeue order (priority desc, FIFO within equal
     priority) of the first item equal to ``item``, 0 if absent (parity:
@@ -142,15 +157,10 @@ def pqueue_position(sim: Sim, q, item):
     reference locates by put-handle — here puts return no handle, so the
     payload is the lookup key and the earliest-dequeuing match wins)."""
     qid = q.id if hasattr(q, "id") else q
+    _, match, p_best, s_best = _pq_match(sim, qid, item)
     live = sim.pqueues.live[qid]
     prio = sim.pqueues.prio[qid]
     seq = sim.pqueues.seq[qid]
-    match = live & (sim.pqueues.items[qid] == jnp.asarray(item, _R))
-    # the match that dequeues first: max priority, then min seq
-    neg_inf = jnp.asarray(-jnp.inf, _R)
-    big = jnp.iinfo(jnp.int32).max
-    p_best = jnp.max(jnp.where(match, prio, neg_inf))
-    s_best = jnp.min(jnp.where(match & (prio == p_best), seq, big))
     ahead = live & (
         (prio > p_best) | ((prio == p_best) & (seq < s_best))
     )
@@ -209,6 +219,52 @@ def pqueue_length(sim: Sim, q):
     """Items in a priority queue (parity: cmb_priorityqueue_length)."""
     qid = q.id if hasattr(q, "id") else q
     return jnp.sum(sim.pqueues.live[qid].astype(_I))
+
+
+def pqueue_cancel(sim: Sim, q, item):
+    """(sim, existed): remove the earliest-dequeuing item equal to
+    ``item`` from a priority queue (parity: ``cmb_priorityqueue_cancel``,
+    `include/cmb_priorityqueue.h` — the reference cancels by put-handle;
+    payload-keyed here, matching pqueue_position's documented lookup).
+    Requires the PQueueRef: the freed slot signals the rear guard so a
+    blocked putter wakes (as the reference does), and the length
+    recording appends a step when the queue records."""
+    from cimba_tpu.core import loop as _loop
+
+    if not hasattr(q, "rear_guard"):
+        raise TypeError("pqueue_cancel needs the PQueueRef, not a bare id")
+    qid = q.id
+    m, _, _, _ = _pq_match(sim, qid, item)
+    existed = jnp.any(m)
+    live2 = dyn.dset(sim.pqueues.live, qid, sim.pqueues.live[qid] & ~m)
+    pq2 = sim.pqueues._replace(live=live2)
+    if q.record and sim.pqueues.acc is not None:
+        pq2 = pq2._replace(
+            acc=_loop._record_row(
+                sim.pqueues.acc, qid, sim.clock,
+                jnp.sum(live2[qid].astype(_I)).astype(_R), existed,
+            )
+        )
+    sim = sim._replace(pqueues=pq2)
+    # the freed slot can satisfy a pending putter
+    sim = _loop._guard_signal(sim, q.rear_guard, pred=existed)
+    return sim, existed
+
+
+def pqueue_reprioritize(sim: Sim, q, item, new_prio):
+    """(sim, existed): change the priority of the earliest-dequeuing
+    item equal to ``item`` (parity: ``cmb_priorityqueue_reprioritize``;
+    payload-keyed, see pqueue_cancel).  FIFO seq is preserved, so equal
+    priorities keep insertion order — the same contract as
+    event_reprioritize."""
+    qid = q.id if hasattr(q, "id") else q
+    m, _, _, _ = _pq_match(sim, qid, item)
+    existed = jnp.any(m)
+    prio2 = dyn.dset(
+        sim.pqueues.prio, qid,
+        jnp.where(m, jnp.asarray(new_prio, _R), sim.pqueues.prio[qid]),
+    )
+    return sim._replace(pqueues=sim.pqueues._replace(prio=prio2)), existed
 
 
 # --- inter-process verbs (thin wrappers over core.loop; blocks close over

@@ -114,9 +114,7 @@ def test_handle_getters_and_component_space():
     """event_is_scheduled/time/priority track the handle lifecycle;
     queue_space/buffer_space/pool_held/pool_in_use/proc_priority read
     live component state (parity: the cmb_* getter surface)."""
-    from cimba_tpu.core.model import Model as _M
-
-    m = _M("getters", event_cap=16)
+    m = Model("getters", event_cap=16)
     q = m.objectqueue("q", capacity=8, record=False)
     b = m.buffer("b", capacity=20.0, initial=5.0)
     pl = m.resourcepool("pool", capacity=6.0)
@@ -193,3 +191,100 @@ def test_pattern_count_find_cancel():
     out, _ = run1(m)
     assert out.user["order"].tolist() == [1, 2]  # h1 @20 before h2 @40
     assert out.user["times"].tolist() == [20.0, 40.0]
+
+
+def test_pqueue_cancel_and_reprioritize_by_payload():
+    """Payload-keyed pq item verbs (parity: cmb_priorityqueue_cancel /
+    _reprioritize, which address by put-handle — here the payload is
+    the key, as pqueue_position documents)."""
+    m = Model("pqv", event_cap=16)
+    pq = m.priorityqueue("pq", capacity=8, record=False)
+
+    @m.user_state
+    def init(params):
+        return {"got": jnp.zeros((3,), jnp.float64),
+                "n": jnp.asarray(0, jnp.int32)}
+
+    @m.block
+    def driver(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 10.0, 1.0, next_pc=d2.pc)
+
+    @m.block
+    def d2(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 20.0, 2.0, next_pc=d3.pc)
+
+    @m.block
+    def d3(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 30.0, 3.0, next_pc=d4.pc)
+
+    @m.block
+    def d4(sim, p, sig):
+        # drop 20.0, then push 10.0 to the front (prio 9 > 3)
+        sim, existed = api.pqueue_cancel(sim, pq, 20.0)
+        sim = api.fail(sim, ~existed)
+        sim, _ = api.pqueue_cancel(sim, pq, 99.0)  # absent: no-op
+        sim, ok2 = api.pqueue_reprioritize(sim, pq, 10.0, 9.0)
+        sim = api.fail(sim, ~ok2)
+        sim = api.fail(sim, api.pqueue_length(sim, pq) != 2)
+        return sim, cmd.pq_get(pq.id, next_pc=take.pc)
+
+    @m.block
+    def take(sim, p, sig):
+        u = sim.user
+        sim = api.set_user(sim, {
+            **u,
+            "got": u["got"].at[u["n"]].set(api.got(sim, p)),
+            "n": u["n"] + 1,
+        })
+        return sim, cmd.select(
+            u["n"] + 1 >= 2, cmd.exit_(),
+            cmd.pq_get(pq.id, next_pc=take.pc),
+        )
+
+    m.process("driver", entry=driver, prio=0)
+    out, _ = run1(m)
+    # 10.0 first (reprio to 9), then 30.0; 20.0 cancelled
+    assert out.user["got"].tolist()[:2] == [10.0, 30.0]
+
+
+def test_pqueue_cancel_wakes_blocked_putter():
+    """Cancelling an item from a FULL priority queue frees a slot and
+    signals the rear guard: the blocked putter completes (the reference
+    wakes putters on cancel; a silent free slot would wedge reneging
+    models that drain only via cancel)."""
+    m = Model("pqw", n_ilocals=1, event_cap=16)
+    pq = m.priorityqueue("pq", capacity=2, record=False)
+
+    @m.block
+    def filler(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 1.0, 0.0, next_pc=f2.pc)
+
+    @m.block
+    def f2(sim, p, sig):
+        return sim, cmd.pq_put(pq.id, 2.0, 0.0, next_pc=f3.pc)
+
+    @m.block
+    def f3(sim, p, sig):
+        # queue now full: this put BLOCKS until the canceller frees 1.0
+        return sim, cmd.pq_put(pq.id, 3.0, 0.0, next_pc=f_done.pc)
+
+    @m.block
+    def f_done(sim, p, sig):
+        sim = api.set_local_i(sim, p, 0, 1)  # proof the put completed
+        return sim, cmd.exit_()
+
+    @m.block
+    def canceller(sim, p, sig):
+        return sim, cmd.hold(5.0, next_pc=c2.pc)
+
+    @m.block
+    def c2(sim, p, sig):
+        sim, existed = api.pqueue_cancel(sim, pq, 1.0)
+        sim = api.fail(sim, ~existed)
+        return sim, cmd.exit_()
+
+    m.process("filler", entry=filler, prio=1)
+    m.process("canceller", entry=canceller, prio=0)
+    out, _ = run1(m)
+    assert int(out.procs.locals_i[0, 0]) == 1  # blocked put completed
+    assert float(out.clock) == 5.0             # ... at the cancel time
