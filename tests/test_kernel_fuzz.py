@@ -37,6 +37,7 @@ def _build_fuzz(seed: int):
     use_resource = rng.random() < 0.7
     use_buffer = rng.random() < 0.5
     use_pq = rng.random() < 0.5
+    use_spawn = rng.random() < 0.5
     arr_mean = rng.uniform(0.5, 2.0)
     srv_mean = rng.uniform(0.4, 1.8)
 
@@ -65,7 +66,30 @@ def _build_fuzz(seed: int):
 
     @m.block
     def p_put(sim, p, sig):
+        if use_spawn:
+            # race a pool-recycled sink against the standing consumers
+            sim, _ = api.spawn(sim, sinks)  # -1 when pool is busy: fine
         return sim, cmd.put(q.id, api.clock(sim), next_pc=produce.pc)
+
+    if use_spawn:
+        @m.block
+        def sink(sim, p, sig):
+            return sim, cmd.get(q.id, next_pc=sink_done.pc)
+
+        @m.block
+        def sink_done(sim, p, sig):
+            sim, t = api.draw(sim, cr.exponential, 0.3)
+            return sim, cmd.hold(t, next_pc=sink_exit.pc)
+
+        @m.block
+        def sink_exit(sim, p, sig):
+            u = sim.user
+            sim = api.set_user(sim, {
+                **u, "done_n": u["done_n"] + 1,
+                "sum_t": u["sum_t"] + (api.clock(sim) - api.got(sim, p)),
+            })
+            sim = api.stop(sim, u["done_n"] + 1 >= n_items)
+            return sim, cmd.exit_()
 
     # consumer chain: get -> [acquire] -> hold -> [buffer put] ->
     # [pq put/get] -> [release] -> record -> get ...
@@ -139,6 +163,10 @@ def _build_fuzz(seed: int):
     if rng.random() < 0.6:
         m.process("consumer2", entry=c_get, prio=rng.randint(-1, 1))
     m.process("meddler", entry=meddle, prio=rng.randint(-1, 1))
+    if use_spawn:
+        sinks = m.process(
+            "sink", entry=sink, count=rng.randint(2, 4), start=False
+        )
     return m.build()
 
 
