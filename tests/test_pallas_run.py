@@ -240,3 +240,40 @@ def test_boundary_block_mid_chain_entry_fails_loudly(f32_profile):
     # kernel path: every lane flags the illegal mid-chain entry
     ker = pr.make_kernel_run(spec, chunk_steps=16, interpret=True)(sims)
     assert bool((ker.err == cl.ERR_BOUNDARY).all()), [int(e) for e in ker.err]
+
+
+def test_kernel_matches_xla_f32_mg1(f32_profile):
+    """Kernel path on mg1: the lognormal sampler (exp/log chains) and
+    the 512-slot ring in-kernel."""
+    from cimba_tpu.models import mg1
+
+    spec, _ = mg1.build()
+
+    def one(rep):
+        return cl.init_sim(spec, 13, rep, (1.25, 1.0, 1.5, 100))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    ker = pr.make_kernel_run(spec, chunk_steps=64, interpret=True)(sims)
+    assert bool((xla.n_events == ker.n_events).all())
+    assert bool((xla.clock == ker.clock).all())
+    assert int(ker.err.sum()) == 0
+
+
+def test_kernel_matches_xla_f32_jobshop(f32_profile):
+    """Kernel path on jobshop: pools (greedy acquire + rollback),
+    buffers (partial fulfillment), pq and recording accumulators all
+    live in one kernel trace — the widest handler table shipped."""
+    from cimba_tpu.models import jobshop
+
+    spec, _ = jobshop.build()
+
+    def one(rep):
+        return cl.init_sim(spec, 13, rep, jobshop.params(40))
+
+    sims = jax.jit(jax.vmap(one))(jnp.arange(16))
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    ker = pr.make_kernel_run(spec, chunk_steps=64, interpret=True)(sims)
+    assert bool((xla.n_events == ker.n_events).all())
+    assert bool((xla.clock == ker.clock).all())
+    assert int(ker.err.sum()) == 0
