@@ -171,6 +171,10 @@ _BUILDS = {
     "matmul": "_build_matmul()",
     "wev": "_build_wev()",
     "spawn": "_build_spawn()",
+    "mg1": "__import__('cimba_tpu.models.mg1', fromlist=['m'])"
+    ".build()[0], (1.25, 1.0, 1.5, 20)",
+    "jobshop": "(lambda j: (j.build()[0], j.params(10)))("
+    "__import__('cimba_tpu.models.jobshop', fromlist=['m']))",
 }
 
 
@@ -212,6 +216,19 @@ def test_spawn_chunk_compiles_through_mosaic():
     """spawn_process's free-row scan and row resets lower through
     Mosaic (interpret-mode equivalence says nothing about lowering)."""
     _aot_compile("spawn")
+
+
+@pytest.mark.slow
+def test_mg1_chunk_compiles_through_mosaic():
+    """Lognormal sampler chain + the 512-slot ring."""
+    _aot_compile("mg1")
+
+
+@pytest.mark.slow
+def test_jobshop_chunk_compiles_through_mosaic():
+    """The widest handler table shipped (pools + buffers + pq +
+    recording accumulators) in one Mosaic kernel."""
+    _aot_compile("jobshop")
 
 
 @pytest.mark.slow
