@@ -142,6 +142,47 @@ def _build_spawn():
     pool = m.process("worker", entry=worker, count=3, start=False)
     return m.build(), None
 
+# condition fixture: registered traced predicate + cond_signal's
+# per-pid wake-all loop (kfori) under real Mosaic coverage
+def _build_cond():
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("aot_cond", n_flocals=1, event_cap=16)
+
+    @m.user_state
+    def init(params):
+        return {{"count": jnp.zeros((), jnp.float32)}}
+
+    cv = m.condition("enough", lambda sim, p: sim.user["count"] >= 2.0)
+
+    @m.block
+    def waiter(sim, p, sig):
+        return sim, cmd.cond_wait(cv.id, next_pc=granted.pc)
+
+    @m.block
+    def granted(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.exit_()
+
+    @m.block
+    def tick(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=bump.pc)
+
+    @m.block
+    def bump(sim, p, sig):
+        sim = api.set_user(sim, {{"count": sim.user["count"] + 1.0}})
+        sim = api.cond_signal(sim, spec_holder[0], cv)
+        return sim, cmd.select(
+            sim.user["count"] >= 2.0, cmd.exit_(), cmd.jump(tick.pc)
+        )
+
+    m.process("waiter", entry=waiter, count=2)
+    m.process("incrementer", entry=tick)
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    return spec_holder[0], None
+
 L = 8
 with config.profile("f32"):
     spec, args = {build}
@@ -175,6 +216,7 @@ _BUILDS = {
     ".build()[0], (1.25, 1.0, 1.5, 20)",
     "jobshop": "(lambda j: (j.build()[0], j.params(10)))("
     "__import__('cimba_tpu.models.jobshop', fromlist=['m']))",
+    "cond": "_build_cond()",
 }
 
 
@@ -229,6 +271,12 @@ def test_jobshop_chunk_compiles_through_mosaic():
     """The widest handler table shipped (pools + buffers + pq +
     recording accumulators) in one Mosaic kernel."""
     _aot_compile("jobshop")
+
+
+@pytest.mark.slow
+def test_condition_chunk_compiles_through_mosaic():
+    """Registered predicate + cond_signal's per-pid wake loop."""
+    _aot_compile("cond")
 
 
 @pytest.mark.slow

@@ -277,3 +277,59 @@ def test_kernel_matches_xla_f32_jobshop(f32_profile):
     assert bool((xla.n_events == ker.n_events).all())
     assert bool((xla.clock == ker.clock).all())
     assert int(ker.err.sum()) == 0
+
+
+def test_kernel_matches_xla_f32_condition(f32_profile):
+    """Kernel path on a condition-variable model: the registered traced
+    predicate, cond_wait's retry gating and cond_signal's per-pid
+    wake-all loop all execute in-kernel (the one component the model
+    battery didn't previously trace through the kernel)."""
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+
+    m = Model("kcond", n_flocals=1, event_cap=16)
+
+    @m.user_state
+    def user_init(params):
+        return {"count": jnp.zeros((), jnp.float32)}
+
+    cv = m.condition("enough", lambda sim, p: sim.user["count"] >= 2.0)
+
+    @m.block
+    def waiter(sim, p, sig):
+        return sim, cmd.cond_wait(cv.id, next_pc=granted.pc)
+
+    @m.block
+    def granted(sim, p, sig):
+        sim = api.set_local_f(sim, p, 0, api.clock(sim))
+        return sim, cmd.exit_()
+
+    @m.block
+    def tick(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=bump.pc)
+
+    @m.block
+    def bump(sim, p, sig):
+        sim = api.set_user(sim, {"count": sim.user["count"] + 1.0})
+        sim = api.cond_signal(sim, spec_holder[0], cv)
+        return sim, cmd.select(
+            sim.user["count"] >= 2.0, cmd.exit_(), cmd.jump(tick.pc)
+        )
+
+    m.process("waiter", entry=waiter, count=2)
+    m.process("incrementer", entry=tick)
+    spec_holder = [None]
+    spec_holder[0] = m.build()
+    spec = spec_holder[0]
+
+    sims = jax.jit(jax.vmap(lambda r: cl.init_sim(spec, 3, r)))(
+        jnp.arange(8)
+    )
+    xla = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+    ker = pr.make_kernel_run(spec, chunk_steps=32, interpret=True)(sims)
+    assert bool((xla.n_events == ker.n_events).all())
+    assert bool((xla.clock == ker.clock).all())
+    assert bool((xla.procs.locals_f == ker.procs.locals_f).all())
+    assert int(ker.err.sum()) == 0
+    # both waiters woke exactly when the predicate turned true
+    assert bool((ker.procs.locals_f[:, 0, 0] == 2.0).all())
