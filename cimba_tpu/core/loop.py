@@ -45,7 +45,7 @@ from jax import lax
 
 from cimba_tpu import config
 from cimba_tpu.config import INDEX_DTYPE
-from cimba_tpu.config import argmax32 as _argmax32, argmin32 as _argmin32
+from cimba_tpu.config import argmax32 as _argmax32
 from cimba_tpu.core import dyn
 from cimba_tpu.core import eventset as ev
 from cimba_tpu.core import guard as gd

@@ -23,7 +23,6 @@ movement costs nothing between events, exactly like the reference storing
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

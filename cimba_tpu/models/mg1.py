@@ -13,7 +13,6 @@ service SCV cs2 = Var[S]/E[S]^2,
 
 from __future__ import annotations
 
-import math
 
 import jax.numpy as jnp
 import numpy as np

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 
-from cimba_tpu.core.loop import ERR_USER, Sim
+from cimba_tpu.core.loop import Sim
 
 _ndebug = bool(int(os.environ.get("CIMBA_NDEBUG", "0")))
 _nassert = bool(int(os.environ.get("CIMBA_NASSERT", "0")))
