@@ -20,7 +20,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _axon_env  # noqa: E402
 
-if _axon_env.plugin_enabled():
+# CIMBA_ON_DEVICE=1 deliberately keeps the accelerator: the kernel
+# equivalence battery then proves Mosaic-*executed* semantics (not just
+# interpret-mode) — see tests/test_kernel_fuzz.py and tools/first_contact.py.
+if _axon_env.plugin_enabled() and not os.environ.get("CIMBA_ON_DEVICE"):
     for _fd in (1, 2):
         try:
             _orig = os.open(

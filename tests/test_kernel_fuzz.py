@@ -12,6 +12,7 @@ accumulators within a few ulp (layout-dependent f32 rounding of long
 dependent chains is allowed; the shipped models happen to be bitwise).
 """
 
+import os
 import random
 
 import jax
@@ -26,6 +27,12 @@ from cimba_tpu.core import pallas_run
 from cimba_tpu.core.model import Model
 
 L = 8  # lanes
+
+# CIMBA_ON_DEVICE=1 runs the kernel side Mosaic-compiled on the real
+# accelerator instead of under the Pallas interpreter — the same contract,
+# proven on executed TPU semantics (root conftest skips its CPU re-exec
+# for this flag; tools/first_contact.py wires it into the tunnel window).
+ON_DEVICE = os.environ.get("CIMBA_ON_DEVICE") == "1"
 
 
 def _build_fuzz(seed: int):
@@ -178,7 +185,7 @@ def _run_both(seed: int):
         )
         xla = jax.jit(jax.vmap(cl.make_run(spec, t_end=400.0)))(sims)
         krun = pallas_run.make_kernel_run(
-            spec, t_end=400.0, interpret=True
+            spec, t_end=400.0, interpret=not ON_DEVICE
         )
         ker = krun(sims)
     return xla, ker

@@ -1,0 +1,175 @@
+"""First tunnel contact, scripted end-to-end: ONE command that turns a
+30-minute window of TPU health into the measurement, the correctness
+proof, and the scaling table, with no human in the loop.
+
+    python tools/first_contact.py            # full sequence (if healthy)
+    python tools/first_contact.py --attempt  # probe only; run sequence on
+                                             # success (cron-safe: exits
+                                             # quietly when wedged/locked)
+
+Sequence (cheapest-and-most-valuable first, per VERDICT r4 #1):
+
+  1. probe     — jax backend init in a throwaway subprocess, hard timeout
+  2. kernel    — tools/tpu_kernel_probe.py 512 200: Mosaic-compile the
+                 mm1 mega-kernel on the chip, time it vs the XLA path,
+                 cross-check means on-device (the first real number)
+  3. fuzz      — CIMBA_ON_DEVICE=1 pytest tests/test_kernel_fuzz.py:
+                 kernel-vs-XLA equivalence with Mosaic *executing* (the
+                 gap interpret-mode equivalence cannot close)
+  4. sweep     — tools/tpu_kernel_probe.py --sweep: (R, chunk) table
+  5. bench     — bench.py headline (auto-selects the kernel path) and
+                 the awacs kernel config
+  6. notes     — machine-written summary appended to BENCH_NOTES.md
+
+Every phase appends a JSON line to FIRST_CONTACT_r05.jsonl as it
+completes, so a mid-sequence wedge still leaves evidence of exactly how
+far the tunnel let us get (VERDICT r4 "honest record of the attempt's
+failure mode").  A lock file serializes runs: concurrent backend inits
+contend on the tunnel and wedge it under each other (BENCH_NOTES r3).
+
+Timeouts are generous on purpose — killing a TPU job mid-RPC is itself
+what wedges the tunnel — but they exist, because a hung phase would
+otherwise hold the lock forever.
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "FIRST_CONTACT_r05.jsonl")
+LOCK = "/tmp/cimba_first_contact.lock"
+PROBE_TIMEOUT_S = int(os.environ.get("CIMBA_FC_PROBE_TIMEOUT", "240"))
+
+PHASE_TIMEOUTS = {
+    "kernel_probe": 2400,
+    "fuzz_on_device": 3600,
+    "sweep": 2400,
+    "bench_mm1": 3600,
+    "bench_awacs": 2400,
+}
+
+
+def log(**kw):
+    kw["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+    line = json.dumps(kw)
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe():
+    """Backend init in a throwaway subprocess (a wedged tunnel hangs init
+    forever, even for jax.devices())."""
+    code = "import jax; jax.devices(); print(jax.default_backend())"
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"init exceeded {PROBE_TIMEOUT_S}s (wedged)", time.time() - t0
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return None, tail[-1][:300] if tail else f"rc={proc.returncode}", time.time() - t0
+    return proc.stdout.strip().splitlines()[-1], "ok", time.time() - t0
+
+
+def run_phase(name, argv, env_extra=None, keep_lines=40):
+    """One sequence phase in a subprocess; captures output into the log."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, env=env,
+            timeout=PHASE_TIMEOUTS[name], cwd=ROOT,
+        )
+        out = (proc.stdout or "").strip().splitlines()
+        err = (proc.stderr or "").strip().splitlines()
+        log(phase=name, rc=proc.returncode, wall_s=round(time.time() - t0, 1),
+            stdout=out[-keep_lines:], stderr_tail=err[-6:])
+        return proc.returncode == 0, out
+    except subprocess.TimeoutExpired:
+        log(phase=name, rc=None, wall_s=round(time.time() - t0, 1),
+            error=f"timeout after {PHASE_TIMEOUTS[name]}s")
+        return False, []
+
+
+def append_notes(results):
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%MZ"
+    )
+    lines = [
+        "",
+        f"## Round 5 — first tunnel contact ({stamp}, scripted)",
+        "",
+        "Produced by `tools/first_contact.py` (one command; see",
+        "`FIRST_CONTACT_r05.jsonl` for raw phase records):",
+        "",
+    ]
+    for name, (ok, out) in results.items():
+        lines.append(f"- **{name}**: {'ok' if ok else 'FAILED'}")
+        for ln in out:
+            if ln.startswith("{"):
+                lines.append(f"  - `{ln}`")
+    with open(os.path.join(ROOT, "BENCH_NOTES.md"), "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    attempt_mode = "--attempt" in sys.argv
+    if os.path.exists(LOCK):
+        age = time.time() - os.path.getmtime(LOCK)
+        if age < 4 * 3600:
+            # a sequence (or probe) is live — do NOT contend with it
+            print(f"lock held ({age:.0f}s old); exiting", file=sys.stderr)
+            return 3
+        os.remove(LOCK)  # stale
+    with open(LOCK, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        backend, why, dt = probe()
+        log(phase="probe", backend=backend, note=why, wall_s=round(dt, 1))
+        if backend in (None, "cpu"):
+            return 1 if attempt_mode else 2
+
+        results = {}
+        results["kernel_probe"] = run_phase(
+            "kernel_probe",
+            [sys.executable, "tools/tpu_kernel_probe.py", "512", "200"],
+        )
+        results["fuzz_on_device"] = run_phase(
+            "fuzz_on_device",
+            [sys.executable, "-m", "pytest", "tests/test_kernel_fuzz.py",
+             "-x", "-q", "--no-header", "-p", "no:cacheprovider"],
+            env_extra={"CIMBA_ON_DEVICE": "1"},
+        )
+        results["sweep"] = run_phase(
+            "sweep",
+            [sys.executable, "tools/tpu_kernel_probe.py", "--sweep", "500"],
+        )
+        results["bench_mm1"] = run_phase(
+            "bench_mm1", [sys.executable, "bench.py"],
+        )
+        results["bench_awacs"] = run_phase(
+            "bench_awacs",
+            [sys.executable, "bench.py", "--config", "awacs"],
+            env_extra={"CIMBA_BENCH_KERNEL": "1"},
+        )
+        append_notes(results)
+        log(phase="done",
+            ok={k: v[0] for k, v in results.items()})
+        return 0
+    finally:
+        os.remove(LOCK)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
