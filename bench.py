@@ -139,18 +139,56 @@ def _line(metric, rate, vs_baseline, detail):
         # (mm1 only today) — must not leak onto later --config all lines
         detail["kernel_fallback"] = _kernel_fallback
         _kernel_fallback = None
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": rate,
-                "unit": "events/s",
-                "vs_baseline": vs_baseline,
-                "detail": detail,
-            }
-        ),
-        flush=True,
-    )
+    line = {
+        "metric": metric,
+        "value": rate,
+        "unit": "events/s",
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    # Headline honesty: masked lane failures are an estimator-bias
+    # signal, not a detail — surface them at the top level (0 on every
+    # healthy run; the fixed-capacity trade is documented in
+    # models/mm1.py:38-47 with the stationary overflow probability)
+    if "failed_replications" in detail:
+        line["failed_replications"] = detail["failed_replications"]
+        if detail["failed_replications"]:
+            line["bias_note"] = (
+                "failed replications are masked out of the pooled "
+                "estimate (fixed-capacity overflow, P~1.4e-6/event for "
+                "the mm1 ring at rho=0.9); regrow detail reports the "
+                "unbiased re-run where attempted"
+            )
+    print(json.dumps(line), flush=True)
+
+
+def _regrow_pass(spec, params, R, t_end=None):
+    """Unbiased re-run through the capacity escape hatch, attached to a
+    config's detail whenever the timed run masked failures: doubling
+    re-runs the whole batch (healthy lanes reproduce bit-identically —
+    counter-derived streams), so ``failed_after`` tells whether the
+    failures were growable capacity (event table) or a structural cap
+    (e.g. the documented mm1 ring trade, models/mm1.py:38-47)."""
+    import numpy as np
+
+    from cimba_tpu.runner import experiment as ex
+
+    t0 = time.perf_counter()
+    try:
+        res, final_spec, n_regrows = ex.run_experiment_regrow(
+            spec, params, R, seed=2026, t_end=t_end
+        )
+    except RuntimeError as e:  # overflow persisted through max doublings
+        return {"error": str(e)[:200]}
+    wall = time.perf_counter() - t0
+    err = np.asarray(res.sims.err)
+    return {
+        "n_regrows": n_regrows,
+        "event_cap_final": final_spec.event_cap,
+        "failed_after": int((err != 0).sum()),
+        "total_events": int(np.asarray(res.sims.n_events).sum()),
+        "wall_s": wall,
+    }
 
 
 def _kernel_mesh():
@@ -297,18 +335,21 @@ def bench_mm1():
         spec, init_one, R, jnp.int32(1), jnp.int32(N)
     )
     rate = ev / wall
+    detail = {
+        "path": "xla_while",
+        "replications": R,
+        "objects_per_replication": N,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": failed,
+    }
+    if failed:
+        detail["regrow"] = _regrow_pass(spec, mm1.params(N), R)
     _line(
         "mm1_events_per_sec",
         rate,
         rate / BASELINE_EVENTS_PER_SEC,
-        {
-            "path": "xla_while",
-            "replications": R,
-            "objects_per_replication": N,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": failed,
-        },
+        detail,
     )
 
 
@@ -326,19 +367,17 @@ def bench_mmc():
     ev, failed, wall = _time_vmapped(
         spec, init_one, R, jnp.int32(1), jnp.int32(N)
     )
-    _line(
-        "mmc_events_per_sec",
-        ev / wall,
-        None,
-        {
-            "c": c,
-            "replications": R,
-            "objects_per_replication": N,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": failed,
-        },
-    )
+    detail = {
+        "c": c,
+        "replications": R,
+        "objects_per_replication": N,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": failed,
+    }
+    if failed:
+        detail["regrow"] = _regrow_pass(spec, mmc.params(N, 2.5, 1.0), R)
+    _line("mmc_events_per_sec", ev / wall, None, detail)
 
 
 def bench_mg1():
@@ -359,21 +398,19 @@ def bench_mg1():
         return cl.init_sim(spec, 2026, rep, lane)
 
     ev, failed, wall = _time_vmapped(spec, init_one, R, warm, params)
-    _line(
-        "mg1_sweep_events_per_sec",
-        ev / wall,
-        None,
-        {
-            "cells": "4cv x 5rho",
-            "reps_per_cell": reps,
-            "replications": R,
-            "objects_per_replication": N,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": failed,
-            "reference_wall_s_200x1e6_units": 1.5,
-        },
-    )
+    detail = {
+        "cells": "4cv x 5rho",
+        "reps_per_cell": reps,
+        "replications": R,
+        "objects_per_replication": N,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": failed,
+        "reference_wall_s_200x1e6_units": 1.5,
+    }
+    if failed:
+        detail["regrow"] = _regrow_pass(spec, params, R)
+    _line("mg1_sweep_events_per_sec", ev / wall, None, detail)
 
 
 def bench_jobshop():
@@ -390,18 +427,16 @@ def bench_jobshop():
     ev, failed, wall = _time_vmapped(
         spec, init_one, R, jnp.int32(1), jnp.int32(N)
     )
-    _line(
-        "jobshop_events_per_sec",
-        ev / wall,
-        None,
-        {
-            "replications": R,
-            "jobs_per_replication": N,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": failed,
-        },
-    )
+    detail = {
+        "replications": R,
+        "jobs_per_replication": N,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": failed,
+    }
+    if failed:
+        detail["regrow"] = _regrow_pass(spec, jobshop.params(N), R)
+    _line("jobshop_events_per_sec", ev / wall, None, detail)
 
 
 def bench_awacs():
@@ -466,21 +501,19 @@ def bench_awacs():
     ev, failed, wall = _time_vmapped(
         spec, init_one, R, jnp.asarray(0.5), jnp.asarray(t_end)
     )
-    _line(
-        "awacs_events_per_sec",
-        ev / wall,
-        None,
-        {
-            "path": "xla_while",
-            "n_targets": n_targets,
-            "replications": R,
-            "t_end": t_end,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": failed,
-            "reference_wall_s_300x6h": 78.0,
-        },
-    )
+    detail = {
+        "path": "xla_while",
+        "n_targets": n_targets,
+        "replications": R,
+        "t_end": t_end,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": failed,
+        "reference_wall_s_300x6h": 78.0,
+    }
+    if failed:
+        detail["regrow"] = _regrow_pass(spec, (t_end,), R)
+    _line("awacs_events_per_sec", ev / wall, None, detail)
 
 
 CONFIGS = {

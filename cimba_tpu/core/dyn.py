@@ -327,6 +327,31 @@ def dadd(arr, i, v, pred=True):
     return arr + jnp.where(m, v, jnp.zeros((), arr.dtype))
 
 
+def dexchange2(arr, i0, i1, v, do_write, pred=True):
+    """Read-or-write at ONE shared one-hot: returns
+    ``(arr[i0, i1], arr.at[i0, i1].set(where(do_write, v, arr[i0, i1])))``
+    gated by ``pred``.
+
+    Where ``do_write`` is false the written value is the read itself — a
+    bitwise no-op — so a single mask (and a single full-width select)
+    serves both verbs.  This is how the combined queue handler halves the
+    ring's full-width ops: put and get share the compare and the write
+    pass, differing only in a scalar select of the value.
+    """
+    n0, n1 = arr.shape[0], arr.shape[1]
+    if pred is not True:
+        if n1 >= _GATE_IDX_MIN:
+            i1, pred = _gate_idx(i1, pred), True
+        elif n0 >= _GATE_IDX_MIN:
+            i0, pred = _gate_idx(i0, pred), True
+    mask = _oh2(n0, n1, i0, i1)
+    if pred is not True:
+        mask = mask & pred
+    item = _reduce_pick(mask, arr)
+    wv = jnp.where(do_write, jnp.asarray(v, arr.dtype), item)
+    return item, _masked_write(arr, mask, wv, True)
+
+
 def set_col(arr, k: int, col):
     """``arr.at[:, k].set(col)`` for a *static* column index — expressed as
     a select over a constant column mask (``.at[:, k]`` lowers to a scatter,

@@ -1123,74 +1123,64 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         return set_pc(sim, p, cmd.next_pc, gate), jnp.asarray(False)
 
     @_gated
-    def h_put(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
-        # straight-line with pred-gated writes: the ok and blocked paths
-        # touch disjoint state under complementary predicates, so no
-        # whole-Sim branch select is needed (each saved select is a full
-        # pass over the queue ring in the kernel)
+    def h_queue(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        """PUT and GET as ONE traced handler, aliased at both dispatch
+        slots (so _vswitch traces it once).  The verbs differ only in a
+        few scalar selects; sharing lets the ring's full-width ops —
+        the largest line in the kernel's per-event budget — serve both:
+        one one-hot, one read pass, one write pass (dyn.dexchange2: a
+        get "writes back" the value it read, a bitwise no-op).  All
+        writes pred-gated straight-line, as before (no whole-Sim branch
+        select — each saved select is a full pass over the ring).
+        """
         qid = cmd.i
+        is_put = cmd.tag == pr.C_PUT
         size = dyn.dget(sim.queues.size, qid)
+        head = dyn.dget(sim.queues.head, qid)
         cap = q_cap[qid]
         # no-jump-ahead fairness (parity: src/cmb_resource.c:202-233): a
-        # fresh caller must queue behind existing waiters; a woken caller
-        # IS the dequeued front and may proceed despite others behind it
-        may = is_retry | gd.is_empty(sim.procs.pend_guard, q_rear[qid])
-        full = (size >= cap) | ~may
-        ok = _and(~full, gate)
+        # fresh caller must queue behind existing waiters (putters watch
+        # the rear guard, getters the front); a woken caller IS the
+        # dequeued front and may proceed despite others behind it
+        own_gid = jnp.where(is_put, q_rear[qid], q_front[qid])
+        may = is_retry | gd.is_empty(sim.procs.pend_guard, own_gid)
+        blocked = jnp.where(is_put, size >= cap, size <= 0) | ~may
+        ok = _and(~blocked, gate)
+        ok_get = ok & ~is_put
 
-        col = (dyn.dget(sim.queues.head, qid) + size) % cap
-        sim = sim._replace(queues=Queues(
-            items=dyn.dset2(sim.queues.items, qid, col, cmd.f, ok),
-            head=sim.queues.head,
-            size=dyn.dadd(sim.queues.size, qid, 1, ok),
-            acc=_record_row_if(
-                q_rec, sim.queues.acc, qid, sim.clock,
-                (size + 1).astype(_R), ok,
+        idx = jnp.where(is_put, (head + size) % cap, head)
+        item, items2 = dyn.dexchange2(
+            sim.queues.items, qid, idx, cmd.f, is_put, ok
+        )
+        dsz = jnp.where(is_put, 1, -1).astype(size.dtype)
+        sim = sim._replace(
+            queues=Queues(
+                items=items2,
+                head=dyn.dset(sim.queues.head, qid, (head + 1) % cap,
+                              ok_get),
+                size=dyn.dadd(sim.queues.size, qid, dsz, ok),
+                acc=_record_row_if(
+                    q_rec, sim.queues.acc, qid, sim.clock,
+                    (size + dsz).astype(_R), ok,
+                ),
             ),
-        ))
-        # a successful put frees no space, so only the getter side can
-        # newly be satisfiable
+            procs=sim.procs._replace(
+                got=dyn.dset(sim.procs.got, p, item, ok_get)
+            ),
+        )
+        # signal order preserved from the split handlers (wake seqs are
+        # order-assigned): a get signals rear (space) then front
+        # (leftover items); a put frees no space, so only the getter
+        # side can newly be satisfiable
+        sim = _guard_signal(sim, q_rear[qid], pred=ok_get)
         sim = _guard_signal(sim, q_front[qid], pred=ok)
         # both outcomes continue at next_pc (the blocked path's signals
         # deliver there), so the pc write is gated only by the branch
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
-            sim, p, q_rear[qid], cmd, is_retry, pred=_and(full, gate)
+            sim, p, own_gid, cmd, is_retry, pred=_and(blocked, gate)
         )
-        return sim, full
-
-    @_gated
-    def h_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
-        qid = cmd.i
-        size = dyn.dget(sim.queues.size, qid)
-        may = is_retry | gd.is_empty(sim.procs.pend_guard, q_front[qid])
-        empty = (size <= 0) | ~may
-        ok = _and(~empty, gate)
-        cap = q_cap[qid]
-
-        head = dyn.dget(sim.queues.head, qid)
-        item = dyn.dget2(sim.queues.items, qid, head)
-        sim = sim._replace(
-            queues=Queues(
-                items=sim.queues.items,
-                head=dyn.dset(sim.queues.head, qid, (head + 1) % cap, ok),
-                size=dyn.dadd(sim.queues.size, qid, -1, ok),
-                acc=_record_row_if(
-                    q_rec, sim.queues.acc, qid, sim.clock,
-                    (size - 1).astype(_R), ok,
-                ),
-            ),
-            procs=sim.procs._replace(
-                got=dyn.dset(sim.procs.got, p, item, ok)
-            ),
-        )
-        sim = _guard_signal(sim, q_rear[qid], pred=ok)   # space for putters
-        sim = _guard_signal(sim, q_front[qid], pred=ok)  # leftover items
-        sim = set_pc(sim, p, cmd.next_pc, gate)
-        sim = _guard_wait(
-            sim, p, q_front[qid], cmd, is_retry, pred=_and(empty, gate)
-        )
-        return sim, empty
+        return sim, blocked
 
     def _grab_resource(sim, p, rid, pred=True):
         r2 = Resources(
@@ -1612,8 +1602,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         h_hold,                                  # C_HOLD
         h_exit,                                  # C_EXIT
         h_jump,                                  # C_JUMP
-        component_gate(has_q, h_put),                      # C_PUT
-        component_gate(has_q, h_get),                      # C_GET
+        component_gate(has_q, h_queue),                    # C_PUT
+        component_gate(has_q, h_queue),                    # C_GET
         component_gate(has_r, h_acquire),                  # C_ACQUIRE
         component_gate(has_r, h_release),                  # C_RELEASE
         component_gate(has_r, h_preempt),                  # C_PREEMPT
@@ -1787,38 +1777,49 @@ def make_step(spec: ModelSpec):
 
         def body(carry):
             sim, sig, _, n, use_pend = carry
-            if not may_pend:
-                # no retry arm exists: the block always runs and its
-                # command applies directly (no use_pend merge at all)
-                if config.KERNEL_MODE and spec.boundary_pcs:
-                    in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
-                    sim = _set_err(sim, in_b, ERR_BOUNDARY)
-                sim2, cmd = run_block(sim, p, sig)
-            elif config.KERNEL_MODE:
-                if spec.boundary_pcs:
-                    # boundary blocks may only be entered by dispatch
-                    # (which the kernel defers to the chunk driver) —
-                    # reaching one mid-chain would run its stub, so it
-                    # fails the lane loudly instead
-                    in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
-                    sim = _set_err(sim, in_b & ~use_pend, ERR_BOUNDARY)
-                # both arms run under vmap regardless; the explicit
-                # bwhere-fold keeps bool leaves off Mosaic's unsupported
-                # i1 select_n path
-                s_blk, c_blk = run_block(sim, p, sig)
-                sim2 = _tree_select(use_pend, sim, s_blk)
-                cmd = jax.tree.map(
-                    lambda a, b: dyn.bwhere(use_pend, a, b), pend, c_blk
-                )
-            else:
-                # scalar/XLA path keeps lax.cond: an unbatched pend-retry
-                # must not execute the block (user side effects fire once)
-                sim2, cmd = lax.cond(
-                    use_pend,
-                    lambda s: (s, pend),
-                    lambda s: run_block(s, p, sig),
-                    sim,
-                )
+            # Draw-word hoist (bits.stash_arm): every block branch's first
+            # counter tick shares one traced Threefry keyed on the
+            # pre-dispatch rng tracers; branches are exclusive per lane,
+            # so one block of ~120 scalar ops serves every draw site in
+            # the switch (values bit-identical, lazily traced — see
+            # random/bits.py).  The XLA cond arm below traces blocks in a
+            # sub-trace where the key cannot match; it simply misses.
+            rb.stash_arm(sim.rng)
+            try:
+                if not may_pend:
+                    # no retry arm exists: the block always runs and its
+                    # command applies directly (no use_pend merge at all)
+                    if config.KERNEL_MODE and spec.boundary_pcs:
+                        in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
+                        sim = _set_err(sim, in_b, ERR_BOUNDARY)
+                    sim2, cmd = run_block(sim, p, sig)
+                elif config.KERNEL_MODE:
+                    if spec.boundary_pcs:
+                        # boundary blocks may only be entered by dispatch
+                        # (which the kernel defers to the chunk driver) —
+                        # reaching one mid-chain would run its stub, so it
+                        # fails the lane loudly instead
+                        in_b = boundary_table[dyn.dget(sim.procs.pc, p)] != 0
+                        sim = _set_err(sim, in_b & ~use_pend, ERR_BOUNDARY)
+                    # both arms run under vmap regardless; the explicit
+                    # bwhere-fold keeps bool leaves off Mosaic's unsupported
+                    # i1 select_n path
+                    s_blk, c_blk = run_block(sim, p, sig)
+                    sim2 = _tree_select(use_pend, sim, s_blk)
+                    cmd = jax.tree.map(
+                        lambda a, b: dyn.bwhere(use_pend, a, b), pend, c_blk
+                    )
+                else:
+                    # scalar/XLA path keeps lax.cond: an unbatched pend-retry
+                    # must not execute the block (user side effects fire once)
+                    sim2, cmd = lax.cond(
+                        use_pend,
+                        lambda s: (s, pend),
+                        lambda s: run_block(s, p, sig),
+                        sim,
+                    )
+            finally:
+                rb.stash_clear()
             sim2, yielded = apply_command(
                 sim2, p, cmd,
                 is_retry=use_pend if may_pend else False,

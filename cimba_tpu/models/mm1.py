@@ -30,7 +30,7 @@ L_PRODUCED = 0
 
 def build(
     queue_cap: int = 128,
-    event_cap: int = 8,
+    event_cap: int = 1,
     guard_cap: int = 4,
     record: bool = True,
 ):
@@ -47,6 +47,11 @@ def build(
     run_experiment_regrow) for heavier-tailed loads.
     ``record=False`` drops queue-length recording from the hot loop (the
     benchmark configuration, like the reference's NLOGINFO build).
+    ``event_cap=1``: holds and guard wakes ride the dense per-pid wake
+    table; the general event table serves only timers/user events, of
+    which this model has none — one placeholder slot keeps every
+    general-table pass (scan, lexmin, validation) out of the per-event
+    budget (trajectory-identical to any larger cap, pinned by goldens).
     """
     m = Model(
         "mm1",

@@ -132,9 +132,68 @@ def to_u64(b0, b1):
     )
 
 
+# Draw-word hoist: the chain loop arms a stash per iteration (loop.py)
+# so that the FIRST counter tick of every block branch shares ONE traced
+# Threefry block.  Blocks are mutually exclusive per lane, so at runtime
+# at most one branch consumes the words — but without the stash every
+# draw *site* traced its own ~120-op Threefry, all of which execute every
+# masked kernel step (mm1: 2 sites -> 260 scalar ops/event, the largest
+# single line in the per-event budget).  Keyed by tracer IDENTITY of the
+# incoming (key, counter): every branch receives the same pre-dispatch
+# ``sim.rng`` tracers, so first ticks hit; a second tick in the same
+# block has an advanced counter (new tracer) and misses to the normal
+# path.  Values are bit-identical either way — the stash IS
+# threefry(key, ctr) — so draw streams, goldens and checkpoints are
+# unchanged.  Lazy: the block is computed at the first consuming site,
+# so draw-free models trace nothing extra.
+_stash = None
+
+
+def stash_arm(state: RandomState) -> None:
+    """Arm the hoist for the current trace with the pre-dispatch stream
+    state.  Caller must :func:`stash_clear` when its trace scope ends."""
+    global _stash
+    from jax._src import core as _jcore
+
+    _stash = [id(_jcore.trace_ctx.trace), state, None]
+
+
+def stash_clear() -> None:
+    global _stash
+    _stash = None
+
+
+def _stash_take(state: RandomState):
+    s = _stash
+    if s is None:
+        return None
+    from jax._src import core as _jcore
+
+    tid, src, words = s
+    if (
+        tid != id(_jcore.trace_ctx.trace)
+        or src.ctr_lo is not state.ctr_lo
+        or src.ctr_hi is not state.ctr_hi
+        or src.key0 is not state.key0
+        or src.key1 is not state.key1
+    ):
+        return None
+    if words is None:
+        s[2] = words = threefry2x32(
+            state.key0, state.key1, state.ctr_lo, state.ctr_hi
+        )
+    return words
+
+
 def next_bits64(state: RandomState):
     """Draw one 64-bit word (as two uint32) and advance the counter."""
-    b0, b1 = threefry2x32(state.key0, state.key1, state.ctr_lo, state.ctr_hi)
+    hit = _stash_take(state)
+    if hit is not None:
+        b0, b1 = hit
+    else:
+        b0, b1 = threefry2x32(
+            state.key0, state.key1, state.ctr_lo, state.ctr_hi
+        )
     lo = state.ctr_lo + _U32(1)
     hi = state.ctr_hi + jnp.where(lo == _U32(0), _U32(1), _U32(0)).astype(_U32)
     return RandomState(state.key0, state.key1, lo, hi), b0, b1

@@ -41,9 +41,11 @@ def test_mm1_step_cost_budget():
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
         el, ops = _cost(spec, (1.0 / 0.9, 1.0, 200))
-    # round-4 measured: 2,457 el / 1,047 ops
-    assert el <= 2_700, f"mm1 step cost regressed: {el} elements/event"
-    assert ops <= 1_200, f"mm1 step op count regressed: {ops} ops/event"
+    # round-5 measured: 1,766 el / 815 ops (draw-word hoist, combined
+    # put/get ring handler, event_cap=1) — ceiling ~545M events/s/chip,
+    # clear of the 469M/chip the v5e-8 north star needs
+    assert el <= 1_900, f"mm1 step cost regressed: {el} elements/event"
+    assert ops <= 880, f"mm1 step op count regressed: {ops} ops/event"
 
 
 def test_awacs_step_cost_budget():
