@@ -499,12 +499,19 @@ def _schedule_wake(sim: Sim, pred, p, sig, t=None) -> Sim:
     return _set_err(sim, armed & ~ok, ERR_EVENT_OVERFLOW)
 
 
-def _guard_signal(sim: Sim, gid, pred=True) -> Sim:
+def _guard_signal(sim: Sim, gid, pred=True, spec=None) -> Sim:
     """Wake the best waiter (if any): schedule its retry at the current
     time with its process priority (parity: cmb_resourceguard_signal
     scheduling wakeup events rather than switching directly).  ``pred``
     gates the whole signal (lets handlers run straight-line with masked
-    writes instead of a whole-Sim branch select)."""
+    writes instead of a whole-Sim branch select).
+
+    Observer forwarding (parity: cmb_resourceguard_register,
+    `src/cmb_resourceguard.c:313-330`): when ``spec`` is supplied and a
+    condition declares ``observes`` covering this guard, the signal also
+    re-evaluates that condition's waiters — so a release/put/rollback
+    satisfying a predicate wakes its cond_wait-ers without the model
+    signalling manually.  Observer-free models trace zero extra ops."""
     pid, found = gd.best_waiter(
         sim.procs.pend_guard, sim.procs.pend_seq, sim.procs.prio, gid
     )
@@ -517,7 +524,23 @@ def _guard_signal(sim: Sim, gid, pred=True) -> Sim:
             pend_guard=dyn.dset(sim.procs.pend_guard, p, -1, woke)
         )
     )
-    return _schedule_wake(sim, woke, p, pr.SUCCESS)
+    sim = _schedule_wake(sim, woke, p, pr.SUCCESS)
+    if spec is not None:
+        for c in spec.conditions:
+            if not c.observes:
+                continue
+            # membership of THIS (possibly traced) gid in the observed
+            # set, as a const table lookup; forwarding is gated by the
+            # same pred as the signal itself
+            obs = _ConstTable(
+                [1 if g in c.observes else 0 for g in range(spec.n_guards)],
+                jnp.int32,
+            )
+            fire = obs[jnp.asarray(gid, _I)] != 0
+            if pred is not True:
+                fire = fire & pred
+            sim = cond_signal(spec, sim, c.id, pred=fire)
+    return sim
 
 
 def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False,
@@ -757,7 +780,7 @@ def _abort_cleanup(spec: ModelSpec, sim: Sim, p, pend: pr.Command, sig,
                 ),
             )
         )
-        rb = _guard_signal(rb, p_guard_c[k])
+        rb = _guard_signal(rb, p_guard_c[k], spec=spec)
         sim = _tree_select(do_rb, rb, sim)
     if spec.buffers:
         is_buf = (pend.tag == pr.C_BUF_GET) | (pend.tag == pr.C_BUF_PUT)
@@ -833,7 +856,7 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig, pred=True) -> Sim:
             ),
         )
         sim = sim._replace(resources=r2)
-        return _guard_signal(sim, r_guard[rid], pred=held)
+        return _guard_signal(sim, r_guard[rid], pred=held, spec=spec)
 
     # pool units held by p return to the pool
     def drop_pool(k, sim):
@@ -850,7 +873,7 @@ def finish_process(spec: ModelSpec, sim: Sim, p, exit_sig, pred=True) -> Sim:
             ),
         )
         sim = sim._replace(pools=p2)
-        return _guard_signal(sim, p_guard[k], pred=has)
+        return _guard_signal(sim, p_guard[k], pred=has, spec=spec)
 
     if spec.resources:
         sim = _kfori(0, sim.resources.holder.shape[0], drop_res, sim)
@@ -968,9 +991,13 @@ def priority_set(sim: Sim, p, new_prio) -> Sim:
 
 
 def _cond_satisfied(spec: ModelSpec, sim: Sim, cid, pid):
-    """Evaluate condition ``cid``'s registered predicate for ``pid``."""
+    """Evaluate condition ``cid``'s registered predicate for ``pid``.
+    A static (python int) ``cid`` — the observer-forwarding path —
+    traces only that condition's predicate."""
     if not spec.conditions:
         return jnp.asarray(False)
+    if isinstance(cid, int):
+        return jnp.asarray(spec.conditions[cid].predicate(sim, pid))
     pred_fns = [
         (lambda c: (lambda s, q: jnp.asarray(c.predicate(s, q))))(c)
         for c in spec.conditions
@@ -981,22 +1008,28 @@ def _cond_satisfied(spec: ModelSpec, sim: Sim, cid, pid):
     )
 
 
-def cond_signal(spec: ModelSpec, sim: Sim, cid) -> Sim:
+def cond_signal(spec: ModelSpec, sim: Sim, cid, pred=True) -> Sim:
     """Signal a condition: evaluate the predicate for every waiter and wake
     all satisfied ones (parity: cmb_condition_signal's two-pass wake-all,
     `src/cmb_condition.c:106-167`; the woken retry re-checks, so spurious
-    wakeups re-wait inside the framework)."""
+    wakeups re-wait inside the framework).  ``pred`` gates the whole
+    signal (the observer-forwarding path runs straight-line, masked)."""
     if not spec.conditions:
         return sim
-    c_guard = _ConstTable([c.guard for c in spec.conditions], _I)
-    cid = jnp.asarray(cid, _I)
-    gid = c_guard[cid]
+    if isinstance(cid, int):
+        gid = spec.conditions[cid].guard
+    else:
+        c_guard = _ConstTable([c.guard for c in spec.conditions], _I)
+        cid = jnp.asarray(cid, _I)
+        gid = c_guard[cid]
 
     def visit(q, sim):
         # dense guards: candidate waiters are the processes themselves
         live = dyn.dget(sim.procs.pend_guard, q) == gid
         satisfied = _cond_satisfied(spec, sim, cid, q)
         wake = live & satisfied
+        if pred is not True:
+            wake = wake & pred
         sim = sim._replace(
             procs=sim.procs._replace(
                 pend_guard=dyn.dset(sim.procs.pend_guard, q, -1, wake)
@@ -1172,8 +1205,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # order-assigned): a get signals rear (space) then front
         # (leftover items); a put frees no space, so only the getter
         # side can newly be satisfiable
-        sim = _guard_signal(sim, q_rear[qid], pred=ok_get)
-        sim = _guard_signal(sim, q_front[qid], pred=ok)
+        sim = _guard_signal(sim, q_rear[qid], pred=ok_get, spec=spec)
+        sim = _guard_signal(sim, q_front[qid], pred=ok, spec=spec)
         # both outcomes continue at next_pc (the blocked path's signals
         # deliver there), so the pc write is gated only by the branch
         sim = set_pc(sim, p, cmd.next_pc, gate)
@@ -1251,7 +1284,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             ),
         )
         sim2 = sim._replace(resources=r2)
-        sim2 = _guard_signal(sim2, r_guard[rid], pred=gate)
+        sim2 = _guard_signal(sim2, r_guard[rid], pred=gate, spec=spec)
         sim2 = set_pc(sim2, p, cmd.next_pc, gate)
         sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
@@ -1354,7 +1387,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # (parity: cmi_pool_acquire_inner signals after completing a grab;
         # signaling from a still-blocked partial grab would ping-pong
         # wakes between starved waiters forever)
-        sim = _guard_signal(sim, p_guard[k], pred=_and(done, gate))
+        sim = _guard_signal(sim, p_guard[k], pred=_and(done, gate), spec=spec)
         sim = set_pc(sim, p, cmd.next_pc, _and(done, gate))
         sim = _guard_wait(
             sim,
@@ -1401,7 +1434,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             ),
         )
         sim2 = sim._replace(pools=p2)
-        sim2 = _guard_signal(sim2, p_guard[k], pred=gate)
+        sim2 = _guard_signal(sim2, p_guard[k], pred=gate, spec=spec)
         sim2 = set_pc(sim2, p, cmd.next_pc, gate)
         sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
@@ -1436,9 +1469,9 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 ),
             )
         )
-        sim = _guard_signal(sim, other_guard, pred=_and(moved > 0.0, gate))
+        sim = _guard_signal(sim, other_guard, pred=_and(moved > 0.0, gate), spec=spec)
         # pass leftover wake along on completion only
-        sim = _guard_signal(sim, my_guard, pred=_and(done, gate))
+        sim = _guard_signal(sim, my_guard, pred=_and(done, gate), spec=spec)
         sim = sim._replace(
             procs=sim.procs._replace(
                 got=dyn.dset(sim.procs.got, p, total, _and(done, gate))
@@ -1487,7 +1520,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         sim = sim._replace(pqueues=pq2)
         # put frees no slots: only the getter side can newly proceed
-        sim = _guard_signal(sim, pq_front[qid], pred=ok)
+        sim = _guard_signal(sim, pq_front[qid], pred=ok, spec=spec)
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, pq_rear[qid], cmd, is_retry, pred=_and(full, gate)
@@ -1524,8 +1557,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 got=dyn.dset(sim.procs.got, p, item, ok)
             ),
         )
-        sim = _guard_signal(sim, pq_rear[qid], pred=ok)
-        sim = _guard_signal(sim, pq_front[qid], pred=ok)
+        sim = _guard_signal(sim, pq_rear[qid], pred=ok, spec=spec)
+        sim = _guard_signal(sim, pq_front[qid], pred=ok, spec=spec)
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, pq_front[qid], cmd, is_retry, pred=_and(empty, gate)

@@ -91,6 +91,11 @@ class ConditionRef:
     name: str
     guard: int
     predicate: Callable  # predicate(sim, pid) -> bool array
+    #: guard ids this condition OBSERVES (parity: cmb_resourceguard_register,
+    #: `src/cmb_resourceguard.c:313-330`): any signal on an observed guard —
+    #: a release, put, rollback, drop-on-exit — forwards into cond_signal,
+    #: so waiters re-evaluate without the model signalling at every site
+    observes: tuple = ()
 
 
 @dataclasses.dataclass
@@ -295,13 +300,41 @@ class Model:
         self._pqueues.append(q)
         return q
 
-    def condition(self, name: str, predicate: Callable) -> ConditionRef:
+    def condition(
+        self, name: str, predicate: Callable, observes=()
+    ) -> ConditionRef:
         """Condition variable: processes wait until ``predicate(sim, pid)``
         holds at a signal (parity: cmb_condition; the reference's C
-        predicate pointer becomes a traced function registered here)."""
+        predicate pointer becomes a traced function registered here).
+
+        ``observes`` — components (resources, pools, buffers, queues,
+        priority queues) whose state changes can satisfy the predicate:
+        any guard signal they emit (release, put, rollback, drop-on-exit)
+        auto-forwards into a signal of this condition, so the model never
+        has to call ``api.cond_signal`` at release sites (parity:
+        ``cmb_resourceguard_register``, `src/cmb_resourceguard.c:313-330`,
+        the mechanism the reference's harbor tutorial rests on,
+        `tutorial/tut_4_1.c:499-501`).  Signals driven by non-component
+        state (e.g. a tide process updating user state) still need the
+        explicit ``api.cond_signal``.
+        """
+        gids = []
+        for comp in observes:
+            found = False
+            for attr in ("guard", "front_guard", "rear_guard"):
+                g = getattr(comp, attr, None)
+                if g is not None:
+                    gids.append(g)
+                    found = True
+            if not found:
+                raise TypeError(
+                    f"condition {name!r}: observes entry {comp!r} has no "
+                    "guard — pass component refs (resource/pool/buffer/"
+                    "queue/pqueue)"
+                )
         c = ConditionRef(
             id=len(self._conditions), name=name, guard=self._guard(),
-            predicate=predicate,
+            predicate=predicate, observes=tuple(gids),
         )
         self._conditions.append(c)
         return c

@@ -66,8 +66,16 @@ def build():
             & (api.pool_level(sim, tugs) >= TUGS_NEEDED)
         )
 
-    harbormaster = m.condition("harbormaster", ready_to_dock)
-    davyjones = m.condition("davyjones", ready_to_sail)
+    # observes= is the reference's cmb_resourceguard_register
+    # (`tut_4_1.c:499-501`): any tug/berth release — including rollbacks
+    # and drop-on-exit — re-evaluates the waiters automatically, so no
+    # release site below signals manually (forgetting one used to strand
+    # waiters silently).  The tide still signals explicitly: depth is
+    # user state, not a component, so no guard observes it.
+    harbormaster = m.condition(
+        "harbormaster", ready_to_dock, observes=[tugs, berths]
+    )
+    davyjones = m.condition("davyjones", ready_to_sail, observes=[tugs])
     spec_box = []
 
     @m.user_state
@@ -154,20 +162,16 @@ def build():
 
     @m.block
     def sail(sim, p, sig):
-        # leaving: berth + tugs go back, which may clear a waiter's
-        # predicate — the releases signal those guards on their own
-        sim2 = sim
-        return sim2, cmd.pool_release(berths.id, 1.0, next_pc=free_tugs.pc)
+        # leaving: berth + tugs go back; each release's guard signal
+        # forwards into the observing conditions on its own
+        return sim, cmd.pool_release(berths.id, 1.0, next_pc=free_tugs.pc)
 
     @m.block
     def free_tugs(sim, p, sig):
-        sim = api.cond_signal(sim, spec_box[0], harbormaster)
-        sim = api.cond_signal(sim, spec_box[0], davyjones)
         return sim, cmd.pool_release(tugs.id, TUGS_NEEDED, next_pc=gone.pc)
 
     @m.block
     def gone(sim, p, sig):
-        sim = api.cond_signal(sim, spec_box[0], harbormaster)
         return sim, cmd.exit_()
 
     m.process("tide", entry=tide, prio=10)
