@@ -468,6 +468,63 @@ def _vswitch(idx, branches, *args):
     return jax.tree.unflatten(treedef, merged)
 
 
+def _check_gated_noop(name: str, h, sim: Sim, tag: int) -> None:
+    """Eagerly run one self-gated handler with ``gate=False`` on a
+    CONCRETE Sim and assert the output is bitwise identical — the
+    invariant :func:`_vswitch`'s zero-merge sequential composition rests
+    on.  A handler with one ungated write corrupts *other lanes'* state
+    only under vmap, far from the cause; this fails loudly at the
+    handler, by name and leaf path."""
+    import numpy as np
+
+    cmd = pr.Command(
+        jnp.asarray(tag, _I),
+        jnp.asarray(0.5, _R),
+        jnp.asarray(0.25, _R),
+        jnp.zeros((), _I),
+        jnp.zeros((), _I),
+    )
+    out = h(
+        sim, jnp.zeros((), _I), cmd, jnp.asarray(False),
+        gate=jnp.zeros((), jnp.bool_),
+    )
+    sim2 = out[0] if isinstance(out, tuple) else out
+    flat, _ = jax.tree_util.tree_flatten_with_path(sim)
+    flat2 = jax.tree.leaves(sim2)
+    for (path, a), b in zip(flat, flat2):
+        a, b = np.asarray(a), np.asarray(b)
+        same = (
+            np.array_equal(a, b, equal_nan=True)
+            if np.issubdtype(a.dtype, np.inexact)
+            else np.array_equal(a, b)
+        )
+        if not same:
+            raise AssertionError(
+                f"gated handler {name!r} (tag {tag}) is not a no-op "
+                f"under gate=False: Sim leaf "
+                f"{jax.tree_util.keystr(path)} changed — every write in "
+                "a _gated handler must be pred-gated by its gate"
+            )
+
+
+def validate_gated_handlers(spec: ModelSpec, sim: Sim) -> None:
+    """Debug-tier structural check over the full handler table: every
+    self-gated command handler must leave a concrete Sim bitwise
+    untouched under ``gate=False``.  Traced nowhere — runs eagerly on
+    one per-lane Sim, once per kernel build (pallas_run wires it behind
+    the dbc debug tier), so the invariant the fuzz battery only samples
+    is enforced structurally."""
+    apply = _make_apply(spec, None)
+    seen: set = set()
+    for tag, h in apply.handler_items:
+        if not getattr(h, "self_gated", False) or id(h) in seen:
+            continue
+        seen.add(id(h))
+        _check_gated_noop(
+            getattr(h, "__name__", repr(h)), h, sim, tag
+        )
+
+
 def _set_err(sim: Sim, pred, code) -> Sim:
     return sim._replace(
         err=jnp.where((sim.err == 0) & pred, jnp.asarray(code, _I), sim.err)
@@ -1658,6 +1715,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 jnp.clip(cmd.tag, 0, pr.N_COMMANDS - 1), handlers, sim, p,
                 cmd, jnp.asarray(is_retry),
             )
+        apply_command.handler_items = list(enumerate(handlers))
         return apply_command
 
     # Specialized table: trace only the handlers this model's blocks can
@@ -1677,6 +1735,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             idx, table, sim, p, cmd, jnp.asarray(is_retry),
         )
 
+    apply_command.handler_items = list(enumerate(handlers))
     return apply_command
 
 

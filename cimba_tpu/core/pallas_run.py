@@ -255,6 +255,8 @@ def make_kernel_run(
 
         return chunk_fn, consts_in
 
+    _validated = []
+
     def run(sims):
         # Host-level driver, NOT for use under an outer jit.  The whole
         # kernel path — tracing, Mosaic lowering AND compilation — must
@@ -265,6 +267,22 @@ def make_kernel_run(
         # first call of the inner jit, so the first chunk invocation sits
         # inside this scope too.  Init (u64 seed mixing) stays outside,
         # under the session's x64 setting.
+        if not _validated:
+            # debug tier: enforce the _vswitch zero-merge invariant
+            # structurally — every self-gated handler is a bitwise no-op
+            # under gate=False on a concrete lane-0 Sim (eager, once per
+            # kernel build; a violation corrupts OTHER lanes only under
+            # vmap, far from its cause)
+            from cimba_tpu.utils import dbc
+
+            if dbc.debug_enabled() and not any(
+                isinstance(l, jax.core.Tracer)
+                for l in jax.tree.leaves(sims)
+            ):
+                cl.validate_gated_handlers(
+                    spec, jax.tree.map(lambda x: x[0], sims)
+                )
+            _validated.append(True)
         with jax.enable_x64(False):
             return _run(sims)
 

@@ -35,6 +35,13 @@ def configure(*, ndebug: bool | None = None, nassert: bool | None = None):
         _nassert = nassert
 
 
+def debug_enabled() -> bool:
+    """True when the heavyweight debug tier is active (CIMBA_NDEBUG
+    unset) — used for eager structural checks too, e.g. the gated-handler
+    no-op validation in the kernel path."""
+    return not _ndebug
+
+
 def _check(sim: Sim, pred) -> Sim:
     from cimba_tpu.core import api
 
