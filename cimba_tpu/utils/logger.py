@@ -116,7 +116,25 @@ def _emit(level_name, sim, p, fmt, *args, **kwargs):
     """One host-callback line: ``[level] r t process func(line) err | msg``
     (parity: the reference's `[trial] [seed] time process func(line): msg`,
     `src/cmb_logger.c:149-227`).  Process names and the call-site tag are
-    trace-time constants; only the numeric payload crosses the boundary."""
+    trace-time constants; only the numeric payload crosses the boundary.
+
+    Kernel-path contract (docs/07): ``jax.debug.callback`` cannot cross
+    a Mosaic kernel, so an enabled log level reached while tracing under
+    KERNEL_MODE fails HERE, loudly, at build time — never a silent line
+    loss or an opaque Mosaic lowering error hours later.  Only models
+    that actually trace an enabled log call are affected; a disabled
+    level still traces to nothing on every path."""
+    from cimba_tpu import config as _cfg
+
+    if _cfg.KERNEL_MODE:
+        raise RuntimeError(
+            f"logger.{level_name}: log emission inside the Pallas kernel "
+            "path — host callbacks cannot cross a Mosaic kernel.  Either "
+            "disable the level for kernel runs (logger.flags_off, the "
+            "reference's NLOGINFO analog), or run this model on the XLA "
+            "while-loop path (cl.make_run), which logs fine.  See "
+            "docs/07_kernel_path.md."
+        )
     rep = getattr(sim, "rep", -1)
     src = _caller_src()
     tff = _timeformatter
@@ -169,9 +187,27 @@ def user(bit: int, sim, p, fmt: str, *args, **kwargs):
 def error(sim, p, fmt: str, *args, **kwargs):
     """Log AND mark the replication failed (parity: cmb_logger_error's
     abandon-this-trial recovery — the runner counts it, the batch
-    continues)."""
+    continues).
+
+    In-kernel, the failure-flag semantics are preserved but the log LINE
+    cannot cross the Mosaic boundary: it is dropped with a trace-time
+    Python warning (not the hard error info/warning raise — a model's
+    containment path must not make it un-compilable on the kernel)."""
+    from cimba_tpu import config as _cfg
     from cimba_tpu.core import api
 
     if _mask & ERROR:
-        _emit_with_seed("error", sim, p, fmt, *args, **kwargs)
+        if _cfg.KERNEL_MODE:
+            import warnings
+
+            warnings.warn(
+                "logger.error inside the Pallas kernel path: the "
+                "replication failure flag is preserved, but the log "
+                "line is dropped (host callbacks cannot cross a Mosaic "
+                "kernel; docs/07_kernel_path.md).  Inspect sim.err and "
+                "the replay key host-side instead.",
+                stacklevel=2,
+            )
+        else:
+            _emit_with_seed("error", sim, p, fmt, *args, **kwargs)
     return api.fail(sim)
