@@ -104,9 +104,18 @@ run_cell "multichip dryrun" python __graft_entry__.py 8
 run_cell "packaging" bash -c '
   set -e
   tmp=$(mktemp -d)
-  trap "rm -rf \"$tmp\"" EXIT
+  repo=$(pwd)
+  # the wheel build litters build/ + egg-info into the source tree
+  # (setuptools behavior); clean on ANY exit so the checkout stays
+  # honest for LoC/grep audits (VERDICT r4 hygiene)
+  trap "rm -rf \"$tmp\" \"$repo/build\" \"$repo/cimba_tpu.egg-info\"" EXIT
   pip wheel --no-build-isolation --no-index --no-deps -q -w "$tmp" .
   pip install --no-index --no-deps -q --target "$tmp/site" "$tmp"/cimba_tpu-*.whl
+  # one-example smoke OUTSIDE the checkout against only the installed
+  # tree (the reference CI builds a hello program against the installed
+  # package, test/tools/verify_install.sh) — strip the example'"'"'s
+  # repo-path bootstrap so the wheel install is what resolves
+  sed "/sys.path.insert/d" examples/tut_4_harbor.py > "$tmp/harbor.py"
   cd "$tmp"
   PYTHONPATH="$tmp/site" python - <<PYEOF
 import cimba_tpu, jax
@@ -119,6 +128,8 @@ out = jax.jit(cl.make_run(spec))(sim)
 assert int(out.err) == 0 and int(out.n_events) > 0
 print("packaged import+run OK:", int(out.n_events), "events")
 PYEOF
+  PYTHONPATH="$tmp/site" python "$tmp/harbor.py"
+  echo "packaged example smoke OK"
 '
 
 exit $fail
