@@ -353,6 +353,78 @@ def bench_mm1():
     )
 
 
+def bench_mm1_single():
+    """BASELINE configs[0] twin: ``benchmark/MM1_single.c`` — ONE
+    replication, the single-stream latency number (reference: ~32M
+    events/s on one 3970X core, `docs/background.rst:1443-1445`).
+
+    At R=1 the engine is op-count-bound, not element-bound (every op
+    issues once regardless of width): the measured rate validates the
+    op-count half of the cost model in tools/kernel_cost.py (~815
+    ops/step -> ~1M steps/s/chip predicted on the kernel path).  This
+    is a LATENCY config; the throughput story is the vmapped headline.
+    ``CIMBA_BENCH_KERNEL=1`` rides the kernel at L=1 (AOT-verified
+    offline), default is the XLA while-loop — the closest analog of the
+    reference's single-threaded loop."""
+    from cimba_tpu.models import mm1
+
+    _, N = _scale(1, 20_000 if _accel() else 2_000)
+    kern = os.environ.get("CIMBA_BENCH_KERNEL")
+    if kern and kern != "0":
+        from cimba_tpu import config as _cfg
+
+        chunk = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 512))
+        with _cfg.profile("f32"):
+            spec, _ = mm1.build(record=False)
+
+            def batch(n):
+                return jax.vmap(
+                    lambda r: cl.init_sim(spec, 2026, r, mm1.params(n))
+                )(jnp.arange(1))
+
+            ev, failed, wall = _time_kernel(spec, batch, 1, N, chunk)
+        rate = ev / wall
+        _line(
+            "mm1_single_events_per_sec",
+            rate,
+            None,
+            {
+                "path": "pallas_kernel",
+                "replications": 1,
+                "objects": N,
+                "total_events": ev,
+                "wall_s": wall,
+                "failed_replications": failed,
+                "reference_single_core_events_per_sec": 32e6,
+            },
+        )
+        return
+
+    spec, _ = mm1.build(record=False)
+
+    def init_one(rep, n):
+        return cl.init_sim(spec, 2026, rep, mm1.params(n))
+
+    ev, failed, wall = _time_vmapped(
+        spec, init_one, 1, jnp.int32(1), jnp.int32(N)
+    )
+    rate = ev / wall
+    _line(
+        "mm1_single_events_per_sec",
+        rate,
+        None,
+        {
+            "path": "xla_while",
+            "replications": 1,
+            "objects": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+            "reference_single_core_events_per_sec": 32e6,
+        },
+    )
+
+
 def bench_mmc():
     """BASELINE configs[1]: M/M/c resource-pool queue (c=3, rho~0.83)."""
     from cimba_tpu.models import mmc
@@ -518,6 +590,7 @@ def bench_awacs():
 
 CONFIGS = {
     "mm1": bench_mm1,
+    "mm1_single": bench_mm1_single,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
     "jobshop": bench_jobshop,

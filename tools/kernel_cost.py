@@ -107,6 +107,41 @@ def main():
     for shp, w in c.most_common(10):
         print(f"  {shp}: {w} el / {ops[shp]} ops  ({w * 100 // total}%)")
 
+    # Issue-slot-aware ceiling at the config's ACTUAL lane count
+    # (CIMBA_COST_LANES, default: the model's bench L): per event, an op
+    # on per-lane shape S costs max(ceil(|S| * L / 1024), 1) VPU issue
+    # slots — the element model is exact only when every op spans >= 1
+    # tile.  Prints the predicted ceiling at L and the op-bound/element-
+    # bound crossover, making claims like "11M ev/s/chip at L=128"
+    # checkable instead of asserted (VERDICT r4 weak #6).
+    bench_L = int(os.environ.get(
+        "CIMBA_COST_LANES", {"awacs": 128, "mm1": 4096}.get(name, 1024)
+    ))
+    def slots_at(L):
+        s = 0
+        for shp, k in ops.items():
+            per = 1
+            for d in shp:
+                per *= d
+            s += k * max((per * L + 1023) // 1024, 1)
+        return s
+    clock_hz = 940e6  # v5e VPU issue rate
+    ceil_at_L = clock_hz * bench_L / max(slots_at(bench_L), 1)
+    pure_el = 962e9 / max(total, 1)
+    print(
+        f"  issue-slot ceiling at L={bench_L}: "
+        f"{ceil_at_L / 1e6:.1f}M events/s/chip "
+        f"({100.0 * ceil_at_L / pure_el:.0f}% of the pure element model)"
+    )
+    lo, hi = 1, 1 << 20
+    while lo < hi:  # smallest L where slots are within 25% of elements
+        mid = (lo + hi) // 2
+        if slots_at(mid) * 1024 <= 1.25 * total * mid:
+            hi = mid
+        else:
+            lo = mid + 1
+    print(f"  element model honest (<=25% slack) from L~{lo}")
+
     # Audit rules (BENCH_NOTES round 3/4): shapes this metric UNDERWEIGHTS.
     # (a) any [P, K] 2-D term (P = process count) is the waiter-scan shape
     #     class — e.g. the wait_event [P, CAP] one-hot validation — a
