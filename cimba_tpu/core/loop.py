@@ -1503,6 +1503,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         now, wait for the remainder; an aborted wait keeps the partial
         amount and the continuation reads it via api.got.
 
+        ``getting`` is a TRACED scalar (cmd.tag == C_BUF_GET): both
+        dispatch slots alias one handler, so the impl traces once and
+        the verbs differ in a few scalar selects (signal order is
+        other-then-my for both, so no wake-seq hazard).
+
         Signals: opposite guard on any progress (the transfer freed space /
         added content for the other side); SAME-side guard only on
         completion — a partial grab leaves this side drained/full, so a
@@ -1511,13 +1516,14 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         b = cmd.i
         rem = cmd.f
         total = jnp.where(is_retry, dyn.dget(sim.procs.pend_f2, p), cmd.f)
-        room = dyn.dget(sim.buffers.level, b) if getting else b_cap[b] - dyn.dget(sim.buffers.level, b)
+        level = dyn.dget(sim.buffers.level, b)
+        room = jnp.where(getting, level, b_cap[b] - level)
         moved = jnp.clip(rem, 0.0, room)
-        level2 = dyn.dget(sim.buffers.level, b) + jnp.where(getting, -moved, moved)
+        level2 = level + jnp.where(getting, -moved, moved)
         rem2 = rem - moved
         done = rem2 <= 0.0
-        my_guard = b_front[b] if getting else b_rear[b]
-        other_guard = b_rear[b] if getting else b_front[b]
+        my_guard = jnp.where(getting, b_front[b], b_rear[b])
+        other_guard = jnp.where(getting, b_rear[b], b_front[b])
         sim = sim._replace(
             buffers=Buffers(
                 level=dyn.dset(sim.buffers.level, b, level2, gate),
@@ -1542,15 +1548,10 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         return sim, ~done
 
     @_gated
-    def h_buffer_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+    def h_buffer(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         return _buffer_xfer_impl(
-            sim, p, cmd, is_retry, getting=True, gate=gate
-        )
-
-    @_gated
-    def h_buffer_put(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
-        return _buffer_xfer_impl(
-            sim, p, cmd, is_retry, getting=False, gate=gate
+            sim, p, cmd, is_retry, getting=cmd.tag == pr.C_BUF_GET,
+            gate=gate,
         )
 
     @_gated
@@ -1699,8 +1700,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         component_gate(has_r, h_preempt),                  # C_PREEMPT
         component_gate(bool(spec.pools), h_pool_acquire),  # C_POOL_ACQ
         component_gate(bool(spec.pools), h_pool_release),  # C_POOL_REL
-        component_gate(bool(spec.buffers), h_buffer_get),  # C_BUF_GET
-        component_gate(bool(spec.buffers), h_buffer_put),  # C_BUF_PUT
+        component_gate(bool(spec.buffers), h_buffer),      # C_BUF_GET
+        component_gate(bool(spec.buffers), h_buffer),      # C_BUF_PUT
         component_gate(bool(spec.pqueues), h_pq_put),      # C_PQ_PUT
         component_gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET
         component_gate(bool(spec.conditions), h_cond_wait),  # C_COND_WAIT
