@@ -50,6 +50,7 @@ PHASE_TIMEOUTS = {
     "sweep": 2400,
     "bench_mm1": 3600,
     "bench_awacs": 2400,
+    "bench_mm1_single": 1800,
 }
 
 
@@ -161,6 +162,11 @@ def main():
         results["bench_awacs"] = run_phase(
             "bench_awacs",
             [sys.executable, "bench.py", "--config", "awacs"],
+            env_extra={"CIMBA_BENCH_KERNEL": "1"},
+        )
+        results["bench_mm1_single"] = run_phase(
+            "bench_mm1_single",
+            [sys.executable, "bench.py", "--config", "mm1_single"],
             env_extra={"CIMBA_BENCH_KERNEL": "1"},
         )
         append_notes(results)
