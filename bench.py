@@ -146,6 +146,19 @@ def _line(metric, rate, vs_baseline, detail):
         "vs_baseline": vs_baseline,
         "detail": detail,
     }
+    if detail.get("backend") == "cpu" and metric.startswith("mm1_events"):
+        # degraded mode (wedged tunnel): a CPU rate must never read as
+        # the accelerator story — carry the last HARDWARE measurement
+        # on record for context (BENCH_NOTES.md round 2; the kernel
+        # path has no hardware number yet — tools/first_contact.py is
+        # the one-command capture for the next tunnel window)
+        line["last_measured_tpu"] = {
+            "events_per_sec": 174_300,
+            "path": "xla_while",
+            "round": 2,
+            "note": "v5e 1 chip, R=4096; pre-kernel engine — see "
+                    "BENCH_NOTES.md",
+        }
     # Headline honesty: masked lane failures are an estimator-bias
     # signal, not a detail — surface them at the top level (0 on every
     # healthy run; the fixed-capacity trade is documented in
