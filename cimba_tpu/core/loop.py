@@ -1154,7 +1154,7 @@ def _may_wait_procs(spec: ModelSpec, sim: Sim) -> bool:
 _PENDING_TAGS = frozenset({
     pr.C_PUT, pr.C_GET, pr.C_ACQUIRE, pr.C_PREEMPT, pr.C_POOL_ACQ,
     pr.C_POOL_PRE, pr.C_BUF_GET, pr.C_BUF_PUT, pr.C_PQ_PUT, pr.C_PQ_GET,
-    pr.C_COND_WAIT,
+    pr.C_COND_WAIT, pr.C_PUT_HOLD, pr.C_GET_HOLD,
 })
 
 
@@ -1224,7 +1224,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         select — each saved select is a full pass over the ring).
         """
         qid = cmd.i
-        is_put = cmd.tag == pr.C_PUT
+        is_put = (cmd.tag == pr.C_PUT) | (cmd.tag == pr.C_PUT_HOLD)
+        # fused verbs: on success the process holds cmd.f2 instead of
+        # continuing inline — the whole queue cycle in ONE chain
+        # iteration (process.put_hold/get_hold)
+        fused = (cmd.tag == pr.C_PUT_HOLD) | (cmd.tag == pr.C_GET_HOLD)
         size = dyn.dget(sim.queues.size, qid)
         head = dyn.dget(sim.queues.head, qid)
         cap = q_cap[qid]
@@ -1264,13 +1268,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # side can newly be satisfiable
         sim = _guard_signal(sim, q_rear[qid], pred=ok_get, spec=spec)
         sim = _guard_signal(sim, q_front[qid], pred=ok, spec=spec)
+        # fused success: hold cmd.f2 (h_hold semantics), waking at
+        # next_pc — the signal seqs above come first, as they would if
+        # the hold were issued by a continuation block
+        sim = _schedule_wake(
+            sim, _and(fused, ok), p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f2, 0.0),
+        )
         # both outcomes continue at next_pc (the blocked path's signals
         # deliver there), so the pc write is gated only by the branch
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, own_gid, cmd, is_retry, pred=_and(blocked, gate)
         )
-        return sim, blocked
+        return sim, blocked | fused
 
     def _grab_resource(sim, p, rid, pred=True):
         r2 = Resources(
@@ -1708,6 +1719,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         h_wait_proc,                             # C_WAIT_PROC
         component_gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE
         h_wait_evt,                              # C_WAIT_EVT
+        component_gate(has_q, h_queue),                    # C_PUT_HOLD
+        component_gate(has_q, h_queue),                    # C_GET_HOLD
     ]
 
     if used_tags is None:

@@ -65,7 +65,14 @@ C_COND_WAIT = 14 # wait on condition i until signaled & predicate true
 C_WAIT_PROC = 15 # wait for process i to finish
 C_POOL_PRE = 16  # greedy pool acquire that may mug lower-priority holders
 C_WAIT_EVT = 17  # wait for event handle i to be dispatched
-N_COMMANDS = 18
+# Fused verbs (TPU-first redesign, no reference counterpart needed —
+# the reference's straight-line C makes a between-yield continuation
+# free, while the masked kernel pays a FULL body pass per chain
+# iteration; fusing the ubiquitous "<queue verb>; hold(t)" pair into
+# one command makes the hot cycle ONE iteration per event):
+C_PUT_HOLD = 18  # put f into queue i, then hold f2       (f=item, f2=dur)
+C_GET_HOLD = 19  # get from queue i, then hold f2         (f2=dur)
+N_COMMANDS = 20
 
 
 class Command(NamedTuple):
@@ -123,6 +130,24 @@ def get(queue, next_pc) -> Command:
     """Blocking get (parity: cmb_objectqueue_get); the item lands in the
     process's result register (api.got)."""
     return _cmd(C_GET, i=queue, next_pc=next_pc)
+
+
+def put_hold(queue, item, duration, next_pc) -> Command:
+    """Fused ``put; hold(duration)``: attempt the put now; once it
+    succeeds (immediately or after pending on the rear guard), hold for
+    ``duration`` and wake at ``next_pc``.  Semantically identical to
+    ``cmd.put`` followed by a block returning ``cmd.hold`` — but ONE
+    chain iteration instead of two, which is the whole per-event cost
+    on the kernel path (docs/07).  Draw ``duration`` before yielding."""
+    return _cmd(C_PUT_HOLD, f=item, f2=duration, i=queue, next_pc=next_pc)
+
+
+def get_hold(queue, duration, next_pc) -> Command:
+    """Fused ``get; hold(duration)``: once the get succeeds the item is
+    in api.got and the process holds ``duration`` before waking at
+    ``next_pc`` — the M/M/1 service cycle in one chain iteration (see
+    :func:`put_hold`)."""
+    return _cmd(C_GET_HOLD, f2=duration, i=queue, next_pc=next_pc)
 
 
 def acquire(resource, next_pc) -> Command:

@@ -71,42 +71,62 @@ def build(
             "wait": sm.empty(),
         }
 
+    # Fused-verb cycles (cmd.put_hold / cmd.get_hold): every chain
+    # iteration on the kernel path costs a FULL masked body pass, so the
+    # classic two-iteration cycle ("put succeeds inline, then the
+    # continuation block holds" — the reference's free straight-line C
+    # between yields, `benchmark/MM1_multi.c:52-90`) pays double.  The
+    # fused commands issue the queue verb and the next hold as ONE
+    # yield: one iteration, one body pass per event.  Durations are
+    # pre-drawn at the previous wake — distributionally identical
+    # (independent exponentials), order pinned by the goldens.
+
     @m.block
-    def a_hold(sim, p, sig):
+    def a_start(sim, p, sig):
+        # reference arrival pattern: hold exp(1/lambda) before the
+        # first put (`benchmark/MM1_multi.c:56-60`)
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.hold(t, next_pc=a_cycle.pc)
+
+    @m.block
+    def a_cycle(sim, p, sig):
+        # at each arrival instant: put the timestamp and hold the next
+        # pre-drawn inter-arrival — the last put continues inline to
+        # the exit instead
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
         produced = api.local_i(sim, p, L_PRODUCED)
         finished = produced >= sim.user["n_objects"]
         sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        now = api.clock(sim)
         return sim, cmd.select(
-            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+            finished,
+            cmd.put(q.id, now, next_pc=a_exit.pc),
+            cmd.put_hold(q.id, now, t, next_pc=a_cycle.pc),
         )
 
     @m.block
-    def a_put(sim, p, sig):
-        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
-        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+    def a_exit(sim, p, sig):
+        return sim, cmd.exit_()
 
     @m.block
-    def s_get(sim, p, sig):
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
-
-    @m.block
-    def s_hold(sim, p, sig):
+    def s_start(sim, p, sig):
         sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
-        return sim, cmd.hold(t, next_pc=s_record.pc)
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
     @m.block
-    def s_record(sim, p, sig):
+    def s_cycle(sim, p, sig):
+        # at each service completion: record the finished item's
+        # sojourn (got = its arrival timestamp), then get the next item
+        # with a pre-drawn service time — one command per event
         t_sys = api.clock(sim) - api.got(sim, p)
         wait = sm.add(sim.user["wait"], t_sys)
         sim = api.set_user(sim, {**sim.user, "wait": wait})
         sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
-        # return the next blocking command directly (not cmd.jump(s_get)):
-        # a jump tail costs one extra full chain iteration per service in
-        # the kernel, where every iteration re-executes the masked body
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+        sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
-    m.process("arrival", entry=a_hold, prio=0)
-    m.process("service", entry=s_get, prio=0)
+    m.process("arrival", entry=a_start, prio=0)
+    m.process("service", entry=s_start, prio=0)
     return m.build(), {"queue": q}
 
 

@@ -42,8 +42,10 @@ def run_dryrun(n_devices: int) -> None:
     if n_devices == 8:
         # golden pooled mean for the canonical driver configuration
         # (f64 path, seed=1, 256 reps x 50 objects): device placement
-        # must not leak into pooled statistics
-        golden = 4.342174158607185
+        # must not leak into pooled statistics.  Regenerated round 5
+        # with the fused-verb mm1 cycle (stream order shifted — see
+        # tests/test_golden.py).
+        golden = 4.112945867223963
         assert abs(mean - golden) <= 1e-9 * golden, (mean, golden)
 
     # the Pallas kernel path over the same mesh (interpret mode on the

