@@ -39,7 +39,9 @@ def build(queue_cap: int = 512):
     lognormal-service) tail puts P(len >= 128) near 1e-3 per event —
     a 128 ring would routinely overflow the heavy cells.  Callers
     running only light cells can pass a smaller cap."""
-    m = Model("mg1", n_ilocals=1, event_cap=8, guard_cap=4)
+    # event_cap=1: no timers/user events — the dense wake table carries
+    # holds and guard wakes (see models/mm1.py)
+    m = Model("mg1", n_ilocals=1, event_cap=1, guard_cap=4)
     q = m.objectqueue("buffer", capacity=queue_cap)
 
     @m.user_state
@@ -56,44 +58,51 @@ def build(queue_cap: int = 512):
             "wait": sm.empty(),
         }
 
+    # Fused-verb cycles: one chain iteration per event on the kernel
+    # path (see models/mm1.py — same redesign, lognormal service)
+
     @m.block
-    def a_hold(sim, p, sig):
+    def a_start(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.hold(t, next_pc=a_cycle.pc)
+
+    @m.block
+    def a_cycle(sim, p, sig):
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
         produced = api.local_i(sim, p, L_PRODUCED)
         finished = produced >= sim.user["n_objects"]
         sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        now = api.clock(sim)
         return sim, cmd.select(
-            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+            finished,
+            cmd.put(q.id, now, next_pc=a_exit.pc),
+            cmd.put_hold(q.id, now, t, next_pc=a_cycle.pc),
         )
 
     @m.block
-    def a_put(sim, p, sig):
-        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
-        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+    def a_exit(sim, p, sig):
+        return sim, cmd.exit_()
 
     @m.block
-    def s_get(sim, p, sig):
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
-
-    @m.block
-    def s_hold(sim, p, sig):
+    def s_start(sim, p, sig):
         sim, t = api.draw(
             sim, cr.lognormal, sim.user["ln_mu"], sim.user["ln_sigma"]
         )
-        return sim, cmd.hold(t, next_pc=s_record.pc)
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
     @m.block
-    def s_record(sim, p, sig):
+    def s_cycle(sim, p, sig):
         t_sys = api.clock(sim) - api.got(sim, p)
         wait = sm.add(sim.user["wait"], t_sys)
         sim = api.set_user(sim, {**sim.user, "wait": wait})
         sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
-        # return the next blocking command directly (not cmd.jump(s_get)):
-        # a jump tail costs one extra full chain iteration per service in
-        # the kernel, where every iteration re-executes the masked body
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+        sim, t = api.draw(
+            sim, cr.lognormal, sim.user["ln_mu"], sim.user["ln_sigma"]
+        )
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
-    m.process("arrival", entry=a_hold)
-    m.process("service", entry=s_get)
+    m.process("arrival", entry=a_start)
+    m.process("service", entry=s_start)
     return m.build(), {"queue": q}
 
 
