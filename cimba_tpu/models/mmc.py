@@ -54,42 +54,49 @@ def build(c: int, queue_cap: int = 128):
             "wait": sm.empty(),
         }
 
+    # Fused-verb cycles: one chain iteration per event on the kernel
+    # path (see models/mm1.py — same redesign; c servers share the
+    # queue, each pending get_hold carries its own pre-drawn service
+    # time through the wait)
+
     @m.block
-    def a_hold(sim, p, sig):
+    def a_start(sim, p, sig):
+        sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.hold(t, next_pc=a_cycle.pc)
+
+    @m.block
+    def a_cycle(sim, p, sig):
+        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
         produced = api.local_i(sim, p, L_PRODUCED)
         finished = produced >= sim.user["n_objects"]
         sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        now = api.clock(sim)
         return sim, cmd.select(
-            finished, cmd.exit_(), cmd.hold(t, next_pc=a_put.pc)
+            finished,
+            cmd.put(q.id, now, next_pc=a_exit.pc),
+            cmd.put_hold(q.id, now, t, next_pc=a_cycle.pc),
         )
 
     @m.block
-    def a_put(sim, p, sig):
-        sim = api.add_local_i(sim, p, L_PRODUCED, 1)
-        return sim, cmd.put(q.id, api.clock(sim), next_pc=a_hold.pc)
+    def a_exit(sim, p, sig):
+        return sim, cmd.exit_()
 
     @m.block
-    def s_get(sim, p, sig):
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
-
-    @m.block
-    def s_hold(sim, p, sig):
+    def s_start(sim, p, sig):
         sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
-        return sim, cmd.hold(t, next_pc=s_record.pc)
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
     @m.block
-    def s_record(sim, p, sig):
+    def s_cycle(sim, p, sig):
         t_sys = api.clock(sim) - api.got(sim, p)
         wait = sm.add(sim.user["wait"], t_sys)
         sim = api.set_user(sim, {**sim.user, "wait": wait})
         sim = api.stop(sim, wait.n >= sim.user["n_objects"].astype(_R))
-        # return the next blocking command directly (not cmd.jump(s_get)):
-        # a jump tail costs one extra full chain iteration per service in
-        # the kernel, where every iteration re-executes the masked body
-        return sim, cmd.get(q.id, next_pc=s_hold.pc)
+        sim, t = api.draw(sim, cr.exponential, sim.user["srv_mean"])
+        return sim, cmd.get_hold(q.id, t, next_pc=s_cycle.pc)
 
-    m.process("arrival", entry=a_hold, prio=0)
-    m.process("server", entry=s_get, prio=0, count=c)
+    m.process("arrival", entry=a_start, prio=0)
+    m.process("server", entry=s_start, prio=0, count=c)
     return m.build(), {"queue": q}
 
 

@@ -116,9 +116,11 @@ struct Ev {
   double t;
   int32_t prio;
   int32_t seq;
-  int32_t target;  // 0 arrival-start/hold-wake, 1 arrival-put, 2 service
-                   // retry/start, 3 service-done
+  int32_t target;  // 0 a_start, 1 a_cycle, 2 s_start, 3 service-done,
+                   // 4 woken guard retry
   double payload;
+  double payload2;  // retry events: the pre-drawn service duration the
+                    // pended get_hold carries (engine: pend_f2)
 };
 
 struct EvOrder {
@@ -135,19 +137,25 @@ struct MM1Result {
   uint64_t events;
 };
 
+// Scalar M/M/1 oracle mirroring the FUSED-verb flagship cycle
+// (models/mm1.py round 5: cmd.put_hold / cmd.get_hold — durations
+// pre-drawn one wake earlier; a pended get_hold carries its drawn
+// service time through the wait, engine field pend_f2).
 MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
                   double arr_mean, double srv_mean) {
   Stream rng = Stream::init(seed, rep);
   std::priority_queue<Ev, std::vector<Ev>, EvOrder> heap;
   int32_t seq = 0;
-  auto sched = [&](double t, int32_t target, double payload) {
-    heap.push(Ev{t, 0, seq++, target, payload});
+  auto sched = [&](double t, int32_t target, double payload,
+                   double payload2 = 0.0) {
+    heap.push(Ev{t, 0, seq++, target, payload, payload2});
   };
 
   double clock = 0.0;
   uint64_t produced = 0, events = 0;
   std::queue<double> fifo;
   bool service_waiting = false;
+  double pending_srv_t = 0.0;  // the pended get_hold's drawn duration
 
   // streaming summary (same Pebay singleton-merge as stats/summary.py)
   double sn = 0, smean = 0, sm2 = 0, smin = HUGE_VAL, smax = -HUGE_VAL;
@@ -160,20 +168,17 @@ MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
     if (x > smax) smax = x;
   };
 
-  auto arrival_chain = [&]() {
-    const double t = rng.exponential(arr_mean);  // drawn even on exit pass
-    if (produced >= n_objects) return;           // arrival exits
-    sched(clock + t, 1, 0.0);
-  };
-  auto service_try = [&]() {
+  // get_hold apply: take an item (service-done at +t_srv) or pend
+  // carrying the pre-drawn duration
+  auto service_try = [&](double t_srv) {
     if (fifo.empty()) {
       service_waiting = true;
+      pending_srv_t = t_srv;
       return;
     }
     const double item = fifo.front();
     fifo.pop();
-    const double t = rng.exponential(srv_mean);
-    sched(clock + t, 3, item);
+    sched(clock + t_srv, 3, item);
   };
 
   sched(0.0, 0, 0.0);  // arrival start
@@ -186,27 +191,33 @@ MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
     clock = ev.t;
     ++events;
     switch (ev.target) {
-      case 0:
-        arrival_chain();
+      case 0:  // a_start: hold exp before the first put
+        sched(clock + rng.exponential(arr_mean), 1, 0.0);
         break;
-      case 1:
+      case 1: {  // a_cycle: count, pre-draw, put (signal first), hold
         ++produced;
+        const bool finished = produced >= n_objects;
+        const double t_next = rng.exponential(arr_mean);
         fifo.push(clock);
-        if (service_waiting) {
+        if (service_waiting) {  // guard-retry wake seq precedes the hold's
           service_waiting = false;
-          sched(clock, 2, 0.0);  // guard signal -> retry event
+          sched(clock, 4, 0.0, pending_srv_t);
         }
-        arrival_chain();  // put never blocks at these capacities
+        if (!finished) sched(clock + t_next, 1, 0.0);
         break;
-      case 2:
-        service_try();
+      }
+      case 2:  // s_start: pre-draw, then get_hold
+        service_try(rng.exponential(srv_mean));
+        break;
+      case 4:  // woken retry re-applies get_hold with its kept duration
+        service_try(ev.payload2);
         break;
       case 3:
         record(clock - ev.payload);
         if (static_cast<uint64_t>(sn) >= n_objects) {
           done = true;
         } else {
-          service_try();
+          service_try(rng.exponential(srv_mean));
         }
         break;
     }
@@ -225,19 +236,24 @@ MM1Result run_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
   Stream rng = Stream::init(seed, rep);
   std::priority_queue<Ev, std::vector<Ev>, EvOrder> heap;
   int32_t seq = 0;
-  // targets: 0 arrival start/hold-wake, 1 arrival put, 2 server fresh
-  // get (start or post-service), 3 service done, 4 woken guard retry
-  // (payload = kept guard seq; re-enqueue keeps FIFO position)
-  auto sched = [&](double t, int32_t target, double payload) {
-    heap.push(Ev{t, 0, seq++, target, payload});
+  // Fused-verb protocol (models/mmc.py round 5): every server's
+  // get_hold pre-draws its service time; a pended get_hold carries it
+  // (engine pend_f2).  targets: 0 a_start, 1 a_cycle, 2 server start,
+  // 3 service done, 4 woken guard retry (payload = kept guard seq,
+  // payload2 = the carried service duration)
+  auto sched = [&](double t, int32_t target, double payload,
+                   double payload2 = 0.0) {
+    heap.push(Ev{t, 0, seq++, target, payload, payload2});
   };
 
   double clock = 0.0;
   uint64_t produced = 0, events = 0;
   std::queue<double> fifo;
-  // waiting servers: min-heap of guard seqs (priorities all equal, so the
-  // engine's (prio DESC, seq ASC) best-waiter pick reduces to min seq)
-  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>>
+  // waiting servers: min-heap of (guard seq, carried duration) — all
+  // priorities equal, so the engine's (prio DESC, seq ASC) best-waiter
+  // pick reduces to min seq
+  using Waiter = std::pair<int32_t, double>;
+  std::priority_queue<Waiter, std::vector<Waiter>, std::greater<Waiter>>
       guard;
   int32_t gseq = 0;
 
@@ -251,43 +267,38 @@ MM1Result run_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
     if (x > smax) smax = x;
   };
 
-  auto arrival_chain = [&]() {
-    const double t = rng.exponential(arr_mean);  // drawn even on exit pass
-    if (produced >= n_objects) return;
-    sched(clock + t, 1, 0.0);
-  };
   auto signal_front = [&]() {
     if (!guard.empty()) {
-      const int32_t woken = guard.top();
+      const Waiter woken = guard.top();
       guard.pop();
-      sched(clock, 4, static_cast<double>(woken));
+      sched(clock, 4, static_cast<double>(woken.first), woken.second);
     }
   };
-  // successful get: take the item, cascade-signal the next waiter
-  // (engine h_get signals unconditionally — an empty-handed wake retries
-  // and re-enqueues with its kept seq), then the chain draws the service
-  // time; signal seq precedes the done-event seq, draw happens after.
-  auto service_take = [&]() {
+  // successful get_hold: take the item, cascade-signal the next waiter
+  // (engine h_queue signals unconditionally — an empty-handed wake
+  // retries and re-enqueues with its kept seq), THEN schedule the fused
+  // hold's own wake: signal seq precedes the done-event seq, exactly
+  // the engine's _guard_signal-before-_schedule_wake order.
+  auto service_take = [&](double t_srv) {
     const double item = fifo.front();
     fifo.pop();
     signal_front();
-    const double t = rng.exponential(srv_mean);
-    sched(clock + t, 3, item);
+    sched(clock + t_srv, 3, item);
   };
-  // fresh get: no-jump-ahead fairness — with waiters ahead, queue behind
-  // them even if items are available (engine h_get's `may` predicate)
-  auto service_fresh = [&]() {
+  // fresh get_hold: no-jump-ahead fairness — with waiters ahead, queue
+  // behind them even if items are available (engine's `may` predicate)
+  auto service_fresh = [&](double t_srv) {
     if (fifo.empty() || !guard.empty()) {
-      guard.push(gseq++);
+      guard.push({gseq++, t_srv});
     } else {
-      service_take();
+      service_take(t_srv);
     }
   };
-  auto service_retry = [&](int32_t kept_seq) {
+  auto service_retry = [&](int32_t kept_seq, double t_srv) {
     if (fifo.empty()) {
-      guard.push(kept_seq);  // keeps its FIFO position
+      guard.push({kept_seq, t_srv});  // keeps its FIFO position
     } else {
-      service_take();
+      service_take(t_srv);
     }
   };
 
@@ -301,30 +312,31 @@ MM1Result run_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
     clock = ev.t;
     ++events;
     switch (ev.target) {
-      case 0:
-        arrival_chain();
+      case 0:  // a_start: hold exp before the first put
+        sched(clock + rng.exponential(arr_mean), 1, 0.0);
         break;
-      case 1:
+      case 1: {  // a_cycle: count, pre-draw, put (signal first), hold
         ++produced;
+        const bool finished = produced >= n_objects;
+        const double t_next = rng.exponential(arr_mean);
         fifo.push(clock);
-        // wake scheduled before the putter's chain continues (engine
-        // order: _guard_signal inside h_put, then the a_hold draw)
         signal_front();
-        arrival_chain();
+        if (!finished) sched(clock + t_next, 1, 0.0);
         break;
-      case 2:
-        service_fresh();
+      }
+      case 2:  // server start: pre-draw, then get_hold
+        service_fresh(rng.exponential(srv_mean));
         break;
       case 3:
         record(clock - ev.payload);
         if (static_cast<uint64_t>(sn) >= n_objects) {
           done = true;
         } else {
-          service_fresh();
+          service_fresh(rng.exponential(srv_mean));
         }
         break;
       case 4:
-        service_retry(static_cast<int32_t>(ev.payload));
+        service_retry(static_cast<int32_t>(ev.payload), ev.payload2);
         break;
     }
   }

@@ -30,8 +30,9 @@ GOLDEN = {
          0.006382670414495806, 23.23331325167962),
     ),
     "mmc": (
+        # regenerated round 5: fused-verb cycle (see mm1 entry)
         (777, 5, mmc.params(400, 2.4, 1.0), "wait"),
-        (187.9299965705548, 1064, 2.1212906904515667, None, None, None),
+        (183.4501694416083, 1037, 1.9199510469125969, None, None, None),
     ),
     "mg1": (
         # regenerated round 5: fused-verb cycle (see mm1 entry)
