@@ -373,7 +373,7 @@ def bench_mm1_single():
 
     At R=1 the engine is op-count-bound, not element-bound (every op
     issues once regardless of width): the measured rate validates the
-    op-count half of the cost model in tools/kernel_cost.py (~815
+    op-count half of the cost model in tools/kernel_cost.py (~874
     ops/step -> ~1M steps/s/chip predicted on the kernel path).  This
     is a LATENCY config; the throughput story is the vmapped headline.
     ``CIMBA_BENCH_KERNEL=1`` rides the kernel at L=1 (AOT-verified
