@@ -41,11 +41,12 @@ def test_mm1_step_cost_budget():
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
         el, ops = _cost(spec, (1.0 / 0.9, 1.0, 200))
-    # round-5 measured: 1,766 el / 815 ops (draw-word hoist, combined
-    # put/get ring handler, event_cap=1) — ceiling ~545M events/s/chip,
-    # clear of the 469M/chip the v5e-8 north star needs
+    # round-5 measured: 1,832 el / 874 ops on the FUSED cycle (draw-word
+    # hoist, combined put/get ring handler, event_cap=1, put_hold/
+    # get_hold at ~1 chain iteration/event) — real ceiling ~525M
+    # events/s/chip, clear of the 469M/chip the v5e-8 north star needs
     assert el <= 1_900, f"mm1 step cost regressed: {el} elements/event"
-    assert ops <= 880, f"mm1 step op count regressed: {ops} ops/event"
+    assert ops <= 900, f"mm1 step op count regressed: {ops} ops/event"
 
 
 def test_awacs_step_cost_budget():
