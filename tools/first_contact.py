@@ -51,6 +51,7 @@ PHASE_TIMEOUTS = {
     "bench_mm1": 3600,
     "bench_awacs": 2400,
     "bench_mm1_single": 1800,
+    "bench_all": 3600,
 }
 
 
@@ -168,6 +169,12 @@ def main():
             "bench_mm1_single",
             [sys.executable, "bench.py", "--config", "mm1_single"],
             env_extra={"CIMBA_BENCH_KERNEL": "1"},
+        )
+        # whole battery last (XLA path for the non-kernel configs):
+        # hardware rates for mmc/mg1/jobshop too, if the window holds
+        results["bench_all"] = run_phase(
+            "bench_all",
+            [sys.executable, "bench.py", "--config", "all"],
         )
         append_notes(results)
         log(phase="done",
