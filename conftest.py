@@ -23,7 +23,9 @@ import _axon_env  # noqa: E402
 # CIMBA_ON_DEVICE=1 deliberately keeps the accelerator: the kernel
 # equivalence battery then proves Mosaic-*executed* semantics (not just
 # interpret-mode) — see tests/test_kernel_fuzz.py and tools/first_contact.py.
-if _axon_env.plugin_enabled() and not os.environ.get("CIMBA_ON_DEVICE"):
+# Compared to "1" exactly, matching the tests' own gate, so
+# CIMBA_ON_DEVICE=0 means OFF here too (not a live-TPU pytest session).
+if _axon_env.plugin_enabled() and os.environ.get("CIMBA_ON_DEVICE") != "1":
     for _fd in (1, 2):
         try:
             _orig = os.open(

@@ -85,6 +85,10 @@ def probe():
 
 def run_phase(name, argv, env_extra=None, keep_lines=40):
     """One sequence phase in a subprocess; captures output into the log."""
+    try:  # refresh lock mtime: a live multi-hour run must not look stale
+        os.utime(LOCK, None)
+    except OSError:
+        pass
     env = dict(os.environ)
     env.update(env_extra or {})
     t0 = time.time()
@@ -127,14 +131,51 @@ def append_notes(results):
 
 def main():
     attempt_mode = "--attempt" in sys.argv
-    if os.path.exists(LOCK):
-        age = time.time() - os.path.getmtime(LOCK)
-        if age < 4 * 3600:
-            # a sequence (or probe) is live — do NOT contend with it
+    # Atomic acquire (O_EXCL): two concurrent invocations must never
+    # both proceed — concurrent backend inits contend on the tunnel and
+    # wedge it under each other (BENCH_NOTES r3), the exact failure this
+    # lock exists to prevent.  Staleness sits above the worst-case
+    # legitimate sequence (~5.6h of summed phase timeouts; run_phase
+    # also refreshes the mtime so a live run never looks stale), and a
+    # stale lock is reclaimed by atomic RENAME — of two reclaimers only
+    # one rename succeeds, and nobody ever deletes a lock another
+    # process just created.
+    stale_s = 8 * 3600
+
+    def _acquire():
+        try:
+            return os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            pass
+        try:
+            age = time.time() - os.path.getmtime(LOCK)
+        except OSError:
+            age = 0.0
+        if age < stale_s:
             print(f"lock held ({age:.0f}s old); exiting", file=sys.stderr)
-            return 3
-        os.remove(LOCK)  # stale
-    with open(LOCK, "w") as f:
+            return None
+        claimed = f"{LOCK}.stale.{os.getpid()}"
+        try:
+            os.rename(LOCK, claimed)  # the one atomic winner reclaims
+        except OSError:
+            print("stale lock reclaimed by another process; exiting",
+                  file=sys.stderr)
+            return None
+        try:
+            os.remove(claimed)
+        except OSError:
+            pass
+        try:
+            return os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            print("lock re-acquired by another process; exiting",
+                  file=sys.stderr)
+            return None
+
+    fd = _acquire()
+    if fd is None:
+        return 3
+    with os.fdopen(fd, "w") as f:
         f.write(str(os.getpid()))
     try:
         backend, why, dt = probe()
@@ -181,7 +222,14 @@ def main():
             ok={k: v[0] for k, v in results.items()})
         return 0
     finally:
-        os.remove(LOCK)
+        # release only if still ours: after a (wrongly) reclaimed lock,
+        # removing blindly would delete the NEW holder's lock
+        try:
+            with open(LOCK) as f:
+                if f.read().strip() == str(os.getpid()):
+                    os.remove(LOCK)
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
