@@ -40,7 +40,7 @@ def sweep():
     log(phase="sweep_start", backend=jax.default_backend(), N=N)
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
-        for R in (128, 512, 1024, 4096):
+        for R in (128, 512, 1024, 4096, 8192):
             sims = jax.jit(
                 jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0 / 0.9, 1.0, N)))
             )(jnp.arange(R))
