@@ -149,15 +149,15 @@ def _line(metric, rate, vs_baseline, detail):
     if detail.get("backend") == "cpu" and metric.startswith("mm1_events"):
         # degraded mode (wedged tunnel): a CPU rate must never read as
         # the accelerator story — carry the last HARDWARE measurement
-        # on record for context (BENCH_NOTES.md round 2; the kernel
-        # path has no hardware number yet — tools/first_contact.py is
-        # the one-command capture for the next tunnel window)
+        # on record for context (BENCH_NOTES.md round-5 first contact:
+        # full battery measured on v5e, 2026-07-31)
         line["last_measured_tpu"] = {
-            "events_per_sec": 174_300,
+            "events_per_sec": 39_746_473,
             "path": "xla_while",
-            "round": 2,
-            "note": "v5e 1 chip, R=4096; pre-kernel engine — see "
-                    "BENCH_NOTES.md",
+            "round": 5,
+            "note": "v5e 1 chip, R=4096, 2026-07-31 first contact; "
+                    "kernel path measured 17.4M at R=8192/chunk=512 — "
+                    "see BENCH_NOTES.md round 5",
         }
     # Headline honesty: masked lane failures are an estimator-bias
     # signal, not a detail — surface them at the top level (0 on every
@@ -246,15 +246,21 @@ def bench_mm1():
     for the full scaling curve."""
     from cimba_tpu.models import mm1
 
-    R, N = _scale(*((4096, 500) if _accel() else (256, 500)))
+    # R=65536 measured 164M events/s on v5e (2026-07-31 scaling probe;
+    # 4096 -> 39.7M, 32768 -> 143M — wall grows sublinearly, still
+    # overhead-bound), ~0.42 s device time: far under the watchdog
+    R, N = _scale(*((65536, 500) if _accel() else (256, 500)))
 
     kern_env = os.environ.get("CIMBA_BENCH_KERNEL")
     if kern_env is None and _accel():
         # Auto-select (the headline must reflect the framework's best path
-        # with no env vars): try the Pallas kernel path in a SUBPROCESS —
-        # a Mosaic compile failure is a SIGABRT, not an exception, so
-        # in-process try/except cannot contain it.  On any child failure,
-        # fall back to the XLA while-loop path below and say so.
+        # with no env vars): measure the Pallas kernel path in a
+        # SUBPROCESS — a Mosaic compile failure is a SIGABRT, not an
+        # exception, so in-process try/except cannot contain it — AND the
+        # XLA while-loop path here, then report whichever is faster as
+        # the headline with the other path's rate in detail (first
+        # on-hardware contact measured the kernel SLOWER than XLA at
+        # small R; success alone must not pick it).
         global _kernel_fallback
         env = dict(os.environ)
         env["CIMBA_BENCH_KERNEL"] = "1"
@@ -285,15 +291,13 @@ def bench_mm1():
         except (json.JSONDecodeError, IndexError) as e:
             why = f"kernel child output unparsable: {e}"
         detail = (parsed or {}).get("detail", {})
-        if (
+        kernel_ok = (
             parsed
             and parsed.get("value")
             and detail.get("backend") not in (None, "cpu")
             and "backend_fallback" not in detail
-        ):
-            print(json.dumps(parsed), flush=True)
-            return
-        if parsed and not why:
+        )
+        if parsed and not kernel_ok and not why:
             # child completed but NOT on the accelerator (its own probe
             # fell back to CPU, e.g. the tunnel wedged between the
             # parent's probe and the child's) — a CPU interpret-mode rate
@@ -302,14 +306,33 @@ def bench_mm1():
                 "kernel child ran on backend="
                 f"{detail.get('backend')} not the accelerator"
             )
-        _kernel_fallback = why or "kernel child produced no result"
+        if not kernel_ok:
+            _kernel_fallback = why or "kernel child produced no result"
+        xla_rate, xla_detail = _mm1_xla(R, N)
+        if kernel_ok and parsed["value"] > xla_rate:
+            parsed["detail"]["xla_while_events_per_sec"] = xla_rate
+            print(json.dumps(parsed), flush=True)
+        else:
+            if kernel_ok:
+                xla_detail["pallas_kernel_events_per_sec"] = parsed["value"]
+            _line(
+                "mm1_events_per_sec",
+                xla_rate,
+                xla_rate / BASELINE_EVENTS_PER_SEC,
+                xla_detail,
+            )
+        return
 
     if kern_env and kern_env != "0":
         # Pallas mega-kernel path (f32 profile): whole-run stepping in
         # VMEM — the per-event kernel-dispatch + HBM cost of the XLA
-        # while-loop path disappears (core/pallas_run.py)
+        # while-loop path disappears (core/pallas_run.py).  Lanes cap at
+        # the largest Mosaic-AOT-verified width (the whole Sim lives in
+        # VMEM; the XLA path above has no such cap), so the auto-select
+        # comparison is each path at its own best operating point.
         from cimba_tpu import config as _cfg
 
+        R = min(R, int(os.environ.get("CIMBA_BENCH_KERNEL_RMAX", 8192)))
         chunk = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 512))
         mesh = _kernel_mesh()
         with _cfg.profile("f32"):
@@ -339,6 +362,20 @@ def bench_mm1():
         )
         return
 
+    rate, detail = _mm1_xla(R, N)
+    _line(
+        "mm1_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        detail,
+    )
+
+
+def _mm1_xla(R, N):
+    """Time the mm1 XLA while-loop path; (rate, detail) for the caller
+    to print (bench_mm1 compares it against the kernel child)."""
+    from cimba_tpu.models import mm1
+
     spec, _ = mm1.build(record=False)
 
     def init_one(rep, n):
@@ -347,7 +384,6 @@ def bench_mm1():
     ev, failed, wall = _time_vmapped(
         spec, init_one, R, jnp.int32(1), jnp.int32(N)
     )
-    rate = ev / wall
     detail = {
         "path": "xla_while",
         "replications": R,
@@ -358,12 +394,7 @@ def bench_mm1():
     }
     if failed:
         detail["regrow"] = _regrow_pass(spec, mm1.params(N), R)
-    _line(
-        "mm1_events_per_sec",
-        rate,
-        rate / BASELINE_EVENTS_PER_SEC,
-        detail,
-    )
+    return ev / wall, detail
 
 
 def bench_mm1_single():
@@ -443,7 +474,9 @@ def bench_mmc():
     from cimba_tpu.models import mmc
 
     c = 3
-    R, N = _scale(*((2048, 400) if _accel() else (128, 300)))
+    # R raised after the 2026-07-31 probe showed the engine still
+    # overhead-bound at 2048 lanes (mm1 scaled 4x from 4096->65536)
+    R, N = _scale(*((16384, 400) if _accel() else (128, 300)))
     spec, _ = mmc.build(c)
 
     def init_one(rep, n):
@@ -472,7 +505,9 @@ def bench_mg1():
     64-core box)."""
     from cimba_tpu.models import mg1
 
-    reps, N = _scale(*((20, 2000) if _accel() else (2, 300)))
+    # reps_per_cell raised after the 2026-07-31 probe (R = 20 cells x
+    # reps; 400 lanes left the chip overhead-bound like mm1 at 4096)
+    reps, N = _scale(*((100, 2000) if _accel() else (2, 300)))
     spec, _ = mg1.build()
     params, cells = mg1.sweep_params(N, reps_per_cell=reps)
     warm, _ = mg1.sweep_params(1, reps_per_cell=reps)
@@ -503,7 +538,8 @@ def bench_jobshop():
     (ref tut_4_2)."""
     from cimba_tpu.models import jobshop
 
-    R, N = _scale(*((2048, 150) if _accel() else (128, 80)))
+    # R raised after the 2026-07-31 probe (see bench_mmc)
+    R, N = _scale(*((16384, 150) if _accel() else (128, 80)))
     spec, _ = jobshop.build()
 
     def init_one(rep, n):
@@ -532,7 +568,9 @@ def bench_awacs():
     from cimba_tpu.models import awacs
 
     n_targets = int(os.environ.get("CIMBA_BENCH_AWACS_TARGETS", 1000))
-    R, t_end = (16, 40.0) if _accel() else (4, 10.0)
+    # R=1024 measured 7.7M events/s on v5e (2026-07-31 scaling probe;
+    # R=16 left ~14x on the table), ~1.5 s device time
+    R, t_end = (1024, 40.0) if _accel() else (4, 10.0)
     # the standard overrides: R = lanes, OBJECTS = per-lane workload (here
     # the simulated horizon, the knob that scales events per lane)
     R = int(os.environ.get("CIMBA_BENCH_R", R))
@@ -540,6 +578,10 @@ def bench_awacs():
 
     kern = os.environ.get("CIMBA_BENCH_KERNEL")
     if kern and kern != "0":
+        # kernel path: the ~90 KB/lane Sim caps VMEM residency at L=128
+        # (BENCH_NOTES round 4); the XLA path above is HBM-resident and
+        # has no such cap
+        R = min(R, int(os.environ.get("CIMBA_BENCH_KERNEL_RMAX", 128)))
         # flagship through the kernel + boundary-block path: DES events
         # step in Pallas chunks, the NN dwell scorer runs between chunks
         # as batched MXU matmuls (models/awacs.py sensor_dwell)

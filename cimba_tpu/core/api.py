@@ -350,6 +350,28 @@ def cond_signal(sim: Sim, spec, condition) -> Sim:
     return _loop.cond_signal(spec, sim, cid)
 
 
+def release(sim: Sim, spec, resource, p) -> Sim:
+    """Release a binary resource INLINE from a block — zero chain
+    iterations (release never blocks or yields, so spending a command —
+    a full masked kernel body pass — on it was pure cost; parity:
+    cmb_resource_release as the reference's plain function call).
+    ``cmd.release`` remains for block-boundary control flow."""
+    from cimba_tpu.core import loop as _loop
+
+    rid = resource.id if hasattr(resource, "id") else resource
+    return _loop.release_resource(spec, sim, p, rid)
+
+
+def pool_release(sim: Sim, spec, pool, p, amount) -> Sim:
+    """Release pool units INLINE from a block (partial release allowed;
+    parity: cmb_resourcepool_release) — see :func:`release` for why
+    this costs zero chain iterations.  ``cmd.pool_release`` remains."""
+    from cimba_tpu.core import loop as _loop
+
+    k = pool.id if hasattr(pool, "id") else pool
+    return _loop.release_pool(spec, sim, p, k, amount)
+
+
 def proc_status(sim: Sim, p):
     """CREATED/RUNNING/FINISHED (parity: cmb_process_status)."""
     return dyn.dget(sim.procs.status, p)

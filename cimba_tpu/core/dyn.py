@@ -213,6 +213,22 @@ def _iota(shape, axis: int):
     )
 
 
+def first_true32(mask):
+    """Lowest True index (i32; ``mask.shape[0]`` when none) WITHOUT an
+    arg-reduction.  ``lax.argmax`` over a mask with several True entries
+    is a tie among equal maxima: XLA resolves ties lowest-index, but
+    Mosaic's hardware arg-reduction lowering does not honor that rule —
+    first on-device contact caught the spawn free-row pick choosing a
+    different row than the XLA path, swapping two symmetric processes'
+    trajectories (kernel-vs-XLA fuzz, seed 1).  Free-slot/row/column
+    picks therefore use this explicit iota-min, whose tie semantics are
+    backend-independent by construction.  Out-of-range on an all-False
+    mask is safe at every call site: the derived one-hot is then
+    all-False and the write/read it gates is masked off."""
+    n = mask.shape[0]
+    return jnp.min(jnp.where(mask, _iota((n,), 0), jnp.asarray(n, _I32)))
+
+
 def _oh2(n0: int, n1: int, i0, i1):
     """One-hot bool mask [n0, n1] for a 2-D index (size-1 dims skip
     their compare — see :func:`_oh1`)."""

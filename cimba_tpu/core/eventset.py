@@ -104,7 +104,10 @@ def schedule(es: EventSet, t, prio, kind, subj, arg):
     """
     t = jnp.asarray(t, _T)
     free = jnp.isinf(es.time)
-    slot = _argmax32(free).astype(_I)  # first free slot
+    # first free slot — iota-min, NOT argmax: several slots are free, and
+    # Mosaic's argmax tie-break differs from XLA's lowest-index rule
+    # (dyn.first_true32); out-of-range when full is gated by ok
+    slot = dyn.first_true32(free).astype(_I)
     ok = jnp.any(free) & jnp.isfinite(t)
     # ONE shared write mask for all six field scatters (a per-field
     # dyn.dset would re-derive the iota==slot one-hot six times over —
@@ -334,8 +337,11 @@ def pattern_find(es: EventSet, kind=WILDCARD, subj=WILDCARD):
     """Handle of the soonest matching event, else NULL_HANDLE."""
     m = _match(es, kind, subj)
     t = jnp.where(m, es.time, NEVER)
-    slot = _argmin32(t).astype(_I)
-    found = jnp.isfinite(jnp.min(t))
+    t_min = jnp.min(t)
+    found = jnp.isfinite(t_min)
+    # lowest slot among equal-time matches — argmin time ties are
+    # backend-dependent under Mosaic (dyn.first_true32)
+    slot = dyn.first_true32(m & (t == t_min)).astype(_I)
     return jnp.where(
         found, _handle(slot, dyn.dget(es.gen, slot)), NULL_HANDLE
     ).astype(_I)

@@ -481,6 +481,7 @@ def _check_gated_noop(name: str, h, sim: Sim, tag: int) -> None:
         jnp.asarray(tag, _I),
         jnp.asarray(0.5, _R),
         jnp.asarray(0.25, _R),
+        jnp.asarray(0.125, _R),
         jnp.zeros((), _I),
         jnp.zeros((), _I),
     )
@@ -623,6 +624,7 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False,
         pend_tag=dyn.dset(sim.procs.pend_tag, p, cmd.tag, pred),
         pend_f=dyn.dset(sim.procs.pend_f, p, cmd.f, pred),
         pend_f2=dyn.dset(sim.procs.pend_f2, p, cmd.f2, pred),
+        pend_f3=dyn.dset(sim.procs.pend_f3, p, cmd.f3, pred),
         pend_i=dyn.dset(sim.procs.pend_i, p, cmd.i, pred),
         pend_pc=dyn.dset(sim.procs.pend_pc, p, cmd.next_pc, pred),
         pend_guard=dyn.dset(sim.procs.pend_guard, p, jnp.asarray(gid, _I), pred),
@@ -870,6 +872,7 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig, pred=True) -> Sim:
         dyn.dget(sim.procs.pend_tag, p),
         dyn.dget(sim.procs.pend_f, p),
         dyn.dget(sim.procs.pend_f2, p),
+        dyn.dget(sim.procs.pend_f3, p),
         dyn.dget(sim.procs.pend_i, p),
         dyn.dget(sim.procs.pend_pc, p),
     )
@@ -964,6 +967,67 @@ def stop_process(spec: ModelSpec, sim: Sim, target) -> Sim:
     return finish_process(spec, sim, target, pr.STOPPED, pred=alive)
 
 
+def release_resource(spec: ModelSpec, sim: Sim, p, rid, pred=True) -> Sim:
+    """Release binary resource ``rid`` held by ``p`` inline — the body of
+    the C_RELEASE handler, callable from a block (via api.release) so a
+    release costs ZERO chain iterations: it never blocks and never
+    yields, so making it a command spent a full masked kernel body pass
+    per call just to run these few writes (the reference's plain
+    function call, `src/cmb_resource.c:249-273`, had the same
+    insight — only waits go through the scheduler)."""
+    rid = jnp.asarray(rid, _I)
+    r_guard = _ConstTable([r.guard for r in spec.resources] or [0], _I)
+    r_rec = [r.record for r in spec.resources]
+    owner_ok = dyn.dget(sim.resources.holder, rid) == p
+    r2 = Resources(
+        holder=dyn.dset(sim.resources.holder, rid, -1, pred),
+        acc=_record_row_if(
+            r_rec, sim.resources.acc, rid, sim.clock, 0.0, pred
+        ),
+    )
+    sim = sim._replace(resources=r2)
+    sim = _guard_signal(sim, r_guard[rid], pred=pred, spec=spec)
+    return _set_err(sim, _and(~owner_ok, pred), ERR_BAD_RELEASE)
+
+
+def release_pool(spec: ModelSpec, sim: Sim, p, k, amount, pred=True) -> Sim:
+    """Release ``amount`` units of pool ``k`` inline (parity:
+    cmb_resourcepool_release; partial release allowed) — the body of the
+    C_POOL_REL handler, callable from a block via api.pool_release (see
+    :func:`release_resource` for why inline releases are free)."""
+    k = jnp.asarray(k, _I)
+    p_guard = _ConstTable([pl.guard for pl in spec.pools] or [0], _I)
+    p_cap = _ConstTable([pl.capacity for pl in spec.pools] or [0.0], _R)
+    p_rec = [pl.record for pl in spec.pools]
+    amount = jnp.asarray(amount, _R)
+    amt = jnp.minimum(amount, dyn.dget2(sim.pools.held, k, p))  # partial ok
+    # profile-scaled ownership tolerance: held amounts accumulate in
+    # REAL, so the release check must forgive rounding at REAL's
+    # resolution (a fixed 1e-12 is below f32 eps and would degenerate
+    # to exact compare under the kernel profile); floored at the
+    # historical 1e-12 — held carries absolute error from its past
+    # magnitude, not amount's, so the relative term alone would be
+    # tighter than the old constant on f64
+    tol = jnp.maximum(
+        64.0 * float(jnp.finfo(config.REAL_DTYPE).eps) * jnp.maximum(
+            jnp.asarray(1.0, config.REAL_DTYPE), jnp.abs(amount)
+        ),
+        jnp.asarray(1e-12, config.REAL_DTYPE),
+    )
+    owner_ok = dyn.dget2(sim.pools.held, k, p) >= amount - tol
+    in_use = p_cap[k] - (dyn.dget(sim.pools.level, k) + amt)
+    p2 = sim.pools._replace(
+        level=dyn.dadd(sim.pools.level, k, amt, pred),
+        held=dyn.dadd2(sim.pools.held, k, p, -amt, pred),
+        acc=_record_row_if(
+            p_rec, sim.pools.acc, k, sim.clock, in_use, pred
+        ),
+    )
+    sim = sim._replace(pools=p2)
+    sim = _guard_signal(sim, p_guard[k], pred=pred, spec=spec)
+    return _set_err(sim, _and(~owner_ok, pred), ERR_BAD_RELEASE)
+
+
 def spawn_process(sim: Sim, pt, at=None, prio=None):
     """Activate one row of a spawn pool (a process type declared with
     ``start=False``); returns ``(sim, pid)`` with pid == -1 when every
@@ -986,7 +1050,10 @@ def spawn_process(sim: Sim, pt, at=None, prio=None):
         | (sim.procs.status == pr.FINISHED)
     )
     found = jnp.any(free)
-    slot = _argmax32(free).astype(_I)  # lowest free pid (first True)
+    # lowest free pid — iota-min, NOT argmax: several rows tie at True
+    # and Mosaic's argmax tie-break differs from XLA's lowest-index rule
+    # (the first on-device fuzz divergence — dyn.first_true32)
+    slot = dyn.first_true32(free).astype(_I)
     p = jnp.where(found, slot, 0)
     new_prio = jnp.asarray(pt.prio if prio is None else prio, _I)
     procs = sim.procs._replace(
@@ -1158,7 +1225,10 @@ def _may_wait_procs(spec: ModelSpec, sim: Sim) -> bool:
 _PENDING_TAGS = frozenset({
     pr.C_PUT, pr.C_GET, pr.C_ACQUIRE, pr.C_PREEMPT, pr.C_POOL_ACQ,
     pr.C_POOL_PRE, pr.C_BUF_GET, pr.C_BUF_PUT, pr.C_PQ_PUT, pr.C_PQ_GET,
-    pr.C_COND_WAIT, pr.C_PUT_HOLD, pr.C_GET_HOLD,
+    pr.C_COND_WAIT, pr.C_PUT_HOLD, pr.C_GET_HOLD, pr.C_ACQ_HOLD,
+    pr.C_PRE_HOLD, pr.C_POOL_ACQ_HOLD, pr.C_POOL_PRE_HOLD,
+    pr.C_BUF_GET_HOLD, pr.C_BUF_PUT_HOLD, pr.C_PQ_PUT_HOLD,
+    pr.C_PQ_GET_HOLD,
 })
 
 
@@ -1229,7 +1299,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         """
         qid = cmd.i
         is_put = (cmd.tag == pr.C_PUT) | (cmd.tag == pr.C_PUT_HOLD)
-        # fused verbs: on success the process holds cmd.f2 instead of
+        # fused verbs: on success the process holds cmd.f3 instead of
         # continuing inline — the whole queue cycle in ONE chain
         # iteration (process.put_hold/get_hold)
         fused = (cmd.tag == pr.C_PUT_HOLD) | (cmd.tag == pr.C_GET_HOLD)
@@ -1272,12 +1342,12 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # side can newly be satisfiable
         sim = _guard_signal(sim, q_rear[qid], pred=ok_get, spec=spec)
         sim = _guard_signal(sim, q_front[qid], pred=ok, spec=spec)
-        # fused success: hold cmd.f2 (h_hold semantics), waking at
+        # fused success: hold cmd.f3 (h_hold semantics), waking at
         # next_pc — the signal seqs above come first, as they would if
         # the hold were issued by a continuation block
         sim = _schedule_wake(
             sim, _and(fused, ok), p, pr.SUCCESS,
-            t=sim.clock + jnp.maximum(cmd.f2, 0.0),
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
         )
         # both outcomes continue at next_pc (the blocked path's signals
         # deliver there), so the pc write is gated only by the branch
@@ -1299,16 +1369,21 @@ def _make_apply(spec: ModelSpec, used_tags=None):
     @_gated
     def h_acquire(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
         rid = cmd.i
+        fused = cmd.tag == pr.C_ACQ_HOLD
         free = dyn.dget(sim.resources.holder, rid) < 0
         may_grab = is_retry | gd.is_empty(sim.procs.pend_guard, r_guard[rid])
         ok = free & may_grab
 
         sim = _grab_resource(sim, p, rid, _and(ok, gate))
+        sim = _schedule_wake(
+            sim, _and(fused & ok, gate), p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, r_guard[rid], cmd, is_retry, pred=_and(~ok, gate)
         )
-        return sim, ~ok
+        return sim, ~ok | fused
 
     @_gated
     def h_preempt(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
@@ -1318,6 +1393,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         Straight-line: the three outcomes write disjoint state under
         exclusive predicates."""
         rid = cmd.i
+        fused = cmd.tag == pr.C_PRE_HOLD
         holder = dyn.dget(sim.resources.holder, rid)
         free = holder < 0
         victim = jnp.maximum(holder, 0)
@@ -1339,26 +1415,20 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             )
         )
         sim = _grab_resource(sim, p, rid, g_free)
+        sim = _schedule_wake(
+            sim, _and(fused & ~blocked, gate), p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, _and(free | can_kick, gate))
         sim = _guard_wait(
             sim, p, r_guard[rid], cmd, is_retry, pred=_and(blocked, gate)
         )
-        return sim, blocked
+        return sim, blocked | fused
 
     @_gated
     def h_release(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
-        rid = cmd.i
-        owner_ok = dyn.dget(sim.resources.holder, rid) == p
-        r2 = Resources(
-            holder=dyn.dset(sim.resources.holder, rid, -1, gate),
-            acc=_record_row_if(
-                r_rec, sim.resources.acc, rid, sim.clock, 0.0, gate
-            ),
-        )
-        sim2 = sim._replace(resources=r2)
-        sim2 = _guard_signal(sim2, r_guard[rid], pred=gate, spec=spec)
+        sim2 = release_resource(spec, sim, p, cmd.i, pred=gate)
         sim2 = set_pc(sim2, p, cmd.next_pc, gate)
-        sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
     def _pool_stamp(sim, k, q, pred=True):
@@ -1447,6 +1517,9 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             )
 
         done = rem <= 0.0
+        fused = (cmd.tag == pr.C_POOL_ACQ_HOLD) | (
+            cmd.tag == pr.C_POOL_PRE_HOLD
+        )
         in_use = p_cap[k] - dyn.dget(sim.pools.level, k)
         sim = sim._replace(
             pools=sim.pools._replace(
@@ -1460,6 +1533,12 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         # signaling from a still-blocked partial grab would ping-pong
         # wakes between starved waiters forever)
         sim = _guard_signal(sim, p_guard[k], pred=_and(done, gate), spec=spec)
+        # fused success: the pre-drawn hold (f3 rides the pend through a
+        # blocked wait), armed after the signal like h_queue
+        sim = _schedule_wake(
+            sim, _and(fused & done, gate), p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, _and(done, gate))
         sim = _guard_wait(
             sim,
@@ -1469,7 +1548,7 @@ def _make_apply(spec: ModelSpec, used_tags=None):
             is_retry,
             pred=_and(~done, gate),
         )
-        return sim, ~done
+        return sim, ~done | fused
 
     @_gated
     def h_pool_acquire(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
@@ -1481,34 +1560,8 @@ def _make_apply(spec: ModelSpec, used_tags=None):
 
     @_gated
     def h_pool_release(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
-        k = cmd.i
-        amt = jnp.minimum(cmd.f, dyn.dget2(sim.pools.held, k, p))  # partial ok
-        # profile-scaled ownership tolerance: held amounts accumulate in
-        # REAL, so the release check must forgive rounding at REAL's
-        # resolution (a fixed 1e-12 is below f32 eps and would degenerate
-        # to exact compare under the kernel profile)
-        # floored at the historical 1e-12: held carries absolute error
-        # from its past magnitude, not cmd.f's, so the relative term
-        # alone would be tighter than the old constant on f64
-        tol = jnp.maximum(
-            64.0 * float(jnp.finfo(config.REAL_DTYPE).eps) * jnp.maximum(
-                jnp.asarray(1.0, config.REAL_DTYPE), jnp.abs(cmd.f)
-            ),
-            jnp.asarray(1e-12, config.REAL_DTYPE),
-        )
-        owner_ok = dyn.dget2(sim.pools.held, k, p) >= cmd.f - tol
-        in_use = p_cap[k] - (dyn.dget(sim.pools.level, k) + amt)
-        p2 = sim.pools._replace(
-            level=dyn.dadd(sim.pools.level, k, amt, gate),
-            held=dyn.dadd2(sim.pools.held, k, p, -amt, gate),
-            acc=_record_row_if(
-                p_rec, sim.pools.acc, k, sim.clock, in_use, gate
-            ),
-        )
-        sim2 = sim._replace(pools=p2)
-        sim2 = _guard_signal(sim2, p_guard[k], pred=gate, spec=spec)
+        sim2 = release_pool(spec, sim, p, cmd.i, cmd.f, pred=gate)
         sim2 = set_pc(sim2, p, cmd.next_pc, gate)
-        sim2 = _set_err(sim2, _and(~owner_ok, gate), ERR_BAD_RELEASE)
         return sim2, jnp.asarray(False)
 
     def _buffer_xfer_impl(sim: Sim, p, cmd: pr.Command, is_retry, getting,
@@ -1555,18 +1608,27 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 got=dyn.dset(sim.procs.got, p, total, _and(done, gate))
             )
         )
+        fused = (cmd.tag == pr.C_BUF_GET_HOLD) | (
+            cmd.tag == pr.C_BUF_PUT_HOLD
+        )
+        sim = _schedule_wake(
+            sim, _and(fused & done, gate), p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, my_guard, cmd._replace(f=rem2, f2=total), is_retry,
             pred=_and(~done, gate),
         )
-        return sim, ~done
+        return sim, ~done | fused
 
     @_gated
     def h_buffer(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
+        getting = (cmd.tag == pr.C_BUF_GET) | (
+            cmd.tag == pr.C_BUF_GET_HOLD
+        )
         return _buffer_xfer_impl(
-            sim, p, cmd, is_retry, getting=cmd.tag == pr.C_BUF_GET,
-            gate=gate,
+            sim, p, cmd, is_retry, getting=getting, gate=gate,
         )
 
     @_gated
@@ -1576,7 +1638,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         may = is_retry | gd.is_empty(sim.procs.pend_guard, pq_rear[qid])
         full = (n_live >= pq_cap[qid]) | ~may
         ok = _and(~full, gate)
-        free_col = _argmax32(~dyn.dget(sim.pqueues.live, qid)).astype(_I)
+        # lowest free column — several columns tie at True; argmax
+        # tie-breaks are backend-dependent under Mosaic (first_true32)
+        free_col = dyn.first_true32(
+            ~dyn.dget(sim.pqueues.live, qid)
+        ).astype(_I)
         pq2 = PQueues(
             items=dyn.dset2(sim.pqueues.items, qid, free_col, cmd.f, ok),
             prio=dyn.dset2(sim.pqueues.prio, qid, free_col, cmd.f2, ok),
@@ -1594,11 +1660,16 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         sim = sim._replace(pqueues=pq2)
         # put frees no slots: only the getter side can newly proceed
         sim = _guard_signal(sim, pq_front[qid], pred=ok, spec=spec)
+        fused = cmd.tag == pr.C_PQ_PUT_HOLD
+        sim = _schedule_wake(
+            sim, fused & ok, p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, pq_rear[qid], cmd, is_retry, pred=_and(full, gate)
         )
-        return sim, full
+        return sim, full | fused
 
     @_gated
     def h_pq_get(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
@@ -1632,11 +1703,16 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         )
         sim = _guard_signal(sim, pq_rear[qid], pred=ok, spec=spec)
         sim = _guard_signal(sim, pq_front[qid], pred=ok, spec=spec)
+        fused = cmd.tag == pr.C_PQ_GET_HOLD
+        sim = _schedule_wake(
+            sim, fused & ok, p, pr.SUCCESS,
+            t=sim.clock + jnp.maximum(cmd.f3, 0.0),
+        )
         sim = set_pc(sim, p, cmd.next_pc, gate)
         sim = _guard_wait(
             sim, p, pq_front[qid], cmd, is_retry, pred=_and(empty, gate)
         )
-        return sim, empty
+        return sim, empty | fused
 
     @_gated
     def h_cond_wait(sim: Sim, p, cmd: pr.Command, is_retry, gate=True):
@@ -1725,6 +1801,14 @@ def _make_apply(spec: ModelSpec, used_tags=None):
         h_wait_evt,                              # C_WAIT_EVT
         component_gate(has_q, h_queue),                    # C_PUT_HOLD
         component_gate(has_q, h_queue),                    # C_GET_HOLD
+        component_gate(has_r, h_acquire),                  # C_ACQ_HOLD
+        component_gate(has_r, h_preempt),                  # C_PRE_HOLD
+        component_gate(bool(spec.pools), h_pool_acquire),  # C_POOL_ACQ_HOLD
+        component_gate(bool(spec.pools), h_pool_preempt),  # C_POOL_PRE_HOLD
+        component_gate(bool(spec.buffers), h_buffer),      # C_BUF_GET_HOLD
+        component_gate(bool(spec.buffers), h_buffer),      # C_BUF_PUT_HOLD
+        component_gate(bool(spec.pqueues), h_pq_put),      # C_PQ_PUT_HOLD
+        component_gate(bool(spec.pqueues), h_pq_get),      # C_PQ_GET_HOLD
     ]
 
     if used_tags is None:
@@ -1840,6 +1924,7 @@ def make_step(spec: ModelSpec):
                 dyn.dget(sim.procs.pend_tag, p),
                 dyn.dget(sim.procs.pend_f, p),
                 dyn.dget(sim.procs.pend_f2, p),
+                dyn.dget(sim.procs.pend_f3, p),
                 dyn.dget(sim.procs.pend_i, p),
                 dyn.dget(sim.procs.pend_pc, p),
             )

@@ -68,11 +68,23 @@ C_WAIT_EVT = 17  # wait for event handle i to be dispatched
 # Fused verbs (TPU-first redesign, no reference counterpart needed —
 # the reference's straight-line C makes a between-yield continuation
 # free, while the masked kernel pays a FULL body pass per chain
-# iteration; fusing the ubiquitous "<queue verb>; hold(t)" pair into
-# one command makes the hot cycle ONE iteration per event):
-C_PUT_HOLD = 18  # put f into queue i, then hold f2       (f=item, f2=dur)
-C_GET_HOLD = 19  # get from queue i, then hold f2         (f2=dur)
-N_COMMANDS = 20
+# iteration; fusing the ubiquitous "<blocking verb>; hold(t)" pair into
+# one command makes the hot cycle ONE iteration per event).  Every
+# blocking verb has a ``*_hold`` twin; the pre-drawn hold duration
+# rides the dedicated f3 payload so it survives a pend (f/f2 keep
+# their verb-specific meanings through the retry/abort protocol —
+# pool rollback holding, buffer totals, pq item priority):
+C_PUT_HOLD = 18       # put f into queue i, then hold f3   (f=item, f3=dur)
+C_GET_HOLD = 19       # get from queue i, then hold f3     (f3=dur)
+C_ACQ_HOLD = 20       # acquire resource i, then hold f3
+C_PRE_HOLD = 21       # preempt resource i, then hold f3
+C_POOL_ACQ_HOLD = 22  # acquire f units of pool i, then hold f3
+C_POOL_PRE_HOLD = 23  # preempt-acquire f units of pool i, then hold f3
+C_BUF_GET_HOLD = 24   # take f units from buffer i, then hold f3
+C_BUF_PUT_HOLD = 25   # add f units into buffer i, then hold f3
+C_PQ_PUT_HOLD = 26    # pq put, then hold f3           (f=item, f2=prio)
+C_PQ_GET_HOLD = 27    # pq get, then hold f3
+N_COMMANDS = 28
 
 
 class Command(NamedTuple):
@@ -81,6 +93,7 @@ class Command(NamedTuple):
     tag: jnp.ndarray      # i32
     f: jnp.ndarray        # f64 payload (duration, item, amount)
     f2: jnp.ndarray       # f64 second payload (item priority, ...)
+    f3: jnp.ndarray       # f64 fused hold duration (``*_hold`` verbs)
     i: jnp.ndarray        # i32 payload (queue/resource/pool id)
     next_pc: jnp.ndarray  # i32 block to continue at
 
@@ -94,15 +107,45 @@ class Command(NamedTuple):
 _tag_collector = None
 
 
-def _cmd(tag, f=0.0, f2=0.0, i=0, next_pc=0) -> Command:
+# Per-dtype cache of the scalar zero constant: every command built with a
+# defaulted payload shares ONE array object per trace-visible dtype, so
+# ``select``'s identity check (below) skips the where on fields neither
+# branch sets — e.g. f3 in a model with no fused verbs costs zero ops.
+_zero_cache: dict = {}
+
+
+def _zero(dt):
+    import jax
+
+    key = jnp.dtype(dt)
+    z = _zero_cache.get(key)
+    if z is None or z.dtype != key:
+        z = jnp.zeros((), key)
+        # cache only a CONCRETE array of the requested dtype: under an
+        # abstract trace (tag inference's eval_shape) creation ops yield
+        # tracers of that trace, and under x64-off an f64 request
+        # silently downcasts — caching either poisons later traces
+        if z.dtype == key and not isinstance(z, jax.core.Tracer):
+            _zero_cache[key] = z
+    return z
+
+
+def _pay(v, dt):
+    return _zero(dt) if isinstance(v, (int, float)) and v == 0 else (
+        jnp.asarray(v, dt)
+    )
+
+
+def _cmd(tag, f=0.0, f2=0.0, f3=0.0, i=0, next_pc=0) -> Command:
     if _tag_collector is not None:
         _tag_collector.add(int(tag))
     return Command(
         jnp.asarray(tag, _I),
-        jnp.asarray(f, _R),
-        jnp.asarray(f2, _R),
-        jnp.asarray(i, _I),
-        jnp.asarray(next_pc, _I),
+        _pay(f, _R),
+        _pay(f2, _R),
+        _pay(f3, _R),
+        _pay(i, _I),
+        _pay(next_pc, _I),
     )
 
 
@@ -139,7 +182,7 @@ def put_hold(queue, item, duration, next_pc) -> Command:
     ``cmd.put`` followed by a block returning ``cmd.hold`` — but ONE
     chain iteration instead of two, which is the whole per-event cost
     on the kernel path (docs/07).  Draw ``duration`` before yielding."""
-    return _cmd(C_PUT_HOLD, f=item, f2=duration, i=queue, next_pc=next_pc)
+    return _cmd(C_PUT_HOLD, f=item, f3=duration, i=queue, next_pc=next_pc)
 
 
 def get_hold(queue, duration, next_pc) -> Command:
@@ -147,7 +190,64 @@ def get_hold(queue, duration, next_pc) -> Command:
     in api.got and the process holds ``duration`` before waking at
     ``next_pc`` — the M/M/1 service cycle in one chain iteration (see
     :func:`put_hold`)."""
-    return _cmd(C_GET_HOLD, f2=duration, i=queue, next_pc=next_pc)
+    return _cmd(C_GET_HOLD, f3=duration, i=queue, next_pc=next_pc)
+
+
+def acquire_hold(resource, duration, next_pc) -> Command:
+    """Fused ``acquire; hold(duration)``: once the resource is granted
+    (immediately or after waiting), hold ``duration`` and wake at
+    ``next_pc`` — the canonical seize-then-serve pair in one chain
+    iteration (see :func:`put_hold` for the cost rationale)."""
+    return _cmd(C_ACQ_HOLD, f3=duration, i=resource, next_pc=next_pc)
+
+
+def preempt_hold(resource, duration, next_pc) -> Command:
+    """Fused ``preempt; hold(duration)`` (see :func:`preempt`)."""
+    return _cmd(C_PRE_HOLD, f3=duration, i=resource, next_pc=next_pc)
+
+
+def pool_acquire_hold(pool, amount, duration, next_pc) -> Command:
+    """Fused ``pool_acquire; hold(duration)``: hold fires when the full
+    claim is granted (the greedy-partial wait protocol is unchanged —
+    pend rollback state rides f/f2, the duration rides f3)."""
+    return _cmd(
+        C_POOL_ACQ_HOLD, f=amount, f3=duration, i=pool, next_pc=next_pc
+    )
+
+
+def pool_preempt_hold(pool, amount, duration, next_pc) -> Command:
+    """Fused ``pool_preempt; hold(duration)`` (see :func:`pool_preempt`)."""
+    return _cmd(
+        C_POOL_PRE_HOLD, f=amount, f3=duration, i=pool, next_pc=next_pc
+    )
+
+
+def buffer_get_hold(buffer, amount, duration, next_pc) -> Command:
+    """Fused ``buffer_get; hold(duration)``: hold fires on completed
+    transfer (partial-fulfillment waits keep their contract)."""
+    return _cmd(
+        C_BUF_GET_HOLD, f=amount, f3=duration, i=buffer, next_pc=next_pc
+    )
+
+
+def buffer_put_hold(buffer, amount, duration, next_pc) -> Command:
+    """Fused ``buffer_put; hold(duration)`` (see :func:`buffer_get_hold`)."""
+    return _cmd(
+        C_BUF_PUT_HOLD, f=amount, f3=duration, i=buffer, next_pc=next_pc
+    )
+
+
+def pq_put_hold(pqueue, item, prio, duration, next_pc) -> Command:
+    """Fused ``pq_put; hold(duration)`` (item priority stays on f2)."""
+    return _cmd(
+        C_PQ_PUT_HOLD, f=item, f2=prio, f3=duration, i=pqueue,
+        next_pc=next_pc,
+    )
+
+
+def pq_get_hold(pqueue, duration, next_pc) -> Command:
+    """Fused ``pq_get; hold(duration)``: the item lands in api.got."""
+    return _cmd(C_PQ_GET_HOLD, f3=duration, i=pqueue, next_pc=next_pc)
 
 
 def acquire(resource, next_pc) -> Command:
@@ -234,8 +334,12 @@ def wait_event(handle, next_pc) -> Command:
 
 
 def select(pred, a: Command, b: Command) -> Command:
-    """Branch-free choice between two commands (pred ? a : b)."""
-    return Command(*[jnp.where(pred, x, y) for x, y in zip(a, b)])
+    """Branch-free choice between two commands (pred ? a : b).  Fields
+    carried as the SAME object on both sides (shared zero constants from
+    ``_cmd``, or a common payload tracer) skip their select entirely."""
+    return Command(
+        *[x if x is y else jnp.where(pred, x, y) for x, y in zip(a, b)]
+    )
 
 
 # no pending command sentinel
@@ -251,6 +355,7 @@ class Procs(NamedTuple):
     pend_tag: jnp.ndarray  # i32 blocked command tag, NO_PEND if none
     pend_f: jnp.ndarray    # f64
     pend_f2: jnp.ndarray   # f64
+    pend_f3: jnp.ndarray   # f64 fused hold duration riding the pend
     pend_i: jnp.ndarray    # i32
     pend_pc: jnp.ndarray   # i32
     pend_guard: jnp.ndarray  # i32 guard the process waits on, -1 if none
@@ -273,6 +378,7 @@ def create(entry_pcs, prios, n_flocals: int, n_ilocals: int) -> Procs:
         pend_tag=jnp.full((p,), NO_PEND, _I),
         pend_f=jnp.zeros((p,), _R),
         pend_f2=jnp.zeros((p,), _R),
+        pend_f3=jnp.zeros((p,), _R),
         pend_i=jnp.zeros((p,), _I),
         pend_pc=jnp.zeros((p,), _I),
         pend_guard=jnp.full((p,), -1, _I),

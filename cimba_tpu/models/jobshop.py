@@ -10,8 +10,20 @@ benchmark config (BASELINE.json configs[3], tut_4_2 pattern).  Structure:
 * ``wip``: a cmb_buffer-style fungible store between the stages.
 * ``crew``: a cmb_resourcepool shared by both stages (contention).
 * maintenance waits on a condition "WIP backlog >= threshold" and then
-  briefly slows stage B (acquiring extra crew) — exercising cond_wait/
-  cond_signal against moving state.
+  briefly slows stage B (acquiring extra crew) — exercising cond_wait
+  against moving state, with the condition OBSERVING the wip buffer so
+  every put re-evaluates it automatically (the
+  cmb_resourceguard_register pattern — no manual cond_signal).
+
+Fused-cycle redesign (round 5): the reference's straight-line C runs
+acquire/release/put between yields for free; the masked kernel pays a
+full body pass per chained command.  The cycles therefore ride the
+fused verb family — ``pool_acquire_hold`` issues seize+serve as ONE
+yield (service pre-drawn), ``buffer_put_hold`` fuses store+next-arrival,
+and releases are INLINE (api.pool_release: release never blocks, so it
+costs zero chain iterations).  Steady-state chain multiplicity drops
+from ~3 to ~1.3 per event; semantics (grant order, signal order, FIFO
+fairness) are the classic protocol's, pinned by tests/test_models.py.
 
 Statistics: per-stage counts, WIP level time-average, sojourn through the
 line.
@@ -40,11 +52,18 @@ def build(
 ):
     """``b_slow`` scales stage B's work relative to stage A, making B the
     bottleneck so WIP genuinely accumulates (the tut_4_2 dynamic)."""
-    m = Model("jobshop", n_ilocals=1, event_cap=16, guard_cap=8)
+    # event_cap=1: every wake here (holds, fused holds, guard retries,
+    # cond wakes) rides the dense per-pid wake table; no timers or user
+    # events means the general table serves nothing — one placeholder
+    # slot gates its scan/lexmin passes out of the step (mm1's round-5
+    # sizing argument, models/mm1.py)
+    m = Model("jobshop", n_ilocals=1, event_cap=1, guard_cap=8)
     wip = m.buffer("wip", capacity=wip_cap, initial=0.0)
     crew = m.resourcepool("crew", capacity=crew_size)
     cv = m.condition(
-        "backlog", lambda sim, p: sim.buffers.level[wip.id] >= backlog
+        "backlog",
+        lambda sim, p: sim.buffers.level[wip.id] >= backlog,
+        observes=[wip],
     )
 
     @m.user_state
@@ -59,71 +78,62 @@ def build(
         }
 
     # --- stage A: make one WIP unit per job -------------------------------
-    def _next_arrival(sim, p):
-        """(sim, command) for the arrival cycle — shared by the entry
-        block and a_sig's inlined tail so the logic has one copy."""
-        made = api.local_i(sim, p, 0)
-        finished = made >= sim.user["n_jobs"]
+    # Per job at steady state: [arrival wake] a_entry seizes crew and
+    # serves in one fused yield; [work-end wake] a_store counts, releases
+    # inline, and fuses the store with the next arrival hold.
+    @m.block
+    def a_start(sim, p, sig):
         sim, t = api.draw(sim, cr.exponential, sim.user["arr_mean"])
-        return sim, cmd.select(
-            finished, cmd.exit_(), cmd.hold(t, next_pc=a_crew.pc)
+        return sim, cmd.hold(t, next_pc=a_entry.pc)
+
+    @m.block
+    def a_entry(sim, p, sig):
+        sim, tw = api.draw(sim, cr.exponential, sim.user["work_mean"])
+        return sim, cmd.pool_acquire_hold(
+            crew.id, 1.0, tw, next_pc=a_store.pc
         )
-
-    @m.block
-    def a_arrive(sim, p, sig):
-        return _next_arrival(sim, p)
-
-    @m.block
-    def a_crew(sim, p, sig):
-        return sim, cmd.pool_acquire(crew.id, 1.0, next_pc=a_work.pc)
-
-    @m.block
-    def a_work(sim, p, sig):
-        sim, t = api.draw(sim, cr.exponential, sim.user["work_mean"])
-        return sim, cmd.hold(t, next_pc=a_store.pc)
 
     @m.block
     def a_store(sim, p, sig):
         sim = api.add_local_i(sim, p, 0, 1)
-        return sim, cmd.pool_release(crew.id, 1.0, next_pc=a_put.pc)
+        sim = api.pool_release(sim, _spec(), crew, p, 1.0)
+        # the put signals the wip guards, and cv observes them — the
+        # backlog condition re-evaluates with the unit already in store
+        # (signal-before-change would never fire; the observer fires
+        # after by construction)
+        finished = api.local_i(sim, p, 0) >= sim.user["n_jobs"]
+        sim, ta = api.draw(sim, cr.exponential, sim.user["arr_mean"])
+        return sim, cmd.select(
+            finished,
+            cmd.buffer_put(wip.id, 1.0, next_pc=a_exit.pc),
+            cmd.buffer_put_hold(wip.id, 1.0, ta, next_pc=a_entry.pc),
+        )
 
     @m.block
-    def a_put(sim, p, sig):
-        return sim, cmd.buffer_put(wip.id, 1.0, next_pc=a_sig.pc)
-
-    @m.block
-    def a_sig(sim, p, sig):
-        # the unit is now IN the store — signal the backlog condition after
-        # the state change (signal-before-change would evaluate the
-        # predicate one unit short and never fire).  The next-arrival
-        # logic is inlined rather than cmd.jump(a_arrive): same draw
-        # order (the chain ran a_arrive immediately anyway), one fewer
-        # chain iteration of the whole masked kernel body per job
-        sim = api.cond_signal(sim, _spec(), cv)
-        return _next_arrival(sim, p)
+    def a_exit(sim, p, sig):
+        return sim, cmd.exit_()
 
     # --- stage B: consume WIP ---------------------------------------------
     @m.block
     def b_take(sim, p, sig):
-        return sim, cmd.buffer_get(wip.id, 1.0, next_pc=b_crew.pc)
+        return sim, cmd.buffer_get(wip.id, 1.0, next_pc=b_svc.pc)
 
     @m.block
-    def b_crew(sim, p, sig):
-        return sim, cmd.pool_acquire(crew.id, 1.0, next_pc=b_work.pc)
+    def b_svc(sim, p, sig):
+        sim, t = api.draw(
+            sim, cr.exponential, sim.user["work_mean"] * b_slow
+        )
+        return sim, cmd.pool_acquire_hold(
+            crew.id, 1.0, t, next_pc=b_fin.pc
+        )
 
     @m.block
-    def b_work(sim, p, sig):
-        sim, t = api.draw(sim, cr.exponential, sim.user["work_mean"] * b_slow)
-        return sim, cmd.hold(t, next_pc=b_done.pc)
-
-    @m.block
-    def b_done(sim, p, sig):
+    def b_fin(sim, p, sig):
         done = sm.add(sim.user["done"], api.clock(sim))
         sim = api.set_user(sim, {**sim.user, "done": done})
         sim = api.stop(sim, done.n >= sim.user["n_jobs"].astype(_R))
-        # continue straight at b_take (no jump-tail block: each chain
-        # iteration re-executes the whole masked body in the kernel)
-        return sim, cmd.pool_release(crew.id, 1.0, next_pc=b_take.pc)
+        sim = api.pool_release(sim, _spec(), crew, p, 1.0)
+        return sim, cmd.buffer_get(wip.id, 1.0, next_pc=b_svc.pc)
 
     # --- maintenance: condition-gated -------------------------------------
     @m.block
@@ -140,17 +150,16 @@ def build(
             },
         )
         # grab a crew member for a while (slows the shop down)
-        return sim, cmd.pool_acquire(crew.id, 1.0, next_pc=mt_hold.pc)
-
-    @m.block
-    def mt_hold(sim, p, sig):
-        return sim, cmd.hold(2.0, next_pc=mt_rel.pc)
+        return sim, cmd.pool_acquire_hold(
+            crew.id, 1.0, 2.0, next_pc=mt_rel.pc
+        )
 
     @m.block
     def mt_rel(sim, p, sig):
-        return sim, cmd.pool_release(crew.id, 1.0, next_pc=mt_wait.pc)
+        sim = api.pool_release(sim, _spec(), crew, p, 1.0)
+        return sim, cmd.cond_wait(cv.id, next_pc=mt_act.pc)
 
-    m.process("stageA", entry=a_arrive)
+    m.process("stageA", entry=a_start)
     m.process("stageB", entry=b_take, count=2)
     m.process("maintenance", entry=mt_wait)
 

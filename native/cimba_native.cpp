@@ -120,7 +120,7 @@ struct Ev {
                    // 4 woken guard retry
   double payload;
   double payload2;  // retry events: the pre-drawn service duration the
-                    // pended get_hold carries (engine: pend_f2)
+                    // pended get_hold carries (engine: pend_f3)
 };
 
 struct EvOrder {
@@ -140,7 +140,7 @@ struct MM1Result {
 // Scalar M/M/1 oracle mirroring the FUSED-verb flagship cycle
 // (models/mm1.py round 5: cmd.put_hold / cmd.get_hold — durations
 // pre-drawn one wake earlier; a pended get_hold carries its drawn
-// service time through the wait, engine field pend_f2).
+// service time through the wait, engine field pend_f3).
 MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
                   double arr_mean, double srv_mean) {
   Stream rng = Stream::init(seed, rep);
@@ -238,7 +238,7 @@ MM1Result run_mmc(uint64_t seed, uint64_t rep, uint64_t n_objects,
   int32_t seq = 0;
   // Fused-verb protocol (models/mmc.py round 5): every server's
   // get_hold pre-draws its service time; a pended get_hold carries it
-  // (engine pend_f2).  targets: 0 a_start, 1 a_cycle, 2 server start,
+  // (engine pend_f3).  targets: 0 a_start, 1 a_cycle, 2 server start,
   // 3 service done, 4 woken guard retry (payload = kept guard seq,
   // payload2 = the carried service duration)
   auto sched = [&](double t, int32_t target, double payload,

@@ -1,0 +1,443 @@
+"""The full fused-verb family (round 5): every blocking verb's
+``*_hold`` twin, plus the inline releases that make release cost zero
+chain iterations.
+
+Strategy mirrors tests/test_fused_verbs.py: deterministic models (no
+RNG) built in CLASSIC (verb; hold in a continuation block) and FUSED
+(one command) renditions are the same discrete-event system, so their
+observables must match exactly; the pended paths are forced by
+construction (contention / partial grabs / full stores); one model is
+pinned kernel-vs-XLA bitwise.  Abort semantics (pool rollback riding
+pend_f2 while the fused duration rides pend_f3) get a dedicated
+interrupt test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cimba_tpu import config
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import pallas_run
+from cimba_tpu.core import process as pr
+from cimba_tpu.core.model import Model
+
+ROUNDS = 6
+
+
+# --- binary resource: acquire_hold + inline release ----------------------
+
+
+def _build_res(fused: bool):
+    """Two workers contend for one resource; every other acquire pends.
+    Classic: acquire -> hold block -> release cmd -> hold block.
+    Fused: acquire_hold -> (inline release + hold) — same system."""
+    m = Model("fr", n_ilocals=1, event_cap=2)
+    r = m.resource("r", record=False)
+    spec_box = {}
+
+    @m.user_state
+    def init(params):
+        return {"svc": jnp.asarray(0, jnp.int32)}
+
+    if fused:
+        @m.block
+        def work(sim, p, sig):
+            k = api.local_i(sim, p, 0)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.acquire_hold(r.id, 0.3, next_pc=rel.pc),
+            )
+
+        @m.block
+        def rel(sim, p, sig):
+            sim = api.add_local_i(sim, p, 0, 1)
+            sim = api.set_user(sim, {"svc": sim.user["svc"] + 1})
+            sim = api.release(sim, spec_box["spec"], r, p)
+            return sim, cmd.hold(0.1, next_pc=work.pc)
+    else:
+        @m.block
+        def work(sim, p, sig):
+            k = api.local_i(sim, p, 0)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.acquire(r.id, next_pc=svc.pc),
+            )
+
+        @m.block
+        def svc(sim, p, sig):
+            return sim, cmd.hold(0.3, next_pc=rel.pc)
+
+        @m.block
+        def rel(sim, p, sig):
+            sim = api.add_local_i(sim, p, 0, 1)
+            sim = api.set_user(sim, {"svc": sim.user["svc"] + 1})
+            return sim, cmd.release(r.id, next_pc=gap.pc)
+
+        @m.block
+        def gap(sim, p, sig):
+            return sim, cmd.hold(0.1, next_pc=work.pc)
+
+    m.process("w1", entry=work, prio=1)
+    m.process("w2", entry=work, prio=0)
+    spec = m.build()
+    spec_box["spec"] = spec
+    return spec
+
+
+def test_acquire_hold_matches_classic():
+    outs = {}
+    for fused in (False, True):
+        with config.profile("f64"):
+            spec = _build_res(fused)
+            outs[fused] = jax.jit(cl.make_run(spec, t_end=50.0))(
+                cl.init_sim(spec, 0, 0, None)
+            )
+    a, b = outs[False], outs[True]
+    assert int(a.err) == int(b.err) == 0
+    assert float(a.clock) == float(b.clock)
+    assert int(a.user["svc"]) == int(b.user["svc"]) == 2 * ROUNDS
+
+
+def test_acquire_hold_kernel_matches_xla():
+    with config.profile("f32"):
+        spec = _build_res(fused=True)
+        sims = jax.vmap(lambda rep: cl.init_sim(spec, 0, rep, None))(
+            jnp.arange(4)
+        )
+        xla = jax.jit(jax.vmap(cl.make_run(spec, t_end=50.0)))(sims)
+        ker = pallas_run.make_kernel_run(
+            spec, t_end=50.0, interpret=True
+        )(sims)
+    for x, k in zip(jax.tree.leaves(xla), jax.tree.leaves(ker)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(k))
+    assert np.all(np.asarray(xla.err) == 0)
+
+
+# --- pool: partial-grab pend, fused hold, abort rollback -----------------
+
+
+def _build_pool(fused: bool):
+    """Claimer wants 2.0 of a 1.0-level pool: partial grab pends (the
+    fused duration must ride pend_f3 through the wait); a feeder
+    releases its unit at t=1.0 completing the claim -> the fused hold
+    fires.  Classic twin proves equality."""
+    m = Model("fp", n_ilocals=1, event_cap=2)
+    pl = m.resourcepool("pl", capacity=2.0, record=False)
+    spec_box = {}
+
+    @m.user_state
+    def init(params):
+        return {"t_done": jnp.asarray(-1.0, config.REAL)}
+
+    # feeder holds one unit from t=0, gives it back at t=1
+    @m.block
+    def f_grab(sim, p, sig):
+        return sim, cmd.pool_acquire(pl.id, 1.0, next_pc=f_wait.pc)
+
+    @m.block
+    def f_wait(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=f_rel.pc)
+
+    @m.block
+    def f_rel(sim, p, sig):
+        sim = api.pool_release(sim, spec_box["spec"], pl, p, 1.0)
+        return sim, cmd.exit_()
+
+    if fused:
+        @m.block
+        def claim(sim, p, sig):
+            return sim, cmd.pool_acquire_hold(
+                pl.id, 2.0, 0.5, next_pc=done.pc
+            )
+    else:
+        @m.block
+        def claim(sim, p, sig):
+            return sim, cmd.pool_acquire(pl.id, 2.0, next_pc=c_hold.pc)
+
+        @m.block
+        def c_hold(sim, p, sig):
+            return sim, cmd.hold(0.5, next_pc=done.pc)
+
+    @m.block
+    def done(sim, p, sig):
+        sim = api.set_user(sim, {"t_done": api.clock(sim)})
+        return sim, cmd.exit_()
+
+    m.process("feeder", entry=f_grab, prio=1)
+    m.process("claimer", entry=claim, prio=0)
+    spec = m.build()
+    spec_box["spec"] = spec
+    return spec
+
+
+def test_pool_acquire_hold_pended_matches_classic():
+    outs = {}
+    for fused in (False, True):
+        with config.profile("f64"):
+            spec = _build_pool(fused)
+            outs[fused] = jax.jit(cl.make_run(spec, t_end=50.0))(
+                cl.init_sim(spec, 0, 0, None)
+            )
+    a, b = outs[False], outs[True]
+    assert int(a.err) == int(b.err) == 0
+    # grant completes at t=1.0 (feeder's release), hold ends at 1.5
+    assert float(a.user["t_done"]) == float(b.user["t_done"]) == 1.5
+
+
+def test_pool_acquire_hold_abort_rolls_back():
+    """Interrupting a pended fused claim must roll the holding back to
+    its pre-call amount (pend_f2's job) — the fused duration in pend_f3
+    must not disturb the rollback protocol."""
+    m = Model("fpa", n_ilocals=1, event_cap=4)
+    pl = m.resourcepool("pl", capacity=2.0, record=False)
+    spec_box = {}
+
+    @m.user_state
+    def init(params):
+        return {"sig": jnp.asarray(99, jnp.int32)}
+
+    @m.block
+    def hog(sim, p, sig):  # takes 1.5 units for good
+        return sim, cmd.pool_acquire(pl.id, 1.5, next_pc=hog_park.pc)
+
+    @m.block
+    def hog_park(sim, p, sig):
+        return sim, cmd.hold(100.0, next_pc=hog_park.pc)
+
+    @m.block
+    def claim(sim, p, sig):  # wants 1.0, only 0.5 left -> pends
+        return sim, cmd.pool_acquire_hold(pl.id, 1.0, 7.0, next_pc=c_done.pc)
+
+    @m.block
+    def c_done(sim, p, sig):
+        sim = api.set_user(sim, {"sig": jnp.asarray(sig, jnp.int32)})
+        return sim, cmd.exit_()
+
+    @m.block
+    def meddle(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=kick.pc)
+
+    @m.block
+    def kick(sim, p, sig):
+        sim = api.interrupt(
+            sim, spec_box["spec"], claimer.first_pid, pr.INTERRUPTED
+        )
+        return sim, cmd.exit_()
+
+    m.process("hog", entry=hog, prio=2)
+    claimer = m.process("claimer", entry=claim, prio=1)
+    m.process("meddler", entry=meddle, prio=0)
+    spec = m.build()
+    spec_box["spec"] = spec
+
+    with config.profile("f64"):
+        out = jax.jit(cl.make_run(spec, t_end=50.0))(
+            cl.init_sim(spec, 0, 0, None)
+        )
+    assert int(out.err) == 0
+    # the partial 0.5 grab was returned: level back to 2.0 - 1.5 = 0.5
+    assert float(out.pools.level[0]) == 0.5
+    assert float(out.pools.held[0, claimer.first_pid]) == 0.0
+    # the continuation saw the interrupting signal, NOT a fused hold
+    assert int(out.user["sig"]) == pr.INTERRUPTED
+    # and well before the 7.0 fused duration could have elapsed
+    assert float(out.clock) < 7.0
+
+
+# --- buffer: fused transfer both ways ------------------------------------
+
+
+def _build_buf(fused: bool):
+    """Producer put_holds 2.0 into a cap-3 store (fills -> pends),
+    consumer get_holds 1.5 (drains -> pends); constant timings."""
+    m = Model("fb", n_ilocals=1, event_cap=2)
+    b = m.buffer("b", capacity=3.0, initial=0.0, record=False)
+
+    @m.user_state
+    def init(params):
+        return {"moved": jnp.asarray(0.0, config.REAL)}
+
+    if fused:
+        @m.block
+        def produce(sim, p, sig):
+            k = api.local_i(sim, p, 0)
+            sim = api.add_local_i(sim, p, 0, 1)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.buffer_put_hold(b.id, 2.0, 0.2, next_pc=produce.pc),
+            )
+
+        @m.block
+        def consume(sim, p, sig):
+            sim = api.set_user(
+                sim, {"moved": sim.user["moved"] + api.got(sim, p)}
+            )
+            k = api.local_i(sim, p, 0)
+            sim = api.add_local_i(sim, p, 0, 1)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.buffer_get_hold(b.id, 1.5, 0.7, next_pc=consume.pc),
+            )
+    else:
+        @m.block
+        def produce(sim, p, sig):
+            k = api.local_i(sim, p, 0)
+            sim = api.add_local_i(sim, p, 0, 1)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.buffer_put(b.id, 2.0, next_pc=p_hold.pc),
+            )
+
+        @m.block
+        def p_hold(sim, p, sig):
+            return sim, cmd.hold(0.2, next_pc=produce.pc)
+
+        @m.block
+        def consume(sim, p, sig):
+            sim = api.set_user(
+                sim, {"moved": sim.user["moved"] + api.got(sim, p)}
+            )
+            k = api.local_i(sim, p, 0)
+            sim = api.add_local_i(sim, p, 0, 1)
+            return sim, cmd.select(
+                k >= ROUNDS, cmd.exit_(),
+                cmd.buffer_get(b.id, 1.5, next_pc=c_hold.pc),
+            )
+
+        @m.block
+        def c_hold(sim, p, sig):
+            return sim, cmd.hold(0.7, next_pc=consume.pc)
+
+    m.process("producer", entry=produce, prio=1)
+    m.process("consumer", entry=consume, prio=0)
+    return m.build()
+
+
+def test_buffer_fused_matches_classic():
+    outs = {}
+    for fused in (False, True):
+        with config.profile("f64"):
+            spec = _build_buf(fused)
+            outs[fused] = jax.jit(cl.make_run(spec, t_end=50.0))(
+                cl.init_sim(spec, 0, 0, None)
+            )
+    a, b = outs[False], outs[True]
+    assert int(a.err) == int(b.err) == 0
+    assert float(a.clock) == float(b.clock)
+    assert float(a.user["moved"]) == float(b.user["moved"])
+    assert float(a.buffers.level[0]) == float(b.buffers.level[0])
+
+
+# --- priority queue: fused put/get ---------------------------------------
+
+
+def _build_pq(fused: bool):
+    """Producer pq_put(_hold)s items 1..N at priority (k % 3); consumer
+    pq_get(_hold)s them — drain order is priority-then-FIFO, identical
+    in both renditions; the 2-slot capacity forces pended puts."""
+    m = Model("fq", n_ilocals=1, event_cap=2)
+    q = m.priorityqueue("q", capacity=2, record=False)
+    n = 9
+
+    @m.user_state
+    def init(params):
+        return {"order": jnp.asarray(0.0, config.REAL),
+                "got_n": jnp.asarray(0, jnp.int32)}
+
+    if fused:
+        @m.block
+        def produce(sim, p, sig):
+            sim = api.add_local_i(sim, p, 0, 1)
+            k = api.local_i(sim, p, 0)
+            return sim, cmd.select(
+                k > n, cmd.exit_(),
+                cmd.pq_put_hold(
+                    q.id, k.astype(config.REAL),
+                    (k % 3).astype(config.REAL), 0.1, next_pc=produce.pc,
+                ),
+            )
+
+        @m.block
+        def consume(sim, p, sig):
+            u = sim.user
+            # order-sensitive digest: 10*prev + item
+            sim = api.set_user(sim, {
+                "order": u["order"] * 10.0 + api.got(sim, p),
+                "got_n": u["got_n"] + 1,
+            })
+            sim = api.stop(sim, u["got_n"] + 1 >= n)
+            return sim, cmd.pq_get_hold(q.id, 0.35, next_pc=consume.pc)
+
+        @m.block
+        def c_first(sim, p, sig):
+            return sim, cmd.pq_get_hold(q.id, 0.35, next_pc=consume.pc)
+    else:
+        @m.block
+        def produce(sim, p, sig):
+            sim = api.add_local_i(sim, p, 0, 1)
+            k = api.local_i(sim, p, 0)
+            return sim, cmd.select(
+                k > n, cmd.exit_(),
+                cmd.pq_put(
+                    q.id, k.astype(config.REAL),
+                    (k % 3).astype(config.REAL), next_pc=p_hold.pc,
+                ),
+            )
+
+        @m.block
+        def p_hold(sim, p, sig):
+            return sim, cmd.hold(0.1, next_pc=produce.pc)
+
+        @m.block
+        def consume(sim, p, sig):
+            u = sim.user
+            sim = api.set_user(sim, {
+                "order": u["order"] * 10.0 + api.got(sim, p),
+                "got_n": u["got_n"] + 1,
+            })
+            sim = api.stop(sim, u["got_n"] + 1 >= n)
+            return sim, cmd.pq_get(q.id, next_pc=c_hold.pc)
+
+        @m.block
+        def c_hold(sim, p, sig):
+            return sim, cmd.hold(0.35, next_pc=consume.pc)
+
+        @m.block
+        def c_first(sim, p, sig):
+            return sim, cmd.pq_get(q.id, next_pc=c_hold.pc)
+
+    m.process("producer", entry=produce, prio=1)
+    m.process("consumer", entry=c_first, prio=0)
+    return m.build()
+
+
+def test_pq_fused_matches_classic():
+    outs = {}
+    for fused in (False, True):
+        with config.profile("f64"):
+            spec = _build_pq(fused)
+            outs[fused] = jax.jit(cl.make_run(spec, t_end=50.0))(
+                cl.init_sim(spec, 0, 0, None)
+            )
+    a, b = outs[False], outs[True]
+    assert int(a.err) == int(b.err) == 0
+    assert float(a.clock) == float(b.clock)
+    assert float(a.user["order"]) == float(b.user["order"])
+    assert int(a.user["got_n"]) == int(b.user["got_n"])
+
+
+def test_pool_fused_kernel_matches_xla():
+    with config.profile("f32"):
+        spec = _build_pool(fused=True)
+        sims = jax.vmap(lambda rep: cl.init_sim(spec, 0, rep, None))(
+            jnp.arange(4)
+        )
+        xla = jax.jit(jax.vmap(cl.make_run(spec, t_end=50.0)))(sims)
+        ker = pallas_run.make_kernel_run(
+            spec, t_end=50.0, interpret=True
+        )(sims)
+    for x, k in zip(jax.tree.leaves(xla), jax.tree.leaves(ker)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(k))
+    assert np.all(np.asarray(xla.err) == 0)

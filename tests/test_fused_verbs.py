@@ -2,7 +2,7 @@
 
 The fuzz battery exercises pended get_holds; this pins the rarer
 pended PUT_HOLD: a producer hitting a full ring pends with its
-pre-drawn hold duration in pend_f2, and the woken retry applies the
+pre-drawn hold duration in pend_f3, and the woken retry applies the
 put AND schedules the fused hold.  Also pins fused-vs-classic
 equivalence on a deterministic model (no RNG → identical trajectories).
 """

@@ -41,12 +41,16 @@ def test_mm1_step_cost_budget():
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
         el, ops = _cost(spec, (1.0 / 0.9, 1.0, 200))
-    # round-5 measured: 1,832 el / 874 ops on the FUSED cycle (draw-word
+    # round-5 measured: 1,856 el / 891 ops on the FUSED cycle (draw-word
     # hoist, combined put/get ring handler, event_cap=1, put_hold/
-    # get_hold at ~1 chain iteration/event) — real ceiling ~525M
-    # events/s/chip, clear of the 469M/chip the v5e-8 north star needs
+    # get_hold at ~1 chain iteration/event) — real ceiling ~518M
+    # events/s/chip, clear of the 469M/chip the v5e-8 north star needs.
+    # (+17 ops vs the pre-f3 cycle: the pend_f3 payload that carries
+    # every fused verb's duration through a blocked wait, and the
+    # backend-independent first_true32 picks that fixed the first
+    # on-device Mosaic tie-break divergence — both deliberate.)
     assert el <= 1_900, f"mm1 step cost regressed: {el} elements/event"
-    assert ops <= 900, f"mm1 step op count regressed: {ops} ops/event"
+    assert ops <= 920, f"mm1 step op count regressed: {ops} ops/event"
 
 
 def test_awacs_step_cost_budget():
