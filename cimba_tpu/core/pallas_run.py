@@ -75,6 +75,111 @@ def _vmem_limit_bytes() -> int:
         ) from e
 
 
+def _pack_plan(avals):
+    """Static carry-packing plan over the chunk's batched leaves
+    ([comp..., L]): 32-bit leaves become rows of one [rows, L] buffer
+    per dtype class (f32; i32 with u32 riding along via same-width
+    bitcast), bool leaves and anything else pass through per-leaf.
+
+    Why: Mosaic's per-iteration cost of the chunk while-loop scales
+    super-linearly with the number of narrow carried leaves — measured
+    on v5e (BENCH_NOTES round-5 floor probes): mm1's real 54-leaf carry
+    costs ~135 us/step with a TRIVIAL body, while the same bytes in a
+    few wide f32 buffers cost <1 us.  Packing trades ~2 slice + reshape
+    (+bitcast) ops per leaf per iteration — all wide-array structural
+    ops — for that per-leaf carry overhead.
+
+    Returns a dict: ``groups`` maps dtype-class name ("f32"/"i32") to
+    the list of leaf indices packed in that buffer (row-major, stable
+    order), ``passthrough`` lists leaf indices carried per-leaf (bools;
+    anything non-32-bit), and ``meta[i] = (rows_i, per_lane_shape_i,
+    dtype_i)`` for every leaf.
+    """
+    groups = {"f32": [], "i32": []}
+    passthrough = []
+    meta = []
+    for i, a in enumerate(avals):
+        s = tuple(a.shape[:-1])
+        r = 1
+        for d in s:
+            r *= int(d)
+        meta.append((r, s, a.dtype))
+        if a.dtype == jnp.float32:
+            groups["f32"].append(i)
+        elif a.dtype in (jnp.int32, jnp.uint32):
+            groups["i32"].append(i)
+        else:
+            passthrough.append(i)
+    return {"groups": groups, "passthrough": passthrough, "meta": meta}
+
+
+def _pack_rows(x, r, s):
+    """[s..., L] -> [r, L] (reshape touches leading dims only)."""
+    L = x.shape[-1]
+    if s == ():
+        return lax.reshape(x, (1, L))
+    if len(s) == 1:
+        return x
+    return lax.reshape(x, (r, L))
+
+
+def _pack(leaves, plan):
+    """leaves (original order) -> packed carry list:
+    [f32 buffer?, i32 buffer?, *passthrough leaves]."""
+    out = []
+    for cls, dt in (("f32", jnp.float32), ("i32", jnp.int32)):
+        idxs = plan["groups"][cls]
+        if not idxs:
+            continue
+        parts = []
+        for i in idxs:
+            r, s, dtype = plan["meta"][i]
+            p = _pack_rows(leaves[i], r, s)
+            if dtype != dt:  # u32 rows ride the i32 buffer bitwise
+                p = lax.bitcast_convert_type(p, dt)
+            parts.append(p)
+        out.append(
+            parts[0] if len(parts) == 1 else lax.concatenate(parts, 0)
+        )
+    for i in plan["passthrough"]:
+        out.append(leaves[i])
+    return out
+
+
+def _unpack(packed, plan, L):
+    """Inverse of :func:`_pack`: packed carry list -> leaves in original
+    order (row slices + bitcast + leading-dim reshape, all Mosaic-clean
+    wide-array ops)."""
+    n = len(plan["meta"])
+    leaves = [None] * n
+    k = 0
+    for cls, dt in (("f32", jnp.float32), ("i32", jnp.int32)):
+        idxs = plan["groups"][cls]
+        if not idxs:
+            continue
+        buf = packed[k]
+        k += 1
+        o = 0
+        for i in idxs:
+            r, s, dtype = plan["meta"][i]
+            if len(idxs) == 1:
+                p = buf
+            else:
+                p = lax.slice(buf, (o, 0), (o + r, L))
+            o += r
+            if dtype != dt:
+                p = lax.bitcast_convert_type(p, dtype)
+            if s == ():
+                p = lax.reshape(p, (L,))
+            elif len(s) != 1:
+                p = lax.reshape(p, s + (L,))
+            leaves[i] = p
+    for i in plan["passthrough"]:
+        leaves[i] = packed[k]
+        k += 1
+    return leaves
+
+
 def make_kernel_run(
     spec: ModelSpec,
     t_end: Optional[float] = None,
@@ -83,6 +188,7 @@ def make_kernel_run(
     interpret: bool = False,
     single_step: bool = False,
     mesh=None,
+    packed: Optional[bool] = None,
 ):
     """Build ``run(sims) -> sims`` where ``sims`` is a lane-FIRST batched
     Sim (the shape ``jax.vmap(init_sim)`` produces) and every lane is
@@ -106,6 +212,10 @@ def make_kernel_run(
             "make_kernel_run requires config.profile('f32') — Mosaic has "
             "no 64-bit types; build the spec and init_sim under f32 too"
         )
+    if packed is None:
+        # carry packing (see _pack_plan): opt-in via env until measured
+        # faster on hardware, then flip the default
+        packed = os.environ.get("CIMBA_KERNEL_PACK", "0") != "0"
     step = cl.make_step(spec)
     cond = cl.make_cond(spec, t_end)
 
@@ -185,6 +295,40 @@ def make_kernel_run(
                 # no loop — separates step bugs from loop bugs
                 out, _ = wbody((tuple(ls), jnp.zeros((), jnp.int32)))
                 return list(out)
+            if packed:
+                # packed carry: the while loop carries 2-5 wide buffers
+                # instead of ~54 narrow leaves (see _pack_plan); the
+                # body unpacks, steps, repacks, and applies the live
+                # mask per BUFFER (one wide select each) instead of
+                # per leaf
+                plan = _pack_plan(
+                    [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in ls]
+                )
+
+                def pcond(carry):
+                    bufs, k = carry
+                    return (k < chunk_steps) & jnp.any(
+                        vcond(_unpack(list(bufs), plan, L))
+                    )
+
+                def pbody(carry):
+                    bufs, k = carry
+                    ls2 = _unpack(list(bufs), plan, L)
+                    live = vcond(ls2)
+                    new = vstep(ls2)
+                    nbufs = _pack(new, plan)
+                    merged = tuple(
+                        b if b is nb else jnp.where(live, nb, b)
+                        for b, nb in zip(bufs, nbufs)
+                    )
+                    return merged, k + 1
+
+                out, _ = lax.while_loop(
+                    pcond,
+                    pbody,
+                    (tuple(_pack(list(ls), plan)), jnp.zeros((), jnp.int32)),
+                )
+                return _unpack(list(out), plan, L)
             out, _ = lax.while_loop(
                 wcond, wbody, (tuple(ls), jnp.zeros((), jnp.int32))
             )

@@ -199,15 +199,21 @@ def _build_fuzz(seed: int):
     return m.build()
 
 
-def _run_both(seed: int):
+_xla_cache = {}  # seed -> (spec, sims, xla): oracle shared by both arms
+
+
+def _run_both(seed: int, packed=False):
     with config.profile("f32"):
-        spec = _build_fuzz(seed)
-        sims = jax.vmap(lambda rep: cl.init_sim(spec, seed, rep, None))(
-            jnp.arange(L)
-        )
-        xla = jax.jit(jax.vmap(cl.make_run(spec, t_end=400.0)))(sims)
+        if seed not in _xla_cache:
+            spec = _build_fuzz(seed)
+            sims = jax.vmap(
+                lambda rep: cl.init_sim(spec, seed, rep, None)
+            )(jnp.arange(L))
+            xla = jax.jit(jax.vmap(cl.make_run(spec, t_end=400.0)))(sims)
+            _xla_cache[seed] = (spec, sims, xla)
+        spec, sims, xla = _xla_cache[seed]
         krun = pallas_run.make_kernel_run(
-            spec, t_end=400.0, interpret=not ON_DEVICE
+            spec, t_end=400.0, interpret=not ON_DEVICE, packed=packed
         )
         ker = krun(sims)
     return xla, ker
@@ -240,6 +246,17 @@ def test_fuzz_models_kernel_matches_xla():
     for seed in _SEEDS:
         xla, ker = _run_both(seed)
         assert int(jnp.sum(xla.n_events)) > 100, f"seed {seed} too short"
+        _check(xla, ker, seed)
+
+
+def test_fuzz_models_packed_carry_matches_xla():
+    """The packed-carry chunk loop (pallas_run._pack_plan: 32-bit leaves
+    concatenated into per-dtype [rows, L] buffers, bools passthrough)
+    must be trajectory-identical to the per-leaf carry on the same
+    generated models — packing is a carry-layout change, never a
+    semantic one."""
+    for seed in _SEEDS:
+        xla, ker = _run_both(seed, packed=True)
         _check(xla, ker, seed)
 
 
