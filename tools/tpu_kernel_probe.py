@@ -37,7 +37,19 @@ def sweep():
     N = int(sys.argv[2]) if len(sys.argv) > 2 else 500
     from cimba_tpu import config
 
-    log(phase="sweep_start", backend=jax.default_backend(), N=N)
+    # CIMBA_SWEEP_CHUNKS widens the chunk axis (e.g. "512,4096,16384"
+    # for the packed-carry arm: chunk_steps is only the loop's trip
+    # BOUND — the while exits when every lane is done, so a big chunk
+    # never wastes compute, it just amortizes the ~75 ms/launch host
+    # overhead over more steps).  CIMBA_KERNEL_PACK=1 is read by
+    # make_kernel_run and flips the carry layout.
+    chunks = tuple(
+        int(c)
+        for c in os.environ.get("CIMBA_SWEEP_CHUNKS", "128,512").split(",")
+    )
+    log(phase="sweep_start", backend=jax.default_backend(), N=N,
+        chunks=list(chunks),
+        packed=os.environ.get("CIMBA_KERNEL_PACK", "0") != "0")
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
         for R in (128, 512, 1024, 4096, 8192):
@@ -45,7 +57,7 @@ def sweep():
                 jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0 / 0.9, 1.0, N)))
             )(jnp.arange(R))
             jax.block_until_ready(jax.tree.leaves(sims))
-            for chunk in (128, 512):
+            for chunk in chunks:
                 try:
                     krun = pr.make_kernel_run(spec, chunk_steps=chunk)
                     kout = krun(sims)  # compile + first run
@@ -70,7 +82,8 @@ def main():
     R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     N = int(sys.argv[2]) if len(sys.argv) > 2 else 100
     CHUNK = int(sys.argv[3]) if len(sys.argv) > 3 else 512
-    log(phase="start", backend=jax.default_backend(), R=R, N=N, chunk=CHUNK)
+    log(phase="start", backend=jax.default_backend(), R=R, N=N, chunk=CHUNK,
+        packed=os.environ.get("CIMBA_KERNEL_PACK", "0") != "0")
 
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
