@@ -337,3 +337,43 @@ def test_kernel_matches_xla_f32_condition(f32_profile):
     assert int(ker.err.sum()) == 0
     # both waiters woke exactly when the predicate turned true
     assert bool((ker.procs.locals_f[:, 0, 0] == 2.0).all())
+
+
+def test_pack_unpack_roundtrip():
+    """pallas_run._pack/_unpack are exact inverses over the leaf-shape
+    zoo the engine produces: scalars, [k], [k,1], [1,cap] per-lane
+    shapes; f32/i32/u32 (bitcast rows) and bool (passthrough)."""
+    import numpy as np
+
+    L = 4
+    rng = np.random.default_rng(0)
+    specs = [
+        ((), jnp.float32), ((), jnp.int32), ((), jnp.uint32),
+        ((2,), jnp.float32), ((2,), jnp.int32), ((2, 1), jnp.int32),
+        ((1, 128), jnp.float32), ((), jnp.bool_), ((3,), jnp.uint32),
+    ]
+    leaves = []
+    for s, dt in specs:
+        full = s + (L,)
+        if dt == jnp.bool_:
+            leaves.append(jnp.asarray(rng.integers(0, 2, full), dt))
+        elif dt == jnp.float32:
+            leaves.append(jnp.asarray(rng.normal(size=full), dt))
+        else:
+            leaves.append(
+                jnp.asarray(rng.integers(0, 2**31 - 1, full), dt)
+            )
+    avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    plan = pr._pack_plan(avals)
+    # grouping: 3 f32 + 5 int/uint rows packed, 1 bool passthrough
+    assert len(plan["groups"]["f32"]) == 3
+    assert len(plan["groups"]["i32"]) == 5
+    assert plan["passthrough"] == [7]
+    bufs = pr._pack(leaves, plan)
+    assert len(bufs) == 3  # f32 buffer, i32 buffer, bool leaf
+    assert bufs[0].shape == (1 + 2 + 128, L)
+    assert bufs[1].shape == (1 + 1 + 2 + 2 + 3, L)
+    out = pr._unpack(bufs, plan, L)
+    for a, b in zip(leaves, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
