@@ -48,13 +48,50 @@ def load() -> Optional[ctypes.CDLL]:
             return None
     try:
         lib = ctypes.CDLL(_SO)
+        _bind(lib)
     except OSError:
         return None
+    except AttributeError:
+        # stale .so predating a symbol (local build artifact): rebuild
+        # from source and reload; if the rebuild or the reload still
+        # misses symbols, degrade to unavailable rather than crash.
+        # (make clean first: gcc rewrites in place, and dlopen caches
+        # by (dev, inode) — a fresh inode guarantees a fresh mapping)
+        try:
+            subprocess.run(
+                ["make", "-s", "clean"],
+                cwd=_NATIVE_DIR,
+                check=True,
+                capture_output=True,
+                timeout=60,
+            )
+        except Exception:
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _bind(lib)
+        except (OSError, AttributeError):
+            return None
+    _lib = lib
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.cimba_hwseed.restype = ctypes.c_uint64
     lib.cimba_threefry2x32.argtypes = [ctypes.c_uint32] * 4 + [
         ctypes.POINTER(ctypes.c_uint32)
     ] * 2
     lib.cimba_oracle_mm1.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.cimba_mm1_single.argtypes = [
         ctypes.c_uint64,
         ctypes.c_uint64,
         ctypes.c_uint64,
@@ -71,8 +108,6 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_uint32,
         ctypes.POINTER(ctypes.c_double),
     ]
-    _lib = lib
-    return lib
 
 
 def available() -> bool:
@@ -99,6 +134,14 @@ def threefry2x32(k0: int, k1: int, c0: int, c1: int) -> tuple[int, int]:
     return o0.value, o1.value
 
 
+def _summary(out) -> dict:
+    """The shared [clock, n, mean, m2, min, max, events] out7 layout."""
+    keys = ("clock", "n", "mean", "m2", "min", "max")
+    d = {k: out[i] for i, k in enumerate(keys)}
+    d["events"] = int(out[6])
+    return d
+
+
 def oracle_mm1(
     seed: int, rep: int, n_objects: int, arr_mean: float, srv_mean: float
 ) -> dict:
@@ -107,15 +150,20 @@ def oracle_mm1(
     assert lib is not None
     out = (ctypes.c_double * 7)()
     lib.cimba_oracle_mm1(seed, rep, n_objects, arr_mean, srv_mean, out)
-    return {
-        "clock": out[0],
-        "n": out[1],
-        "mean": out[2],
-        "m2": out[3],
-        "min": out[4],
-        "max": out[5],
-        "events": int(out[6]),
-    }
+    return _summary(out)
+
+
+def mm1_single(
+    seed: int, rep: int, n_objects: int, arr_mean: float, srv_mean: float
+) -> dict:
+    """Single-stream M/M/1 on the host core at engine semantics — the
+    native latency path (run_mm1_fast in cimba_native.cpp); results are
+    bitwise-equal to :func:`oracle_mm1` (pinned by test_native.py)."""
+    lib = load()
+    assert lib is not None
+    out = (ctypes.c_double * 7)()
+    lib.cimba_mm1_single(seed, rep, n_objects, arr_mean, srv_mean, out)
+    return _summary(out)
 
 
 def oracle_mmc(
@@ -131,12 +179,4 @@ def oracle_mmc(
     assert lib is not None
     out = (ctypes.c_double * 7)()
     lib.cimba_oracle_mmc(seed, rep, n_objects, arr_mean, srv_mean, c, out)
-    return {
-        "clock": out[0],
-        "n": out[1],
-        "mean": out[2],
-        "m2": out[3],
-        "min": out[4],
-        "max": out[5],
-        "events": int(out[6]),
-    }
+    return _summary(out)

@@ -25,6 +25,7 @@
 #include <ctime>
 #include <queue>
 #include <vector>
+#include <cstdlib>
 
 #if defined(__x86_64__)
 #include <cpuid.h>
@@ -226,6 +227,135 @@ MM1Result run_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
 }
 
 // ---------------------------------------------------------------------------
+// Single-stream M/M/1 at engine semantics, tuned for the host core — the
+// reference's MM1_single benchmark shape (one replication, one core;
+// reference: benchmark/MM1_single.c, ~32M events/s on a 3970X core).
+// Trajectory-identical to run_mm1: same RNG placement, same (t, seq) pop
+// order (every mm1 event shares priority 0), bitwise-equal outputs
+// (pinned by test_native.py).  Only the data structures change: the <=3
+// live events sit in a flat 4-slot table (linear lexmin beats a binary
+// heap at n<=3) and the FIFO is a power-of-two ring.
+// ---------------------------------------------------------------------------
+
+MM1Result run_mm1_fast(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                       double arr_mean, double srv_mean) {
+  Stream rng = Stream::init(seed, rep);
+  struct Slot {
+    double t;
+    int32_t seq, target;
+    double payload, payload2;
+    bool live;
+  };
+  Slot slots[4] = {};
+  int32_t seq = 0;
+  int n_live = 0;
+  auto sched = [&](double t, int32_t target, double payload,
+                   double payload2 = 0.0) {
+    for (auto& s : slots) {
+      if (!s.live) {
+        s = Slot{t, seq++, target, payload, payload2, true};
+        ++n_live;
+        return;
+      }
+    }
+    std::abort();  // mm1 never carries more than 3 live events
+  };
+
+  std::vector<double> ring(1u << 4);  // FIFO ring; starts small so the
+                                    // doubling path is routinely
+                                    // exercised (growth is amortized
+                                    // and the equality test covers it)
+  uint32_t head = 0, count = 0;
+  auto fifo_push = [&](double x) {
+    if (count == ring.size()) {
+      std::vector<double> bigger(ring.size() * 2);
+      for (uint32_t i = 0; i < count; ++i)
+        bigger[i] = ring[(head + i) & (ring.size() - 1)];
+      ring.swap(bigger);
+      head = 0;
+    }
+    ring[(head + count) & (ring.size() - 1)] = x;
+    ++count;
+  };
+
+  double clock = 0.0;
+  uint64_t produced = 0, events = 0;
+  bool service_waiting = false;
+  double pending_srv_t = 0.0;
+  double sn = 0, smean = 0, sm2 = 0, smin = HUGE_VAL, smax = -HUGE_VAL;
+  auto record = [&](double x) {
+    sn += 1.0;
+    const double d = x - smean;
+    smean += d / sn;
+    sm2 += d * (x - smean);
+    if (x < smin) smin = x;
+    if (x > smax) smax = x;
+  };
+  auto service_try = [&](double t_srv) {
+    if (count == 0) {
+      service_waiting = true;
+      pending_srv_t = t_srv;
+      return;
+    }
+    const double item = ring[head & (ring.size() - 1)];
+    head = (head + 1) & (ring.size() - 1);
+    --count;
+    sched(clock + t_srv, 3, item);
+  };
+
+  sched(0.0, 0, 0.0);  // arrival start
+  sched(0.0, 2, 0.0);  // service start
+
+  bool done = false;
+  while (n_live > 0 && !done) {
+    int best = -1;
+    for (int i = 0; i < 4; ++i) {
+      if (!slots[i].live) continue;
+      if (best < 0 || slots[i].t < slots[best].t ||
+          (slots[i].t == slots[best].t && slots[i].seq < slots[best].seq))
+        best = i;
+    }
+    const Slot ev = slots[best];
+    slots[best].live = false;
+    --n_live;
+    clock = ev.t;
+    ++events;
+    switch (ev.target) {
+      case 0:
+        sched(clock + rng.exponential(arr_mean), 1, 0.0);
+        break;
+      case 1: {
+        ++produced;
+        const bool finished = produced >= n_objects;
+        const double t_next = rng.exponential(arr_mean);
+        fifo_push(clock);
+        if (service_waiting) {
+          service_waiting = false;
+          sched(clock, 4, 0.0, pending_srv_t);
+        }
+        if (!finished) sched(clock + t_next, 1, 0.0);
+        break;
+      }
+      case 2:
+        service_try(rng.exponential(srv_mean));
+        break;
+      case 4:
+        service_try(ev.payload2);
+        break;
+      case 3:
+        record(clock - ev.payload);
+        if (static_cast<uint64_t>(sn) >= n_objects) {
+          done = true;
+        } else {
+          service_try(rng.exponential(srv_mean));
+        }
+        break;
+    }
+  }
+  return MM1Result{clock, sn, smean, sm2, smin, smax, events};
+}
+
+// ---------------------------------------------------------------------------
 // Scalar M/M/c oracle — c symmetric servers sharing one FIFO, with the
 // engine's exact guard protocol (parity role: src/cmb_resourceguard.c FIFO
 // wake order; engine rendition: core/guard.py + h_get/h_put in core/loop.py)
@@ -385,6 +515,21 @@ uint64_t cimba_hwseed(void) {
 void cimba_oracle_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
                       double arr_mean, double srv_mean, double* out7) {
   const MM1Result r = run_mm1(seed, rep, n_objects, arr_mean, srv_mean);
+  out7[0] = r.clock;
+  out7[1] = r.n;
+  out7[2] = r.mean;
+  out7[3] = r.m2;
+  out7[4] = r.min;
+  out7[5] = r.max;
+  out7[6] = static_cast<double>(r.events);
+}
+
+// Single-stream M/M/1 at engine semantics (run_mm1_fast): the native
+// host-core latency path behind bench.py --config mm1_single; same
+// output layout as cimba_oracle_mm1 and bitwise-equal results.
+void cimba_mm1_single(uint64_t seed, uint64_t rep, uint64_t n_objects,
+                      double arr_mean, double srv_mean, double* out7) {
+  const MM1Result r = run_mm1_fast(seed, rep, n_objects, arr_mean, srv_mean);
   out7[0] = r.clock;
   out7[1] = r.n;
   out7[2] = r.mean;

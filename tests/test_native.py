@@ -141,3 +141,15 @@ def test_engine_matches_cpp_oracle_at_replication_scale():
         assert n_events[rep] == ora["events"]
         np.testing.assert_allclose(clocks[rep], ora["clock"], rtol=1e-9)
         np.testing.assert_allclose(m1[rep], ora["mean"], rtol=1e-8)
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_mm1_single_fast_path_bitwise_equals_oracle():
+    """run_mm1_fast (the bench's native single-stream path: flat 4-slot
+    event table + ring FIFO) must be trajectory-identical to the heap
+    oracle — every output double bitwise equal, across seeds and reps."""
+    for seed in (1, 42, 2026):
+        for rep in (0, 7):
+            a = native.oracle_mm1(seed, rep, 20000, 1.0 / 0.9, 1.0)
+            b = native.mm1_single(seed, rep, 20000, 1.0 / 0.9, 1.0)
+            assert a == b, (seed, rep)
