@@ -220,11 +220,12 @@ _BUILDS = {
 }
 
 
-def _aot_compile(model):
+def _aot_compile(model, packed=False):
     code = _SCRIPT.format(repo=_REPO, build=_BUILDS[model])
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""  # offline: never touch the tunnel
+    env["CIMBA_KERNEL_PACK"] = "1" if packed else "0"
     proc = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True,
@@ -251,6 +252,15 @@ def _aot_compile(model):
 @pytest.mark.slow
 def test_mm1_chunk_compiles_through_mosaic():
     _aot_compile("mm1")
+
+
+@pytest.mark.slow
+def test_mm1_packed_carry_compiles_through_mosaic():
+    """The packed-carry chunk (pallas_run._pack/_unpack: concat/slice/
+    bitcast/leading-dim reshapes inside the loop body) lowers through
+    Mosaic — the structural-op risk class the per-leaf carry never
+    exercises."""
+    _aot_compile("mm1", packed=True)
 
 
 @pytest.mark.slow
