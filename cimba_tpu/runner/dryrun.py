@@ -93,6 +93,15 @@ def _dryrun_model_mesh(mesh, n_devices: int, build, params, label) -> int:
         assert bool((single.n_events == sharded.n_events).all()), label
         assert bool((single.clock == sharded.clock).all()), label
         assert int(sharded.err.sum()) == 0, f"{label} dryrun errors"
+        # packed carry over the same mesh: the carry-layout change must
+        # be invisible to the sharded trajectory too
+        packed = pr.make_kernel_run(
+            spec, chunk_steps=32, interpret=interp, mesh=mesh, packed=True
+        )(sims)
+        assert bool((single.n_events == packed.n_events).all()), (
+            f"{label} packed"
+        )
+        assert bool((single.clock == packed.clock).all()), f"{label} packed"
         return int(sharded.n_events.sum())
 
 
