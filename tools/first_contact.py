@@ -50,6 +50,7 @@ PHASE_TIMEOUTS = {
     "fuzz_on_device": 5400,  # packed fuzz arm doubles the kernel compiles
     "sweep": 2400,
     "sweep_packed": 3600,
+    "xla_tuning": 1800,
     "bench_awacs": 2400,
     "bench_mm1_single": 1800,
     "bench_all": 3600,
@@ -219,6 +220,10 @@ def main():
             [sys.executable, "-m", "pytest", "tests/test_kernel_fuzz.py",
              "-x", "-q", "--no-header", "-p", "no:cacheprovider"],
             env_extra={"CIMBA_ON_DEVICE": "1"},
+        )
+        results["xla_tuning"] = run_phase(
+            "xla_tuning",
+            [sys.executable, "tools/xla_tuning_probe.py"],
         )
         results["bench_awacs"] = run_phase(
             "bench_awacs",
