@@ -57,15 +57,18 @@ from cimba_tpu.core import loop as cl
 from cimba_tpu.core.model import ModelSpec
 
 
-def _vmem_limit_bytes() -> int:
+def _vmem_limit_bytes(lane_block=None) -> int:
     """Mosaic scoped-vmem budget for the chunk kernel, in bytes.
 
     Default 96 MiB (v5e has 128 MiB; the 16 MiB Mosaic default rejects
     the whole-Sim-resident kernel above L≈1024 — measured offline,
-    BENCH_NOTES round 4).  Override with ``CIMBA_KERNEL_VMEM_LIMIT``."""
+    BENCH_NOTES round 4); 110 MiB under lane blocking (the grid's DMA
+    double-buffering adds a few MiB — an Lb=8192 block measured 97.3
+    MiB offline, 1.3 over the plain budget).  Override with
+    ``CIMBA_KERNEL_VMEM_LIMIT``."""
     raw = os.environ.get("CIMBA_KERNEL_VMEM_LIMIT", "").strip()
     if not raw:
-        return 96 * 1024 * 1024
+        return (110 if lane_block else 96) * 1024 * 1024
     try:
         return int(raw)
     except ValueError as e:
@@ -189,6 +192,7 @@ def make_kernel_run(
     single_step: bool = False,
     mesh=None,
     packed: Optional[bool] = None,
+    lane_block: Optional[int] = None,
 ):
     """Build ``run(sims) -> sims`` where ``sims`` is a lane-FIRST batched
     Sim (the shape ``jax.vmap(init_sim)`` produces) and every lane is
@@ -216,6 +220,24 @@ def make_kernel_run(
         # carry packing (see _pack_plan): opt-in via env until measured
         # faster on hardware, then flip the default
         packed = os.environ.get("CIMBA_KERNEL_PACK", "0") != "0"
+    if lane_block is None:
+        # lane blocking: run the chunk as a pallas GRID over lane
+        # blocks — VMEM holds ONE block's Sim (so total lanes are no
+        # longer VMEM-capped), Mosaic compiles a block-sized program
+        # (so compile time stops growing with total lanes), and one
+        # launch advances every block (amortizing the ~75 ms/launch
+        # host overhead over L/lane_block more events).  Lanes are
+        # independent, so per-block while-loops are trajectory-
+        # identical to the monolithic form: each block just exits its
+        # loop when its own lanes are done.
+        raw = os.environ.get("CIMBA_KERNEL_LANE_BLOCK", "").strip()
+        try:
+            lane_block = int(raw) if raw else None
+        except ValueError as e:
+            raise ValueError(
+                f"CIMBA_KERNEL_LANE_BLOCK must be an integer lane count, "
+                f"got {raw!r}"
+            ) from e
     step = cl.make_step(spec)
     cond = cl.make_cond(spec, t_end)
 
@@ -355,21 +377,59 @@ def make_kernel_run(
     def build_chunk_call(leaves, treedef):
         """trace_chunk + constant hoisting to SMEM + the pallas_call.
         Returns ``(chunk_fn, consts_in)`` where ``chunk_fn(*leaves)``
-        advances every lane by one chunk."""
+        advances every lane by one chunk.  With ``lane_block`` the call
+        becomes a 1-D grid over lane blocks (see make_kernel_run)."""
         n = len(leaves)
-        flat_chunk, bool_idx, carrier_avals = trace_chunk(leaves, treedef)
+        L = leaves[0].shape[-1]
+        Lb = lane_block or L
+        if L % Lb:
+            raise ValueError(
+                f"lanes={L} must divide evenly by lane_block={Lb}"
+                + (
+                    " (under mesh= the chunk is built at the PER-DEVICE "
+                    "local lane width, so lane_block applies per shard)"
+                    if mesh is not None
+                    else ""
+                )
+            )
+        block_avals = [
+            jax.ShapeDtypeStruct(l.shape[:-1] + (Lb,), l.dtype)
+            for l in leaves
+        ]
+        flat_chunk, bool_idx, block_carriers = trace_chunk(
+            block_avals, treedef
+        )
+        # out_shape is FULL width; the kernel sees block-shaped refs.
+        # Derive it from trace_chunk's carriers (widen the lane axis)
+        # so the carrier dtype rule has one source of truth.
+        carrier_avals = [
+            jax.ShapeDtypeStruct(a.shape[:-1] + (L,), a.dtype)
+            for a in block_carriers
+        ]
 
         const_info, smem_in, vmem_in = route_consts(flat_chunk.consts)
         consts_in = smem_in + vmem_in
+        if Lb == L:
+            grid_kwargs = {}
+            state_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)] * n
+        else:
+            def _spec_of(a):
+                nd = len(a.shape)
+                return pl.BlockSpec(
+                    a.shape[:-1] + (Lb,),
+                    lambda i, _nd=nd: (0,) * (_nd - 1) + (i,),
+                )
+
+            grid_kwargs = {"grid": (L // Lb,)}
+            state_specs = [_spec_of(a) for a in carrier_avals]
         chunk_call = pl.pallas_call(
             partial(_kernel_body, flat_chunk.jaxpr, const_info, n),
             out_shape=[
                 jax.ShapeDtypeStruct(a.shape, a.dtype)
                 for a in carrier_avals
             ],
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n
-            + const_specs(const_info),
-            out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n,
+            in_specs=state_specs + const_specs(const_info),
+            out_specs=state_specs,
             input_output_aliases={i: i for i in range(n)},
             interpret=interpret,
             # Mosaic's scoped-vmem budget defaults to 16 MiB; the
@@ -382,9 +442,10 @@ def make_kernel_run(
                 None
                 if interpret
                 else pltpu.CompilerParams(
-                    vmem_limit_bytes=_vmem_limit_bytes()
+                    vmem_limit_bytes=_vmem_limit_bytes(lane_block)
                 )
             ),
+            **grid_kwargs,
         )
 
         def chunk_fn(*ls):

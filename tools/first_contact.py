@@ -50,6 +50,7 @@ PHASE_TIMEOUTS = {
     "fuzz_on_device": 5400,  # packed fuzz arm doubles the kernel compiles
     "sweep": 2400,
     "sweep_packed": 3600,
+    "sweep_lane_block": 3600,
     "xla_tuning": 1800,
     "bench_awacs": 2400,
     "bench_mm1_single": 1800,
@@ -209,6 +210,19 @@ def main():
             env_extra={
                 "CIMBA_KERNEL_PACK": "1",
                 "CIMBA_SWEEP_CHUNKS": "512,4096,16384",
+            },
+        )
+        # lane-block grid: VMEM holds one 8192-lane block, so total
+        # lanes scale to XLA-path widths; compiles are block-sized
+        # (5 s offline at Lb=1024 vs 153 s monolithic L=8192)
+        results["sweep_lane_block"] = run_phase(
+            "sweep_lane_block",
+            [sys.executable, "tools/tpu_kernel_probe.py", "--sweep", "2000"],
+            env_extra={
+                "CIMBA_KERNEL_PACK": "1",
+                "CIMBA_KERNEL_LANE_BLOCK": "8192",
+                "CIMBA_SWEEP_LANES": "16384,65536,131072",
+                "CIMBA_SWEEP_CHUNKS": "2048,8192",
             },
         )
         results["kernel_probe"] = run_phase(

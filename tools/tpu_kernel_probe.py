@@ -47,12 +47,19 @@ def sweep():
         int(c)
         for c in os.environ.get("CIMBA_SWEEP_CHUNKS", "128,512").split(",")
     )
+    lanes = tuple(
+        int(x)
+        for x in os.environ.get(
+            "CIMBA_SWEEP_LANES", "128,512,1024,4096,8192"
+        ).split(",")
+    )
     log(phase="sweep_start", backend=jax.default_backend(), N=N,
-        chunks=list(chunks),
-        packed=os.environ.get("CIMBA_KERNEL_PACK", "0") != "0")
+        chunks=list(chunks), lanes=list(lanes),
+        packed=os.environ.get("CIMBA_KERNEL_PACK", "0") != "0",
+        lane_block=os.environ.get("CIMBA_KERNEL_LANE_BLOCK", ""))
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
-        for R in (128, 512, 1024, 4096, 8192):
+        for R in lanes:
             sims = jax.jit(
                 jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0 / 0.9, 1.0, N)))
             )(jnp.arange(R))
