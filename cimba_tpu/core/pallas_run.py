@@ -382,6 +382,15 @@ def make_kernel_run(
         n = len(leaves)
         L = leaves[0].shape[-1]
         Lb = lane_block or L
+        if lane_block and not interpret and Lb % 1024:
+            # per-lane scalars batch to 1-D [L] leaves, which XLA lays
+            # out in 1024-wide tiles — a lane block that splits a tile
+            # fails Mosaic's operand-layout check (measured offline:
+            # "XLA layout T(1024) does not match Mosaic layout T(128)")
+            raise ValueError(
+                f"lane_block={Lb} must be a multiple of 1024 (the XLA "
+                "tile width of 1-D per-lane leaves)"
+            )
         if L % Lb:
             raise ValueError(
                 f"lanes={L} must divide evenly by lane_block={Lb}"
