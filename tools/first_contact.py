@@ -223,6 +223,7 @@ def main():
                 "CIMBA_KERNEL_LANE_BLOCK": "8192",
                 "CIMBA_SWEEP_LANES": "16384,65536,131072",
                 "CIMBA_SWEEP_CHUNKS": "2048,8192",
+                "CIMBA_SWEEP_VERIFY": "1",
             },
         )
         results["kernel_probe"] = run_phase(

@@ -57,6 +57,7 @@ def sweep():
         chunks=list(chunks), lanes=list(lanes),
         packed=os.environ.get("CIMBA_KERNEL_PACK", "0") != "0",
         lane_block=os.environ.get("CIMBA_KERNEL_LANE_BLOCK", ""))
+    verify = os.environ.get("CIMBA_SWEEP_VERIFY", "0") != "0"
     with config.profile("f32"):
         spec, _ = mm1.build(record=False)
         for R in lanes:
@@ -64,6 +65,18 @@ def sweep():
                 jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0 / 0.9, 1.0, N)))
             )(jnp.arange(R))
             jax.block_until_ready(jax.tree.leaves(sims))
+            xref = None
+            if verify:
+                # CIMBA_SWEEP_VERIFY=1: cross-check each cell against
+                # the XLA path on the same sims — the first Mosaic
+                # EXECUTION of a new kernel configuration (e.g. the
+                # lane-block grid) must prove semantics, not just time
+                xout = jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+                jax.block_until_ready(jax.tree.leaves(xout))
+                xref = (
+                    int(xout.n_events.sum()),
+                    float(xout.clock.sum()),
+                )
             for chunk in chunks:
                 try:
                     krun = pr.make_kernel_run(spec, chunk_steps=chunk)
@@ -74,9 +87,15 @@ def sweep():
                     jax.block_until_ready(jax.tree.leaves(kout))
                     dt = time.perf_counter() - t0
                     ev_n = int(kout.n_events.sum())
-                    log(phase="cell", R=R, chunk=chunk, events=ev_n,
-                        wall_s=dt, rate=ev_n / dt,
-                        failed=int((kout.err != 0).sum()))
+                    cell = dict(phase="cell", R=R, chunk=chunk,
+                                events=ev_n, wall_s=dt, rate=ev_n / dt,
+                                failed=int((kout.err != 0).sum()))
+                    if xref is not None:
+                        cell["events_match_xla"] = ev_n == xref[0]
+                        cell["clock_sum_match_xla"] = (
+                            float(kout.clock.sum()) == xref[1]
+                        )
+                    log(**cell)
                 except Exception as e:  # keep sweeping other cells
                     log(phase="cell_error", R=R, chunk=chunk,
                         error=str(e)[:300])
