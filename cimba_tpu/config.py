@@ -96,6 +96,22 @@ class _DtypeHandle:
     def __repr__(self):
         return f"config.{self._name}(={self.dtype.name})"
 
+    # Hash/eq follow the CURRENT resolution, not object identity: jax's
+    # ``canonicalize_dtype`` memoizes on the dtype argument, and with
+    # id-based hashing the first profile to resolve a handle poisoned
+    # every later trace under the other profile (f64 clocks inside an
+    # f32 trace — the cross-profile branch-dtype mismatches this fixes).
+    def __hash__(self):
+        return hash(self.dtype)
+
+    def __eq__(self, other):
+        if isinstance(other, _DtypeHandle):
+            return self.dtype == other.dtype
+        try:
+            return self.dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
 
 TIME = _DtypeHandle("TIME_DTYPE")
 REAL = _DtypeHandle("REAL_DTYPE")
@@ -149,6 +165,16 @@ def profile(name: str):
         yield
     finally:
         use_profile(prev)
+
+
+def x64_scope(enable: bool):
+    """``jax.enable_x64(enable)`` across jax versions (older releases only
+    ship the context manager under ``jax.experimental``)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enable)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(enable)
 
 
 def setup() -> None:

@@ -192,10 +192,20 @@ def eval_bool32(jaxpr, consts, *args):
             else:
                 write(eqn, [eqn.primitive.bind(*ins, **eqn.params)])
         elif prim in ("reduce_or", "reduce_and") and in_bool[0]:
-            red = lax.reduce_max if prim == "reduce_or" else lax.reduce_min
+            # bind the reduction primitive directly: older jax has no
+            # lax.reduce_max/reduce_min function wrappers
+            red_p = (
+                lax.reduce_max_p if prim == "reduce_or" else lax.reduce_min_p
+            )
             write(
                 eqn,
-                [_B(c32=red(ins[0].carrier(), axes=eqn.params["axes"]))],
+                [
+                    _B(
+                        c32=red_p.bind(
+                            ins[0].carrier(), axes=eqn.params["axes"]
+                        )
+                    )
+                ],
             )
         elif prim == "while":
             write(eqn, _bind_while(eqn, carriers(eqn, ins), out_bool))

@@ -51,6 +51,8 @@ from cimba_tpu.core import eventset as ev
 from cimba_tpu.core import guard as gd
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import ModelSpec
+from cimba_tpu.obs import metrics as obs_metrics
+from cimba_tpu.obs import trace as obs_trace
 from cimba_tpu.random import bits as rb
 from cimba_tpu.stats import timeseries as ts
 
@@ -132,6 +134,12 @@ class Sim(NamedTuple):
     #: kernel path only: this lane's next dispatch targets a boundary
     #: block — the chunk freezes it for the host driver (pallas_run)
     boundary_pending: jnp.ndarray
+    #: flight recorder ring (obs.trace.TraceRing) or None — None prunes
+    #: the leaves from the pytree, so a disabled recorder costs zero ops
+    #: (the logger's NLOGINFO story, as state instead of lines)
+    trace: Any = None
+    #: metrics registry (obs.metrics.Metrics) or None, same contract
+    metrics: Any = None
 
 
 def _tree_select(pred, a, b):
@@ -262,6 +270,14 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         ),
         n_events=jnp.zeros((), config.COUNT),
         boundary_pending=jnp.asarray(False),
+        # observability state is trace-time gated like the logger mask:
+        # disabled (the default) carries no arrays at all
+        trace=obs_trace.create() if obs_trace.enabled() else None,
+        metrics=obs_metrics.create(
+            N_KINDS + len(spec.user_handlers), len(spec.queues)
+        )
+        if obs_metrics.enabled()
+        else None,
     )
 
 
@@ -1336,6 +1352,11 @@ def _make_apply(spec: ModelSpec, used_tags=None):
                 got=dyn.dset(sim.procs.got, p, item, ok_get)
             ),
         )
+        if sim.metrics is not None:
+            # queue-length high-water ratchet, gated by the same ok as
+            # the size write (gate=False lanes write nothing — the
+            # _gated no-op contract holds bitwise)
+            sim = obs_metrics.on_queue_len(sim, qid, size + dsz, ok)
         # signal order preserved from the split handlers (wake seqs are
         # order-assigned): a get signals rear (space) then front
         # (leftover items); a put frees no space, so only the getter
@@ -2051,7 +2072,14 @@ def make_step(spec: ModelSpec):
             if config.KERNEL_MODE
             else (n >= MAX_CHAIN)
         )
-        return _set_err(sim, runaway, ERR_CHAIN_RUNAWAY)
+        sim = _set_err(sim, runaway, ERR_CHAIN_RUNAWAY)
+        if sim.metrics is not None:
+            # n == 0 exactly when the resume was gated off, so the hook's
+            # own ran-gate preserves the "gated-off resume output IS the
+            # input" contract on_proc rests on (per-lane values; the
+            # masked adds contribute zero there)
+            sim = obs_metrics.on_resume(sim, n, use_pend0)
+        return sim
 
     def on_proc(sim: Sim, subj, arg, gate):
         # NO merge at all: resume pred-gates every preamble write by
@@ -2107,6 +2135,14 @@ def make_step(spec: ModelSpec):
             not_deferred = True
         out_of_events = ~event.found  # BEFORE the boundary defer masks it
         event = event._replace(found=proceed)
+        # event-set occupancy BEFORE the consume (the popped event still
+        # pends): the high-water gauge of how close this replication came
+        # to ERR_EVENT_OVERFLOW.  Computed only when a registry is carried
+        # — the [CAP]+[P] reductions stay out of the metrics-off trace.
+        if sim.metrics is not None:
+            occupancy = ev.length(sim.events) + jnp.sum(
+                jnp.isfinite(sim.wakes.time).astype(_I), dtype=_I
+            )
         es2, wk2 = ev.consume_merged(
             sim.events, sim.wakes, take_e, take_w, proceed
         )
@@ -2117,6 +2153,14 @@ def make_step(spec: ModelSpec):
             n_events=sim.n_events
             + jnp.where(proceed, 1, 0).astype(config.COUNT),
         )
+        # the flight-recorder/metrics hooks return sim UNCHANGED (the
+        # same object — zero traced ops) when the Sim carries no
+        # ring/registry; with one, this is THE dispatch-site write
+        sim = obs_trace.emit(
+            sim, event.time, event.subj, event.kind, event.arg, proceed
+        )
+        if sim.metrics is not None:
+            sim = obs_metrics.on_dispatch(sim, event.kind, occupancy, proceed)
         if _may_wait_events(spec, sim):
             # wake event-waiters before the action runs (reference order,
             # `src/cmb_event.c:312-314`); statically absent from models

@@ -497,7 +497,7 @@ def make_kernel_run(
                     spec, jax.tree.map(lambda x: x[0], sims)
                 )
             _validated.append(True)
-        with jax.enable_x64(False):
+        with config.x64_scope(False):
             return _run(sims)
 
     _built = {}  # (treedef, leaf avals) -> (chunk_jit, alive_jit)
@@ -520,7 +520,8 @@ def make_kernel_run(
                 # per-device kernel: build the chunk at LOCAL lane width
                 # (L is a static kernel shape), then shard_map it over
                 # the minor lane axis
-                from jax import shard_map
+                # version-compat import (see runner.experiment.shard_map)
+                from cimba_tpu.runner.experiment import shard_map
 
                 n_dev = mesh.devices.size
                 L = leaves[0].shape[-1]

@@ -1,0 +1,35 @@
+"""Observability: flight recorder, metrics registry, exporters, profiling.
+
+The reference makes its event loop inspectable with ``cmb_logger``
+flag-mask lines and ``cmb_event_queue_print`` golden dumps; this package
+is the TPU-native half of that story — observability that lives *inside*
+the compiled program as arrays, because a host callback cannot cross an
+XLA while-loop iteration (let alone a Mosaic kernel) without serializing
+the run it is meant to observe.
+
+Three parts, all trace-time gated like :mod:`cimba_tpu.utils.logger`
+(disabled = literally zero ops in the jaxpr):
+
+* :mod:`~cimba_tpu.obs.trace` — the **flight recorder**: a
+  capacity-bounded on-device ring buffer ``(t, pid, kind, arg, seq)``
+  written at the dispatch site in ``core/loop.py``.  One ring per
+  replication under ``vmap``.
+* :mod:`~cimba_tpu.obs.metrics` — the **metrics registry**: named
+  counters/gauges/histograms carried as Sim arrays (dispatches by kind,
+  queue high-water marks, guard retries, chain-length histogram),
+  pooled across replications and over ICI.
+* :mod:`~cimba_tpu.obs.export` / :mod:`~cimba_tpu.obs.prof` —
+  **exporters and profiling**: Chrome-trace/Perfetto JSON of a
+  replication's ring, and a :class:`~cimba_tpu.obs.prof.RunReport`
+  capturing the compile-vs-execute wall-time split, device memory and a
+  metrics snapshot.
+
+Kernel-path contract (docs/07): both the recorder and the metrics
+registry raise a loud build-time error when an enabled instance is
+traced under ``config.KERNEL_MODE`` — mirroring ``logger._emit``.
+"""
+
+from cimba_tpu.obs import metrics, trace  # noqa: F401
+
+# export and prof are imported lazily by callers (they pull in numpy/json
+# and the runner surface; the hot loop only ever needs trace/metrics)

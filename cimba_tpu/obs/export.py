@@ -1,0 +1,153 @@
+"""Exporters: flight-recorder rings as Chrome-trace / Perfetto JSON.
+
+The exported dict follows the Trace Event Format (the ``chrome://tracing``
+/ Perfetto JSON schema): a ``traceEvents`` list of instant events, one per
+recorded dispatch, with ``pid`` = replication index and ``tid`` = the
+event subject (process id), so Perfetto's process/thread tracks render
+replications as processes and simulated processes as threads.  Name
+tables come from the model spec — the same tables
+:mod:`cimba_tpu.utils.debug` renders golden dumps with.
+
+Timestamps: Chrome trace ``ts`` is microseconds; simulated time is
+unitless, so one simulated time unit is exported as one second
+(``ts = t * 1e6``) to keep sub-unit event spacing visible.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from cimba_tpu.obs import metrics as _metrics
+from cimba_tpu.obs import trace as _trace
+from cimba_tpu.utils.debug import kind_name as _kind_name
+from cimba_tpu.utils.debug import subj_name as _subj_name
+
+#: top-level keys every export carries (the CI smoke validates these)
+REQUIRED_KEYS = ("traceEvents", "displayTimeUnit", "otherData")
+
+#: microseconds per simulated time unit in the exported ``ts``
+TS_SCALE = 1e6
+
+
+def _lane(sims, r):
+    import jax
+
+    return jax.tree.map(lambda x: x[r], sims)
+
+
+def chrome_trace(sims, spec=None) -> dict:
+    """Build the Chrome-trace dict from a Sim (single replication or a
+    batched one — every lane's ring becomes one trace-viewer process).
+
+    Raises if the Sim carries no ring (recorder was disabled at init)."""
+    batched = np.ndim(np.asarray(sims.clock)) > 0
+    lanes = range(np.asarray(sims.clock).shape[0]) if batched else (None,)
+
+    events = []
+    total = 0
+    for r in lanes:
+        sim = _lane(sims, r) if r is not None else sims
+        # the JSON pid is the LANE index (unique by construction), not
+        # sim.rep: lanes may legitimately share a replication id (e.g. a
+        # seed sweep at replication=0), and colliding pids would merge
+        # their tracks; rep is kept in the process_name metadata
+        rep = int(sim.rep)
+        pid_track = r if r is not None else rep
+        if sim.trace is None:
+            raise ValueError(
+                "chrome_trace: Sim carries no flight-recorder ring — "
+                "call obs.trace.enable() before init_sim/run"
+            )
+        ring = _trace.unwrap(sim.trace)
+        total += len(ring["seq"])
+        seen_tids = {}
+        for t, pid, kind, arg, seq in zip(
+            ring["t"], ring["pid"], ring["kind"], ring["arg"], ring["seq"]
+        ):
+            pid, kind = int(pid), int(kind)
+            events.append(
+                {
+                    "name": f"{_kind_name(kind, spec)} "
+                    f"{_subj_name(pid, kind, spec)}",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": float(t) * TS_SCALE,
+                    "pid": pid_track,
+                    "tid": pid,
+                    "args": {
+                        "kind": kind,
+                        "arg": int(arg),
+                        "seq": int(seq),
+                    },
+                }
+            )
+            seen_tids.setdefault(pid, _subj_name(pid, kind, spec))
+        # metadata rows name the tracks (Trace Event Format "M" events)
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid_track,
+                "args": {"name": f"replication {rep}"},
+            }
+        )
+        for tid, name in sorted(seen_tids.items()):
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid_track,
+                    "tid": tid,
+                    "args": {"name": name},
+                }
+            )
+
+    other = {
+        "model": spec.name if spec is not None else "?",
+        "recorded_events": total,
+        "ts_unit": "1 simulated time unit = 1 s",
+    }
+    if getattr(sims, "metrics", None) is not None:
+        m = sims.metrics
+        if batched:
+            import jax
+
+            m = jax.jit(_metrics.pool)(m)
+        other["metrics"] = _metrics.snapshot(m, spec)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def dump_chrome_trace(path: str, sims, spec=None) -> dict:
+    """Export to ``path`` (JSON); returns the dict that was written."""
+    doc = chrome_trace(sims, spec)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Structural check used by the CI smoke: required top-level keys,
+    non-empty events, per-event required fields, and per-replication
+    monotone timestamps (dispatch order is time order)."""
+    for k in REQUIRED_KEYS:
+        if k not in doc:
+            raise ValueError(f"chrome trace missing top-level key {k!r}")
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    if not evs:
+        raise ValueError("chrome trace has no events")
+    last_ts: dict = {}
+    for e in evs:
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in e:
+                raise ValueError(f"trace event missing {k!r}: {e}")
+        if e["ts"] < last_ts.get(e["pid"], float("-inf")):
+            raise ValueError(
+                f"timestamps not monotone within replication {e['pid']}"
+            )
+        last_ts[e["pid"]] = e["ts"]

@@ -1,0 +1,232 @@
+"""The metrics registry: named counters/gauges/histograms as Sim arrays.
+
+Reference parity: the reference has no first-class metrics — you grep its
+logger output.  Here the dispatcher's own health signals are carried as
+arrays *inside* the jitted program and pooled exactly like the model's
+statistics: summed/maxed across vmap lanes, and over ICI via the same
+``all_gather``/``psum`` path ``make_sharded_experiment`` uses for Pébay
+summaries (counters and histogram bins are plain sums, so ``psum`` does
+pool them; high-water gauges pool with ``pmax``).
+
+Registry (fixed per spec, sized at ``init_sim``):
+
+* ``dispatch_by_kind`` [NK] — events dispatched per kind (K_PROC,
+  K_TIMER, user handlers); their sum is ``events_dispatched`` and equals
+  ``sim.n_events``.
+* ``guard_retries`` — pended commands re-attempted on a SUCCESS wake
+  (the guard fairness protocol's retry arm firing).
+* ``queue_hwm`` [NQ] — per-objectqueue length high-water mark.
+* ``event_hwm`` — future-event-set occupancy high-water mark (general
+  table + armed dense wakes): how close the run came to
+  ``ERR_EVENT_OVERFLOW``.
+* ``chain_hist`` [CHAIN_BINS] — histogram of blocks chained per dispatch
+  (bin i = chain length i+1; last bin is overflow): the
+  kernel-path cost model's central quantity, measured instead of guessed.
+
+Trace-time gating mirrors :mod:`cimba_tpu.obs.trace`: disabled means
+``Sim.metrics is None`` and every hook returns its input Sim object —
+zero ops.  An enabled registry traced under ``config.KERNEL_MODE``
+raises at build time (see the kernel-path contract in docs/07).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import dyn
+
+_I = INDEX_DTYPE
+_C = config.COUNT
+
+#: chain-length histogram bins: lengths 1..CHAIN_BINS-1, last bin = longer
+CHAIN_BINS = 8
+
+_enabled = False
+
+
+class Metrics(NamedTuple):
+    """One replication's registry (pooled shapes are identical)."""
+
+    dispatch_by_kind: jnp.ndarray  # [NK] COUNT
+    guard_retries: jnp.ndarray     # COUNT
+    queue_hwm: jnp.ndarray         # [NQ] i32
+    event_hwm: jnp.ndarray         # i32
+    chain_hist: jnp.ndarray        # [CHAIN_BINS] COUNT
+
+
+def enable() -> None:
+    """Enable the registry for subsequently *traced* runs (re-jit to take
+    effect, like ``logger.flags_on``)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def create(n_kinds: int, n_queues: int) -> Metrics:
+    """A zeroed registry; called by ``init_sim`` when enabled."""
+    return Metrics(
+        dispatch_by_kind=jnp.zeros((max(n_kinds, 1),), _C),
+        guard_retries=jnp.zeros((), _C),
+        queue_hwm=jnp.zeros((max(n_queues, 1),), _I),
+        event_hwm=jnp.zeros((), _I),
+        chain_hist=jnp.zeros((CHAIN_BINS,), _C),
+    )
+
+
+def _kernel_check() -> None:
+    if config.KERNEL_MODE:
+        raise RuntimeError(
+            "obs.metrics: metrics registry updates inside the Pallas "
+            "kernel path — carrying the registry through the chunked "
+            "kernel must be a deliberate choice, not a leftover global "
+            "flag.  Disable metrics for kernel runs "
+            "(obs.metrics.disable()) or run on the XLA while-loop path "
+            "(cl.make_run).  See docs/07_kernel_path.md."
+        )
+
+
+# --- update hooks (called from core/loop.py; no-ops when disabled) ---------
+
+
+def on_dispatch(sim, kind, occupancy, pred):
+    """Per dispatched event: count its kind and track event-set occupancy
+    high-water (``occupancy`` = general-table live + armed wakes)."""
+    m = sim.metrics
+    if m is None:
+        return sim
+    _kernel_check()
+    nk = m.dispatch_by_kind.shape[0]
+    k = jnp.clip(jnp.asarray(kind, _I), 0, nk - 1)
+    occ = jnp.where(pred, jnp.asarray(occupancy, _I), m.event_hwm)
+    return sim._replace(
+        metrics=m._replace(
+            dispatch_by_kind=dyn.dadd(
+                m.dispatch_by_kind, k, jnp.ones((), _C), pred
+            ),
+            event_hwm=jnp.maximum(m.event_hwm, occ),
+        )
+    )
+
+
+def on_resume(sim, n_chain, retried):
+    """Per resume: chain-length histogram and the guard-retry counter.
+    ``n_chain`` is the chain loop's iteration count (0 when the resume
+    was gated off — those are not counted)."""
+    m = sim.metrics
+    if m is None:
+        return sim
+    _kernel_check()
+    ran = jnp.asarray(n_chain, _I) > 0
+    bin_ = jnp.clip(jnp.asarray(n_chain, _I) - 1, 0, CHAIN_BINS - 1)
+    return sim._replace(
+        metrics=m._replace(
+            chain_hist=dyn.dadd(m.chain_hist, bin_, jnp.ones((), _C), ran),
+            guard_retries=m.guard_retries
+            + (jnp.asarray(retried) & ran).astype(_C),
+        )
+    )
+
+
+def on_queue_len(sim, qid, length, pred):
+    """Per successful queue verb: ratchet the queue's high-water mark.
+    Every write is gated by ``pred`` (the handler's ok-and-gate), so the
+    hook is legal inside a ``_gated`` handler."""
+    m = sim.metrics
+    if m is None:
+        return sim
+    _kernel_check()
+    length = jnp.asarray(length, _I)
+    cur = dyn.dget(m.queue_hwm, qid)
+    return sim._replace(
+        metrics=m._replace(
+            queue_hwm=dyn.dset(
+                m.queue_hwm, qid, jnp.maximum(cur, length), pred
+            )
+        )
+    )
+
+
+# --- pooling ----------------------------------------------------------------
+
+
+def events_dispatched(m: Metrics):
+    """Total events across kinds (equals ``sim.n_events`` per lane, or
+    their sum after pooling)."""
+    return jnp.sum(m.dispatch_by_kind)
+
+
+def pool(m: Metrics) -> Metrics:
+    """Pool a batched registry (leading axis R) into one: counters and
+    histogram bins sum — associative and commutative, so the merge is
+    order-independent — and high-water gauges take the max."""
+    return Metrics(
+        dispatch_by_kind=jnp.sum(m.dispatch_by_kind, axis=0),
+        guard_retries=jnp.sum(m.guard_retries, axis=0),
+        queue_hwm=jnp.max(m.queue_hwm, axis=0),
+        event_hwm=jnp.max(m.event_hwm, axis=0),
+        chain_hist=jnp.sum(m.chain_hist, axis=0),
+    )
+
+
+def pool_across(m: Metrics, axis_name: str) -> Metrics:
+    """Pool an (already lane-pooled) registry across a mesh axis inside
+    ``shard_map`` — the ICI leg: ``psum`` for the summable fields,
+    ``pmax`` for the high-water gauges (the same collective layer
+    ``make_sharded_experiment`` rides for summaries)."""
+    return Metrics(
+        dispatch_by_kind=jax.lax.psum(m.dispatch_by_kind, axis_name),
+        guard_retries=jax.lax.psum(m.guard_retries, axis_name),
+        queue_hwm=jax.lax.pmax(m.queue_hwm, axis_name),
+        event_hwm=jax.lax.pmax(m.event_hwm, axis_name),
+        chain_hist=jax.lax.psum(m.chain_hist, axis_name),
+    )
+
+
+def snapshot(m: Metrics, spec=None, regrows: Optional[int] = None) -> dict:
+    """Host-side: the registry as a JSON-able dict, with names resolved
+    from the model spec where one is given (kind/queue name tables, the
+    same ones ``utils.debug`` renders with).  ``regrows`` attaches the
+    runner's host-side capacity-regrow count when the caller has one."""
+    import numpy as np
+
+    from cimba_tpu.utils.debug import kind_name
+
+    by_kind = np.asarray(m.dispatch_by_kind)
+    dispatch = {}
+    for k in range(by_kind.shape[0]):
+        name = kind_name(k, spec)
+        if name in dispatch:  # duplicate handler names must not collide
+            name = f"{name}#{k}"
+        dispatch[name] = int(by_kind[k])
+    q_names = (
+        [q.name for q in spec.queues] if spec and spec.queues else None
+    )
+    hwm = np.asarray(m.queue_hwm)
+    queue_hwm = {
+        (q_names[i] if q_names and i < len(q_names) else f"q{i}"): int(hwm[i])
+        for i in range(hwm.shape[0])
+    }
+    out = {
+        "events_dispatched": int(by_kind.sum()),
+        "dispatch_by_kind": dispatch,
+        "guard_retries": int(m.guard_retries),
+        "queue_hwm": queue_hwm,
+        "event_hwm": int(m.event_hwm),
+        "chain_hist": [int(c) for c in np.asarray(m.chain_hist)],
+    }
+    if regrows is not None:
+        out["regrows"] = int(regrows)
+    return out

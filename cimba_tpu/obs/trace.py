@@ -1,0 +1,154 @@
+"""The flight recorder: an on-device dispatch-event ring buffer.
+
+Reference parity: ``cmb_event_queue_print`` shows the *pending* events at
+one instant; the flight recorder keeps the last ``capacity`` events the
+dispatcher *actually executed* — what a scheduler log would show, but as
+arrays inside the jitted program ("observability must live inside the
+compiled program" — the per-event host callback a naive log would need
+serializes the very loop it observes).
+
+Design, mirroring :mod:`cimba_tpu.utils.logger`:
+
+* **Trace-time gating.**  :func:`enable`/:func:`disable` flip a Python
+  global read while *tracing*; with the recorder disabled, ``Sim.trace``
+  is ``None`` (the pytree prunes the leaves) and :func:`emit` returns the
+  Sim object it was given — the dispatch site traces to literally zero
+  ops.  Re-jit after flipping, exactly like logger flags.
+* **Struct-of-arrays ring.**  ``(t, pid, kind, arg, seq)`` slots plus a
+  monotone ``count``; slot ``count % capacity`` is overwritten, so the
+  ring always holds the *last* ``min(count, capacity)`` dispatches.
+  ``seq`` is the global dispatch index, so a wrapped ring still tells you
+  exactly which events it kept.
+* **Batched by vmap.**  The ring rides the Sim pytree: one independent
+  ring per replication, sharded with the Sim over a mesh.
+* **Kernel-path contract** (docs/07): an enabled recorder reached while
+  tracing under ``config.KERNEL_MODE`` raises HERE, loudly, at build time
+  — mirroring ``logger._emit``.  The ring's writes are Mosaic-legal ops,
+  but its contents only mean something host-side, and hauling the ring
+  through the chunked kernel carry is a cost the kernel path must opt
+  into deliberately, not inherit from a leftover global flag.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from cimba_tpu import config
+from cimba_tpu.config import INDEX_DTYPE
+from cimba_tpu.core import dyn
+
+_I = INDEX_DTYPE
+_T = config.TIME
+
+#: default ring capacity (events kept per replication)
+DEFAULT_CAPACITY = 256
+
+_enabled = False
+_capacity = DEFAULT_CAPACITY
+
+
+class TraceRing(NamedTuple):
+    """One replication's last ``capacity`` dispatched events."""
+
+    t: jnp.ndarray      # [CAP] TIME — dispatch clock
+    pid: jnp.ndarray    # [CAP] i32 — event subject (process id / user subj)
+    kind: jnp.ndarray   # [CAP] i32 — dispatch kind (K_PROC/K_TIMER/user)
+    arg: jnp.ndarray    # [CAP] i32 — event payload (signal code / user arg)
+    seq: jnp.ndarray    # [CAP] i32 — global dispatch index; -1 = never written
+    count: jnp.ndarray  # i32 — total dispatches recorded (wrap detector)
+
+
+def enable(capacity: int = DEFAULT_CAPACITY) -> None:
+    """Enable the recorder for subsequently *traced* runs (re-jit to take
+    effect, like ``logger.flags_on``).  ``capacity`` bounds device memory:
+    5 arrays x capacity per replication."""
+    global _enabled, _capacity
+    if capacity <= 0:
+        raise ValueError(f"trace capacity must be positive, got {capacity}")
+    _enabled = True
+    _capacity = int(capacity)
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def capacity() -> int:
+    return _capacity
+
+
+def create(cap: int | None = None) -> TraceRing:
+    """A fresh (empty) ring; called by ``init_sim`` when enabled."""
+    cap = _capacity if cap is None else int(cap)
+    return TraceRing(
+        t=jnp.zeros((cap,), _T),
+        pid=jnp.zeros((cap,), _I),
+        kind=jnp.zeros((cap,), _I),
+        arg=jnp.zeros((cap,), _I),
+        seq=jnp.full((cap,), -1, _I),
+        count=jnp.zeros((), _I),
+    )
+
+
+def _kernel_check() -> None:
+    if config.KERNEL_MODE:
+        raise RuntimeError(
+            "obs.trace: flight-recorder emission inside the Pallas kernel "
+            "path — the ring's contents are host-export state and hauling "
+            "them through the chunked kernel carry must be a deliberate "
+            "choice, not a leftover global flag.  Disable the recorder for "
+            "kernel runs (obs.trace.disable(), the logger.flags_off "
+            "analog) or run this model on the XLA while-loop path "
+            "(cl.make_run).  See docs/07_kernel_path.md."
+        )
+
+
+def emit(sim, t, pid, kind, arg, pred):
+    """Record one dispatched event, gated by ``pred`` (the dispatcher's
+    event-found predicate).  Returns ``sim`` unchanged — the *same
+    object*, zero traced ops — when the Sim carries no ring."""
+    ring = sim.trace
+    if ring is None:
+        return sim
+    _kernel_check()
+    cap = ring.t.shape[0]
+    slot = jnp.mod(ring.count, cap)
+    armed = jnp.asarray(pred)
+    ring2 = TraceRing(
+        t=dyn.dset(ring.t, slot, jnp.asarray(t, _T), pred),
+        pid=dyn.dset(ring.pid, slot, jnp.asarray(pid, _I), pred),
+        kind=dyn.dset(ring.kind, slot, jnp.asarray(kind, _I), pred),
+        arg=dyn.dset(ring.arg, slot, jnp.asarray(arg, _I), pred),
+        seq=dyn.dset(ring.seq, slot, ring.count, pred),
+        count=ring.count + armed.astype(_I),
+    )
+    return sim._replace(trace=ring2)
+
+
+def unwrap(ring: TraceRing):
+    """Host-side: the ring's valid entries in dispatch order.
+
+    Returns a dict of numpy arrays ``{t, pid, kind, arg, seq}`` sorted by
+    ``seq`` (the global dispatch index), holding the last
+    ``min(count, capacity)`` recorded events.  Fetch one lane of a
+    batched Sim first (``jax.tree.map(lambda x: x[r], sims)``), as with
+    :mod:`cimba_tpu.utils.debug`.
+    """
+    import numpy as np
+
+    seq = np.asarray(ring.seq)
+    valid = seq >= 0
+    order = np.argsort(seq[valid], kind="stable")
+    out = {}
+    for name in ("t", "pid", "kind", "arg", "seq"):
+        out[name] = np.asarray(getattr(ring, name))[valid][order]
+    out["count"] = int(ring.count)
+    out["capacity"] = int(seq.shape[0])
+    return out

@@ -31,7 +31,22 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # older jax keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def shard_map(f, **kw):
+    """``jax.shard_map`` across jax versions: older releases live under
+    ``jax.experimental`` and spell ``check_vma`` as ``check_rep``."""
+    import inspect
+
+    if "check_vma" in kw and (
+        "check_vma" not in inspect.signature(_shard_map_impl).parameters
+    ):
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map_impl(f, **kw)
 
 from cimba_tpu.core.loop import Sim, init_sim, make_run
 from cimba_tpu.core.model import ModelSpec
@@ -73,13 +88,22 @@ def run_experiment(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     t_end: Optional[float] = None,
-) -> ExperimentResult:
+    with_report: bool = False,
+    profile_dir: Optional[str] = None,
+):
     """Run ``n_replications`` independent replications of ``spec``.
 
     ``params`` is the experiment array (reference: the user's trial struct
     array): a pytree whose leaves are either scalars (shared by all
     replications) or arrays with leading axis ``n_replications`` (a
     parameter sweep — the M/G/1 4x5x10 sweep pattern).
+
+    ``with_report=True`` returns ``(ExperimentResult, obs.prof.RunReport)``
+    instead: the run goes through the AOT path so the report carries the
+    trace/compile/execute wall-time split, plus device memory stats and —
+    when the metrics registry is enabled — the pooled metrics snapshot.
+    ``profile_dir`` additionally wraps the execute leg in a
+    ``jax.profiler.trace`` context writing there.
     """
     run = make_run(spec, t_end=t_end)
     pb = _broadcast_params(params, n_replications)
@@ -90,8 +114,9 @@ def run_experiment(
 
     vm = jax.vmap(one)
 
+    timings = None
     if mesh is None:
-        sims = jax.jit(vm)(reps, pb)
+        fn = vm
     else:
         n_dev = mesh.devices.size
         if n_replications % n_dev:
@@ -111,13 +136,38 @@ def run_experiment(
         def sharded(reps_local, p_local):
             return vm(reps_local, p_local)
 
-        sims = jax.jit(sharded)(reps, pb)
+        fn = sharded
 
-    return ExperimentResult(
+    if with_report:
+        from cimba_tpu.obs import prof as _prof
+
+        sims, timings = _prof.profiled_call(
+            jax.jit(fn), reps, pb, profile_dir=profile_dir
+        )
+    else:
+        sims = jax.jit(fn)(reps, pb)
+
+    result = ExperimentResult(
         sims=sims,
         n_failed=jnp.sum((sims.err != 0).astype(jnp.int32)),
         total_events=jnp.sum(sims.n_events),
     )
+    if not with_report:
+        return result
+    from cimba_tpu.obs import metrics as _metrics
+
+    snap = None
+    if sims.metrics is not None:
+        snap = _metrics.snapshot(jax.jit(_metrics.pool)(sims.metrics), spec)
+    report = _prof.build_report(
+        timings,
+        n_replications=n_replications,
+        n_failed=int(result.n_failed),
+        total_events=int(result.total_events),
+        metrics=snap,
+        profile_dir=profile_dir,
+    )
+    return result, report
 
 
 def run_experiment_regrow(
@@ -187,15 +237,25 @@ def make_sharded_experiment(
     program (per-shard Pébay partials ride an all_gather over ICI, the
     scalar counters a psum).  Returns ``fn(params, seed=0) ->
     (pooled Summary, n_failed, total_events)`` — everything replicated.
+
+    When the metrics registry is enabled (``obs.metrics.enable()``) at
+    build time, the return gains a fourth element: the registry pooled
+    over lanes AND the mesh (psum for counters/histograms, pmax for
+    high-water gauges — the same ICI layer the summaries ride).  The
+    flag binds here, like logger flags bind at trace time: don't flip it
+    between build and run.
     """
+    from cimba_tpu.obs import metrics as _metrics
+
     run = make_run(spec, t_end=t_end)
     reps = jnp.arange(n_replications)
+    with_metrics = _metrics.enabled()
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(REP_AXIS), P(REP_AXIS), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P()) + ((P(),) if with_metrics else ()),
         check_vma=False,
     )
     def sharded(reps_local, p_local, seed):
@@ -203,6 +263,17 @@ def make_sharded_experiment(
             return run(init_sim(spec, seed, rep, p))
 
         sims = jax.vmap(one_seeded)(reps_local, p_local)
+        if (sims.metrics is None) == with_metrics:
+            # the flag bound at build time; init_sim re-reads it at trace
+            # time — fail with the subsystem's loud, named error instead
+            # of an opaque NoneType crash deep in the shard_map trace
+            raise RuntimeError(
+                "make_sharded_experiment: obs.metrics was "
+                f"{'enabled' if with_metrics else 'disabled'} when this "
+                "experiment was built but flipped before the first call "
+                "— the flag binds at build time (like logger flags at "
+                "trace time); rebuild the experiment after changing it"
+            )
         local = sm.merge_tree(summary_path(sims))
         # gather per-shard partial summaries over ICI, merge identically
         # everywhere (merge is not a plain sum, so psum cannot do it)
@@ -214,6 +285,11 @@ def make_sharded_experiment(
             jnp.sum((sims.err != 0).astype(jnp.int32)), REP_AXIS
         )
         events = jax.lax.psum(jnp.sum(sims.n_events), REP_AXIS)
+        if with_metrics:
+            pooled_metrics = _metrics.pool_across(
+                _metrics.pool(sims.metrics), REP_AXIS
+            )
+            return pooled, n_failed, events, pooled_metrics
         return pooled, n_failed, events
 
     def experiment(params, seed=0):

@@ -19,6 +19,27 @@ _KIND_NAMES = {0: "PROC", 1: "TIMER"}
 _STATUS = {0: "CREATED", 1: "RUNNING", 2: "FINISHED"}
 
 
+def kind_name(kind: int, spec: ModelSpec | None = None) -> str:
+    """Dispatch-kind label: framework kinds by name, user kinds by their
+    handler's ``__name__`` when a spec is given (the one name table both
+    the golden dumps and the Chrome-trace exporter render with)."""
+    if kind in _KIND_NAMES:
+        return _KIND_NAMES[kind]
+    if spec is not None:
+        u = kind - 2
+        if 0 <= u < len(spec.user_handlers):
+            return getattr(spec.user_handlers[u], "__name__", f"user{kind}")
+    return f"user{kind}"
+
+
+def subj_name(subj: int, kind: int, spec: ModelSpec | None = None) -> str:
+    """Event-subject label: process name for process/timer kinds, the raw
+    id otherwise (user kinds address arbitrary subjects)."""
+    if spec is not None and kind <= 1 and 0 <= subj < len(spec.proc_names):
+        return spec.proc_names[subj]
+    return str(subj)
+
+
 def eventset_str(sim, spec: ModelSpec | None = None) -> str:
     """Pending events in firing order (parity: cmb_event_queue_print)."""
     es = sim.events
@@ -63,12 +84,44 @@ def procs_str(sim, spec: ModelSpec | None = None) -> str:
     return "\n".join(rows)
 
 
+def trace_str(sim, spec: ModelSpec | None = None) -> str:
+    """Flight-recorder ring in dispatch order, in the golden-dump format
+    of :func:`eventset_str` (parity: what cmb_event_queue_print would
+    show for the events the dispatcher already ran).  Renders a one-line
+    notice when the Sim carries no ring (recorder disabled at init)."""
+    ring = getattr(sim, "trace", None)
+    if ring is None:
+        return "flight recorder: disabled"
+    from cimba_tpu.obs import trace as _trace
+
+    r = _trace.unwrap(ring)
+    rows = []
+    for t, pid, kind, arg, seq in zip(
+        r["t"], r["pid"], r["kind"], r["arg"], r["seq"]
+    ):
+        kind = int(kind)
+        rows.append(
+            f"  t={float(t):<14.6f} seq={int(seq):<6d} "
+            f"{kind_name(kind, spec):<6s} "
+            f"subj={subj_name(int(pid), kind, spec)} arg={int(arg)}"
+        )
+    head = (
+        f"flight recorder: {len(rows)} recorded of "
+        f"{r['count']} dispatched (cap {r['capacity']})"
+    )
+    return "\n".join([head] + rows)
+
+
 def sim_str(sim, spec: ModelSpec | None = None) -> str:
-    """One-replication overview."""
-    return (
+    """One-replication overview (includes the flight-recorder ring when
+    the Sim carries one)."""
+    out = (
         f"clock={float(sim.clock):.6f} err={int(sim.err)} "
         f"done={bool(sim.done)} events_dispatched={int(sim.n_events)}\n"
         + eventset_str(sim, spec)
         + "\n"
         + procs_str(sim, spec)
     )
+    if getattr(sim, "trace", None) is not None:
+        out += "\n" + trace_str(sim, spec)
+    return out

@@ -184,30 +184,49 @@ def user(bit: int, sim, p, fmt: str, *args, **kwargs):
     return sim
 
 
-def error(sim, p, fmt: str, *args, **kwargs):
-    """Log AND mark the replication failed (parity: cmb_logger_error's
-    abandon-this-trial recovery — the runner counts it, the batch
-    continues).
-
-    In-kernel, the failure-flag semantics are preserved but the log LINE
-    cannot cross the Mosaic boundary: it is dropped with a trace-time
-    Python warning (not the hard error info/warning raise — a model's
-    containment path must not make it un-compilable on the kernel)."""
+def _fail_level(level_name, bit, sim, p, fmt, args, kwargs):
+    """Shared body of :func:`error` and :func:`fatal`: log with the
+    replay stream id if the level is enabled, and mark the replication
+    failed either way.  In-kernel the failure-flag semantics are
+    preserved but the log LINE cannot cross the Mosaic boundary: it is
+    dropped with a trace-time Python warning (not the hard info/warning
+    raise — a model's containment path must not make it un-compilable
+    on the kernel)."""
     from cimba_tpu import config as _cfg
     from cimba_tpu.core import api
 
-    if _mask & ERROR:
+    if _mask & bit:
         if _cfg.KERNEL_MODE:
             import warnings
 
             warnings.warn(
-                "logger.error inside the Pallas kernel path: the "
+                f"logger.{level_name} inside the Pallas kernel path: the "
                 "replication failure flag is preserved, but the log "
                 "line is dropped (host callbacks cannot cross a Mosaic "
                 "kernel; docs/07_kernel_path.md).  Inspect sim.err and "
                 "the replay key host-side instead.",
-                stacklevel=2,
+                stacklevel=3,
             )
         else:
-            _emit_with_seed("error", sim, p, fmt, *args, **kwargs)
+            _emit_with_seed(level_name, sim, p, fmt, *args, **kwargs)
     return api.fail(sim)
+
+
+def fatal(sim, p, fmt: str, *args, **kwargs):
+    """Log at the reserved FATAL level AND mark the replication failed.
+
+    Parity: the reference reserves the FATAL bit (the lowest of the 4
+    ``CMB_LOGGER_*`` levels) for errors the run cannot recover from.
+    Under the batch model nothing is allowed to take down the *process*
+    — so fatal's containment is the same as :func:`error`'s (the
+    replication freezes with ``sim.err`` set and the runner counts it);
+    the distinction is the level tag, and that silencing the level must
+    not unfail the replication."""
+    return _fail_level("fatal", FATAL, sim, p, fmt, args, kwargs)
+
+
+def error(sim, p, fmt: str, *args, **kwargs):
+    """Log AND mark the replication failed (parity: cmb_logger_error's
+    abandon-this-trial recovery — the runner counts it, the batch
+    continues)."""
+    return _fail_level("error", ERROR, sim, p, fmt, args, kwargs)

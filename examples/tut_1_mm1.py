@@ -16,6 +16,11 @@ cimba-tpu, where the "parallelize" step is one vmap:
     confidence interval.  Theory check: Lq = ρ²/(1-ρ).
 
 Run:  python examples/tut_1_mm1.py
+
+Observability (docs/10): set ``CIMBA_TRACE=1`` to re-run a 2-replication
+slice with the flight recorder + metrics registry enabled and export a
+Chrome-trace/Perfetto JSON (path: ``CIMBA_TRACE_OUT``, default
+``trace_tut1.json``) — the CI obs smoke drives exactly this.
 """
 
 import os
@@ -102,7 +107,39 @@ def main():
     assert abs(mean - theory) < max(3 * half, 0.25 * theory), (
         mean, theory, half,
     )
+    if os.environ.get("CIMBA_TRACE"):
+        traced_run()
     return mean, half
+
+
+def traced_run():
+    """The observability pass (docs/10): the same model re-run with the
+    flight recorder + metrics registry on, exported as Chrome-trace JSON.
+    Small on purpose — tracing is for looking, the vmapped run above is
+    for measuring."""
+    from cimba_tpu.obs import export as oe
+    from cimba_tpu.obs import metrics as om
+    from cimba_tpu.obs import trace as ot
+
+    ot.enable(512)
+    om.enable()
+    try:
+        spec, _ = build()  # fresh spec: obs state binds at init/trace time
+        run = cl.make_run(spec, t_end=40.0)
+        sims = jax.jit(
+            jax.vmap(lambda r: run(cl.init_sim(spec, seed=2026, replication=r)))
+        )(jnp.arange(2))
+        out_path = os.environ.get("CIMBA_TRACE_OUT", "trace_tut1.json")
+        doc = oe.dump_chrome_trace(out_path, sims, spec)
+        oe.validate_chrome_trace(doc)
+        print(
+            f"flight recorder   : {doc['otherData']['recorded_events']} "
+            f"events from 2 replications -> {out_path}"
+        )
+        print(f"metrics           : {doc['otherData']['metrics']}")
+    finally:
+        ot.disable()
+        om.disable()
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
+import pytest
 
 ROUNDS = 6
 
@@ -100,6 +101,7 @@ def test_acquire_hold_matches_classic():
     assert int(a.user["svc"]) == int(b.user["svc"]) == 2 * ROUNDS
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_acquire_hold_kernel_matches_xla():
     with config.profile("f32"):
         spec = _build_res(fused=True)
@@ -428,6 +430,7 @@ def test_pq_fused_matches_classic():
     assert int(a.user["got_n"]) == int(b.user["got_n"])
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_pool_fused_kernel_matches_xla():
     with config.profile("f32"):
         spec = _build_pool(fused=True)

@@ -17,6 +17,7 @@ from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
+import pytest
 
 N_ITEMS = 12
 
@@ -130,6 +131,7 @@ def test_fused_matches_classic_deterministically():
     assert int(a.err) == int(b.err) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_pended_put_hold_kernel_matches_xla():
     with config.profile("f32"):
         spec = _build(fused=True)

@@ -18,6 +18,7 @@ from cimba_tpu import config
 from cimba_tpu.core import dyn
 from cimba_tpu.core import loop as cl
 from tools.kernel_cost import hist
+import pytest
 
 
 def _cost(spec, params):
@@ -35,6 +36,7 @@ def _cost(spec, params):
     return sum(c.values()), sum(ops.values())
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_mm1_step_cost_budget():
     from cimba_tpu.models import mm1
 

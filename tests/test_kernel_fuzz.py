@@ -25,6 +25,7 @@ from cimba_tpu.core import api, cmd
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run
 from cimba_tpu.core.model import Model
+import pytest
 
 L = 8  # lanes
 
@@ -242,6 +243,7 @@ _SEEDS = tuple(
 )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_fuzz_models_kernel_matches_xla():
     for seed in _SEEDS:
         xla, ker = _run_both(seed)
@@ -249,6 +251,7 @@ def test_fuzz_models_kernel_matches_xla():
         _check(xla, ker, seed)
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_fuzz_models_packed_carry_matches_xla():
     """The packed-carry chunk loop (pallas_run._pack_plan: 32-bit leaves
     concatenated into per-dtype [rows, L] buffers, bools passthrough)
@@ -260,6 +263,7 @@ def test_fuzz_models_packed_carry_matches_xla():
         _check(xla, ker, seed)
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_fuzz_model_no_failures():
     """The generated models are themselves healthy: no capacity or
     containment errors on either path."""

@@ -96,3 +96,58 @@ def test_error_in_kernel_keeps_fail_flag_drops_line():
                    for w in caught)
     # the containment semantics survived: every lane flagged failed
     assert np.all(np.asarray(ker.err) != 0)
+
+
+def _build_fatal_model():
+    m = Model("fatalm", n_ilocals=1, event_cap=4)
+
+    @m.block
+    def work(sim, p, sig):
+        sim = logger.fatal(sim, p, "unrecoverable n={0}", api.local_i(sim, p, 0))
+        return sim, cmd.exit_()
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def test_fatal_marks_replication_failed(capsys):
+    """The reserved FATAL bit (satellite): on the XLA path fatal logs a
+    line carrying the replay stream id AND freezes the replication like
+    error — the runner counts it, the batch continues."""
+    spec = _build_fatal_model()
+    sim = cl.init_sim(spec, 3, 0, None)
+    out = jax.jit(cl.make_run(spec))(sim)
+    jax.block_until_ready(out)
+    assert int(out.err) != 0
+    captured = capsys.readouterr().out
+    assert "[fatal]" in captured and "replay: key=" in captured
+
+
+def test_fatal_masked_out_when_level_off():
+    """FATAL is a mask bit like the others: with it off, the line traces
+    to nothing — but the failure-flag semantics are NOT maskable (the
+    model declared the state unrecoverable; silencing the log must not
+    unfail the replication)."""
+    logger.flags_off(logger.FATAL)
+    try:
+        spec = _build_fatal_model()
+        out = jax.jit(cl.make_run(spec))(cl.init_sim(spec, 3, 0, None))
+        assert int(out.err) != 0
+    finally:
+        logger.flags_on(logger.FATAL)
+
+
+def test_fatal_in_kernel_keeps_fail_flag_drops_line():
+    """In-kernel fatal mirrors error: the flag survives, the line is
+    dropped with a trace-time warning, the model stays compilable."""
+    with config.profile("f32"):
+        spec = _build_fatal_model()
+        sims = jax.vmap(lambda r: cl.init_sim(spec, 3, r, None))(
+            jnp.arange(4)
+        )
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ker = pallas_run.make_kernel_run(spec, interpret=True)(sims)
+        assert any("failure flag is preserved" in str(w.message)
+                   for w in caught)
+    assert np.all(np.asarray(ker.err) != 0)

@@ -1,0 +1,138 @@
+"""Metrics-registry tests: pooling across vmap lanes equals per-lane
+sums, histogram merge order-independence, the ICI (psum/pmax) leg through
+``make_sharded_experiment``, and the kernel-path build-time raise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cimba_tpu import config
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core import pallas_run
+from cimba_tpu.models import mm1
+from cimba_tpu.obs import metrics as om
+from cimba_tpu.obs import trace as ot
+from cimba_tpu.runner import experiment as ex
+
+
+@pytest.fixture
+def obs_off():
+    yield
+    ot.disable()
+    om.disable()
+
+
+def _run_mm1(R, n_objects, seed=1):
+    spec, _ = mm1.build(record=False)
+    run = cl.make_run(spec)
+    sims = jax.jit(
+        jax.vmap(lambda r: run(cl.init_sim(spec, seed, r, mm1.params(n_objects))))
+    )(jnp.arange(R))
+    return spec, sims
+
+
+def test_pooled_counters_equal_per_lane_sum(obs_off):
+    """pool() over vmapped registries == summing each lane's counters by
+    hand; high-water gauges == the per-lane max; and the pooled
+    events_dispatched equals the engine's own n_events total."""
+    om.enable()
+    spec, sims = _run_mm1(4, 60)
+    m = sims.metrics
+    pooled = jax.jit(om.pool)(m)
+    np.testing.assert_array_equal(
+        np.asarray(pooled.dispatch_by_kind),
+        np.asarray(m.dispatch_by_kind).sum(axis=0),
+    )
+    assert int(pooled.guard_retries) == int(
+        np.asarray(m.guard_retries).sum()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pooled.queue_hwm), np.asarray(m.queue_hwm).max(axis=0)
+    )
+    assert int(pooled.event_hwm) == int(np.asarray(m.event_hwm).max())
+    np.testing.assert_array_equal(
+        np.asarray(pooled.chain_hist), np.asarray(m.chain_hist).sum(axis=0)
+    )
+    assert int(om.events_dispatched(pooled)) == int(jnp.sum(sims.n_events))
+
+
+def test_histogram_merge_order_independent(obs_off):
+    """Pooling is a sum/max reduction — permuting the replication axis
+    must not change any pooled value (the associative+commutative merge
+    contract the Pébay summaries also honor)."""
+    om.enable()
+    _, sims = _run_mm1(6, 40, seed=3)
+    m = sims.metrics
+    perm = jnp.asarray([4, 0, 5, 2, 1, 3])
+    m_perm = jax.tree.map(lambda x: x[perm], m)
+    a = jax.jit(om.pool)(m)
+    b = jax.jit(om.pool)(m_perm)
+    for leaf_a, leaf_b in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+
+
+def test_metrics_content_sane(obs_off):
+    """mm1 semantics reflected in the registry: every dispatch is a
+    process resume (no timers/user events), the queue high-water is
+    within capacity, and blocked-get retries were counted."""
+    om.enable()
+    spec, sims = _run_mm1(2, 80)
+    pooled = om.pool(sims.metrics)
+    snap = om.snapshot(pooled, spec)
+    assert snap["dispatch_by_kind"]["TIMER"] == 0
+    assert snap["dispatch_by_kind"]["PROC"] == snap["events_dispatched"]
+    assert 1 <= snap["queue_hwm"]["buffer"] <= 128
+    assert snap["guard_retries"] > 0  # the server pends on an empty queue
+    assert sum(snap["chain_hist"]) == snap["events_dispatched"]
+    assert snap["event_hwm"] >= 1
+
+
+def test_sharded_experiment_pools_metrics_over_mesh(obs_off):
+    """The ICI leg: with the registry enabled at build time,
+    make_sharded_experiment returns a 4th element — the registry pooled
+    with psum/pmax — matching a single-device pooled run."""
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    om.enable()
+    spec, _ = mm1.build(record=False)
+    mesh = ex.make_mesh()
+    n_dev = mesh.devices.size
+    R = 2 * n_dev
+    fn = ex.make_sharded_experiment(spec, R, mesh)
+    pooled, n_failed, events, metrics = fn(mm1.params(30), seed=5)
+    assert int(om.events_dispatched(metrics)) == int(events)
+    # reference: the same replications pooled without the mesh
+    spec2, _ = mm1.build(record=False)
+    res = ex.run_experiment(spec2, mm1.params(30), R, seed=5)
+    ref = om.pool(res.sims.metrics)
+    for a, b in zip(jax.tree.leaves(metrics), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_run_report_carries_metrics_snapshot(obs_off):
+    """run_experiment(with_report=True): the RunReport carries the
+    compile/execute split and the pooled metrics snapshot."""
+    om.enable()
+    spec, _ = mm1.build(record=False)
+    res, report = ex.run_experiment(
+        spec, mm1.params(30), 2, seed=2, with_report=True
+    )
+    d = report.to_dict()
+    assert d["compile_s"] > 0 and d["execute_s"] > 0
+    assert d["n_replications"] == 2
+    assert d["metrics"]["events_dispatched"] == int(res.total_events)
+    assert d["total_events"] == int(res.total_events)
+
+
+def test_metrics_kernel_mode_raises(obs_off):
+    """An enabled registry traced under the Pallas kernel fails loudly
+    at build time, like the recorder and logger._emit."""
+    om.enable()
+    with config.profile("f32"):
+        spec, _ = mm1.build(record=False)
+        sims = jax.vmap(lambda r: cl.init_sim(spec, 3, r, mm1.params(10)))(
+            jnp.arange(4)
+        )
+        with pytest.raises(RuntimeError, match="kernel"):
+            pallas_run.make_kernel_run(spec, interpret=True)(sims)

@@ -12,6 +12,7 @@ from cimba_tpu.runner import experiment as ex
 from cimba_tpu.stats import summary as sm
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_mg1_sweep_matches_pollaczek_khinchine():
     spec, _ = mg1.build()
     n_objects = 4000
@@ -68,6 +69,7 @@ def test_mg1_full_sweep_matches_pk_at_scale():
     assert checked == 20
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_mg1_heavy_tail_cell_converges():
     """cv=2 lognormal at rho=0.8 — the heavy-tailed cell needs real sample
     mass (per-replication means spread ~9-15 around W=11 at small n)."""
@@ -86,6 +88,7 @@ def test_mg1_heavy_tail_cell_converges():
     assert abs(m.mean() - w_theory) < 0.12 * w_theory
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_mg1_per_replication_param_arrays_are_respected():
     """Replications with different utilizations must produce measurably
     different waits within one batched run."""

@@ -19,6 +19,7 @@ import cimba_tpu.random as cr
 from cimba_tpu.core import loop as cl
 from cimba_tpu.models import mm1
 from cimba_tpu.stats import summary as sm
+import pytest
 
 
 def oracle_mm1(seed, rep, n_objects, arr_mean=1.0 / 0.9, srv_mean=1.0):
@@ -179,6 +180,7 @@ def test_agrees_with_queueing_theory():
     assert abs(l_mean - 0.9 * (w_mean - 1.0)) < 0.6
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_f32_profile_agrees_with_theory_and_f64():
     """The f32 profile — the accelerator-bench and kernel-path width
     (``config.profile('f32')``; bench.py runs the battery under it,

@@ -20,6 +20,7 @@ from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
+import pytest
 
 
 def _build(observe: bool):
@@ -134,6 +135,7 @@ def test_drop_on_exit_forwards_too():
     assert int(out.err) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_observer_kernel_matches_xla():
     """The forwarding machinery rides the kernel path bitwise (the same
     contract every other component carries, docs/07_kernel_path.md)."""

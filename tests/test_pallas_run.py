@@ -45,6 +45,7 @@ def test_kernel_matches_xla_f32_bitwise(f32_profile):
     assert float(sm.mean(mx)) == float(sm.mean(mk))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_chunk_boundary_invariance(f32_profile):
     """Splitting the run into different chunk sizes cannot change results
     (state round-trips through the kernel boundary losslessly)."""
@@ -56,6 +57,7 @@ def test_kernel_chunk_boundary_invariance(f32_profile):
     assert bool((a.clock == b.clock).all())
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_f32_profile_statistics_close_to_f64():
     spec64_out = None
     with config.profile("f64"):
@@ -86,6 +88,7 @@ def test_kernel_requires_f32_profile():
         pr.make_kernel_run(spec)
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_sharded_over_mesh_matches_single(f32_profile):
     """Kernel x mesh composition: the chunked kernel driver under
     shard_map over the lane axis (per-device kernels, global-liveness
@@ -106,6 +109,7 @@ def test_kernel_sharded_over_mesh_matches_single(f32_profile):
     assert int(many.err.sum()) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_matches_xla_f32_awacs(f32_profile):
     """configs[4] through the kernel: exercises the BOUNDARY-block
     machinery end to end — sensor_dwell dispatches are deferred by the
@@ -130,6 +134,7 @@ def test_kernel_matches_xla_f32_awacs(f32_profile):
     assert float(sm.mean(mx)) == float(sm.mean(mk))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_matches_xla_f32_mmc(f32_profile):
     """Kernel path on a model with pool + bool pqueue-style state (mmc):
     exercises lane_sel's bool-leaf handling (i1 selects are rewritten as
@@ -177,6 +182,7 @@ def test_lanelast_dot_general_rule(f32_profile):
     )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_awacs_sharded_over_mesh_matches_single(f32_profile):
     """Flagship x mesh: the AWACS kernel run — boundary-block NN physics
     applied between chunks — sharded over the 8-virtual-device mesh must
@@ -205,6 +211,7 @@ def test_kernel_awacs_sharded_over_mesh_matches_single(f32_profile):
     assert float(sm.mean(mx)) == float(sm.mean(mk))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_boundary_block_mid_chain_entry_fails_loudly(f32_profile):
     """A boundary block reached mid-chain (via a completed command's
     next_pc instead of a resume) violates the boundary contract; the
@@ -246,6 +253,7 @@ def test_boundary_block_mid_chain_entry_fails_loudly(f32_profile):
     assert bool((ker.err == cl.ERR_BOUNDARY).all()), [int(e) for e in ker.err]
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_matches_xla_f32_mg1(f32_profile):
     """Kernel path on mg1: the lognormal sampler (exp/log chains) and
     the 512-slot ring in-kernel."""
@@ -264,6 +272,7 @@ def test_kernel_matches_xla_f32_mg1(f32_profile):
     assert int(ker.err.sum()) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_matches_xla_f32_jobshop(f32_profile):
     """Kernel path on jobshop: pools (greedy acquire + rollback),
     buffers (partial fulfillment), pq and recording accumulators all
@@ -283,6 +292,7 @@ def test_kernel_matches_xla_f32_jobshop(f32_profile):
     assert int(ker.err.sum()) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_matches_xla_f32_condition(f32_profile):
     """Kernel path on a condition-variable model: the registered traced
     predicate, cond_wait's retry gating and cond_signal's per-pid
@@ -379,6 +389,7 @@ def test_pack_unpack_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_kernel_lane_block_grid_matches_xla(f32_profile):
     """The lane-block grid (pallas grid over lane blocks; VMEM holds one
     block) is trajectory-identical to the monolithic kernel and the XLA

@@ -41,6 +41,7 @@ def _burst_spec(n_timers, event_cap):
     return m.build()
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_overflow_replication_completes_after_regrow():
     spec = _burst_spec(12, event_cap=4)
 
@@ -68,6 +69,7 @@ def test_regrow_noop_when_capacity_suffices():
     assert int(res.n_failed) == 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_regrow_reproduces_ample_cap_run_bitwise():
     """A regrown run must equal the run that started at the final cap:
     streams are (seed, rep)-derived, so capacity cannot leak into
@@ -79,6 +81,7 @@ def test_regrow_reproduces_ample_cap_run_bitwise():
     assert bool((res.sims.n_events == direct.sims.n_events).all())
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_regrow_gives_up_on_runaway():
     """A model whose demand outruns any doubling within max_regrows."""
     spec = _burst_spec(64, event_cap=2)

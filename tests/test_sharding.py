@@ -17,6 +17,7 @@ import numpy as np
 from cimba_tpu.models import mm1
 from cimba_tpu.runner import experiment as ex
 from cimba_tpu.stats import summary as sm
+import pytest
 
 R = 64  # 8 lanes/device on the virtual mesh
 
@@ -25,6 +26,7 @@ def _pooled(res):
     return sm.merge_tree(res.sims.user["wait"])
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_mesh_matches_single_device_bitwise():
     spec, _ = mm1.build()
     params = mm1.params(200)
@@ -42,6 +44,7 @@ def test_mesh_matches_single_device_bitwise():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_make_sharded_experiment_merge_is_exact():
     """The fused on-device all_gather+Pebay merge equals host-side
     merge_tree over the unsharded batch."""

@@ -20,6 +20,7 @@ from cimba_tpu.core import loop as cl
 from cimba_tpu.core import pallas_run
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
+import pytest
 
 N_CUSTOMERS = 30
 POOL = 8  # max concurrently-live customers
@@ -161,6 +162,7 @@ def test_spawn_pool_exhaustion_reports_minus_one():
     assert int(out.user["misses"]) == 2
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_spawn_kernel_path_bit_identical():
     with config.profile("f32"):
         spec = _build()

@@ -12,6 +12,7 @@ from cimba_tpu.core import api, cmd
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core import process as pr
 from cimba_tpu.core.model import Model
+import pytest
 
 
 def run1(m, params=None, t_end=None):
@@ -314,6 +315,7 @@ def test_wait_event_interrupt_during_wait():
     assert int(out.procs.await_evt[1]) == -1
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_wait_event_model_through_kernel():
     """The kernel path on a wait_event model: exercises the vectorized
     waiter scan (ev._valid_vec's [P, CAP] one-hot) and the event-waiter

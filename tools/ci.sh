@@ -94,6 +94,32 @@ EOF
 
 run_cell "multichip dryrun" python __graft_entry__.py 8
 
+# observability smoke: tut_1 with the flight recorder enabled must export
+# a Chrome-trace JSON that loads and carries the required keys (docs/10;
+# the in-repo validator additionally checks per-replication timestamp
+# monotonicity and the metrics section)
+run_cell "obs smoke" bash -c '
+  set -e
+  tmp=$(mktemp -d)
+  trap "rm -rf \"$tmp\"" EXIT
+  CIMBA_TRACE=1 CIMBA_TRACE_OUT="$tmp/trace.json" \
+    python examples/tut_1_mm1.py
+  python - "$tmp/trace.json" <<PYEOF
+import json, sys
+doc = json.load(open(sys.argv[1]))
+for key in ("traceEvents", "displayTimeUnit", "otherData"):
+    assert key in doc, f"missing {key}"
+events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+assert events, "no trace events recorded"
+for e in events:
+    for k in ("name", "ph", "ts", "pid", "tid"):
+        assert k in e, f"event missing {k}: {e}"
+assert doc["otherData"]["metrics"]["events_dispatched"] > 0
+print("obs smoke OK:", len(events), "events,",
+      doc["otherData"]["metrics"]["events_dispatched"], "dispatched")
+PYEOF
+'
+
 # packaging: build the wheel, install it into a scratch --target, and
 # drive a model from OUTSIDE the repo checkout — catches a subpackage or
 # data file missing from the install the way the reference CI's install
