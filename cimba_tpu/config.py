@@ -137,6 +137,61 @@ _ACTIVE_PROFILE = "f64"
 #: batched (vector) loop condition.
 KERNEL_MODE = False
 
+# --- dispatch-cost levers (docs/11_dispatch_cost.md) -------------------------
+#
+# Both tri-state: ``None`` defers to the environment variable (and its
+# default), ``True``/``False`` override it programmatically — bench.py
+# flips these to measure the packed+hierarchical and flat arms in one
+# process.  Both bind at TRACE time (like the dtype profile): arrays and
+# jaxprs already built keep their layout.
+
+#: Hierarchical (two-level tournament) event-set minima.  ``None`` ->
+#: ``CIMBA_EVENTSET_HIER`` (default on — structurally inert unless the
+#: event capacity is a >= 2x multiple of the block size, which no
+#: shipped model's is); ``False`` is the flat-scan oracle.
+EVENTSET_HIER = None
+
+#: Event-set block size for the hierarchical minima.  ``None`` ->
+#: ``CIMBA_EVENTSET_BLOCK`` (default 128, a lane-friendly multiple).
+EVENTSET_BLOCK = None
+
+#: Packed XLA while-loop carry (core/carry.py).  ``None`` ->
+#: ``CIMBA_XLA_PACK``; unset environment auto-selects: packed on
+#: accelerator backends (where the per-leaf carry cost is measured),
+#: per-leaf on CPU (today's jaxpr).  ``CIMBA_XLA_PACK=0`` / ``False``
+#: always reproduces the current per-leaf jaxpr bitwise.
+XLA_PACK = None
+
+
+def eventset_hier_enabled() -> bool:
+    import os
+
+    if EVENTSET_HIER is not None:
+        return bool(EVENTSET_HIER)
+    return os.environ.get("CIMBA_EVENTSET_HIER", "1") != "0"
+
+
+def eventset_block() -> int:
+    import os
+
+    if EVENTSET_BLOCK is not None:
+        return int(EVENTSET_BLOCK)
+    return int(os.environ.get("CIMBA_EVENTSET_BLOCK", "128"))
+
+
+def xla_pack_enabled() -> bool:
+    import os
+
+    if XLA_PACK is not None:
+        return bool(XLA_PACK)
+    raw = os.environ.get("CIMBA_XLA_PACK", "").strip()
+    if raw:
+        return raw != "0"
+    # auto: the wide-carry cost this packs away is the accelerator
+    # while-loop's (BENCH_NOTES round 5 floor probes); CPU keeps the
+    # per-leaf carry it has always run
+    return jax.default_backend() != "cpu"
+
 
 def active_profile() -> str:
     return _ACTIVE_PROFILE

@@ -2219,7 +2219,7 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
             live = live & ~sim.boundary_pending
         if t_end is not None:
             nxt = jnp.minimum(
-                jnp.min(sim.events.time), jnp.min(sim.wakes.time)
+                ev.min_time(sim.events), jnp.min(sim.wakes.time)
             )
             live = live & ((nxt <= t_end) | (empty & ~out_of_work))
         return live
@@ -2227,15 +2227,62 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
     return cond
 
 
-def make_run(spec: ModelSpec, t_end: Optional[float] = None):
+def make_run(
+    spec: ModelSpec,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+):
     """Build ``run(sim) -> sim``: dispatch events until the model stops
     (api.stop), fails, runs out of events, or passes ``t_end``
     (parity: cmb_event_queue_execute; t_end plays the role of the
-    user-scheduled end event)."""
+    user-scheduled end event).
+
+    ``pack`` selects the while-loop carry layout (None defers to
+    ``config.xla_pack_enabled()`` — ``CIMBA_XLA_PACK``, auto-on for
+    accelerator backends): packed runs the SAME step/cond on a carry of
+    a few wide per-dtype buffers instead of the Sim's ~50 narrow leaves
+    (core/carry.py, the same packing the Pallas chunk loop uses under
+    ``CIMBA_KERNEL_PACK``).  Pack/unpack are bitwise-lossless structural
+    ops, so trajectories are identical; ``pack=False`` reproduces
+    today's per-leaf jaxpr exactly.  See docs/11_dispatch_cost.md."""
     step = make_step(spec)
     cond = make_cond(spec, t_end)
+    if pack is None:
+        pack = config.xla_pack_enabled()
+    if not pack:
+        def run(sim: Sim) -> Sim:
+            return lax.while_loop(cond, step, sim)
+
+        return run
+
+    from cimba_tpu.core import carry as _carry
 
     def run(sim: Sim) -> Sim:
-        return lax.while_loop(cond, step, sim)
+        leaves, treedef = jax.tree.flatten(sim)
+        plan = _carry.pack_plan(
+            [
+                jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l))
+                for l in leaves
+            ],
+            lane_last=False,
+        )
+
+        def unflatten(bufs):
+            return jax.tree.unflatten(
+                treedef, _carry.unpack(list(bufs), plan)
+            )
+
+        def pcond(bufs):
+            return cond(unflatten(bufs))
+
+        def pbody(bufs):
+            return tuple(
+                _carry.pack(jax.tree.leaves(step(unflatten(bufs))), plan)
+            )
+
+        out = lax.while_loop(
+            pcond, pbody, tuple(_carry.pack(leaves, plan))
+        )
+        return unflatten(out)
 
     return run

@@ -52,7 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cimba_tpu import config
-from cimba_tpu.core import bool32, dyn, lanelast
+from cimba_tpu.core import bool32, carry, dyn, lanelast
 from cimba_tpu.core import loop as cl
 from cimba_tpu.core.model import ModelSpec
 
@@ -78,109 +78,28 @@ def _vmem_limit_bytes(lane_block=None) -> int:
         ) from e
 
 
+# Carry packing (see core/carry.py — ONE implementation serves this
+# kernel chunk loop and loop.make_run's packed XLA while-loop, so the
+# two hot paths can never diverge on buffer layout).  The lane-last
+# aliases below keep this module's historical API: the chunk's batched
+# leaves are [comp..., L] and pack into [rows, L] buffers.  Why packing
+# at all: Mosaic's per-iteration cost of the chunk while-loop scales
+# super-linearly with the number of narrow carried leaves — measured on
+# v5e (BENCH_NOTES round-5 floor probes): mm1's real 54-leaf carry
+# costs ~135 us/step with a TRIVIAL body, the same bytes in a few wide
+# f32 buffers <1 us.
+
+
 def _pack_plan(avals):
-    """Static carry-packing plan over the chunk's batched leaves
-    ([comp..., L]): 32-bit leaves become rows of one [rows, L] buffer
-    per dtype class (f32; i32 with u32 riding along via same-width
-    bitcast), bool leaves and anything else pass through per-leaf.
-
-    Why: Mosaic's per-iteration cost of the chunk while-loop scales
-    super-linearly with the number of narrow carried leaves — measured
-    on v5e (BENCH_NOTES round-5 floor probes): mm1's real 54-leaf carry
-    costs ~135 us/step with a TRIVIAL body, while the same bytes in a
-    few wide f32 buffers cost <1 us.  Packing trades ~2 slice + reshape
-    (+bitcast) ops per leaf per iteration — all wide-array structural
-    ops — for that per-leaf carry overhead.
-
-    Returns a dict: ``groups`` maps dtype-class name ("f32"/"i32") to
-    the list of leaf indices packed in that buffer (row-major, stable
-    order), ``passthrough`` lists leaf indices carried per-leaf (bools;
-    anything non-32-bit), and ``meta[i] = (rows_i, per_lane_shape_i,
-    dtype_i)`` for every leaf.
-    """
-    groups = {"f32": [], "i32": []}
-    passthrough = []
-    meta = []
-    for i, a in enumerate(avals):
-        s = tuple(a.shape[:-1])
-        r = 1
-        for d in s:
-            r *= int(d)
-        meta.append((r, s, a.dtype))
-        if a.dtype == jnp.float32:
-            groups["f32"].append(i)
-        elif a.dtype in (jnp.int32, jnp.uint32):
-            groups["i32"].append(i)
-        else:
-            passthrough.append(i)
-    return {"groups": groups, "passthrough": passthrough, "meta": meta}
-
-
-def _pack_rows(x, r, s):
-    """[s..., L] -> [r, L] (reshape touches leading dims only)."""
-    L = x.shape[-1]
-    if s == ():
-        return lax.reshape(x, (1, L))
-    if len(s) == 1:
-        return x
-    return lax.reshape(x, (r, L))
+    return carry.pack_plan(avals, lane_last=True)
 
 
 def _pack(leaves, plan):
-    """leaves (original order) -> packed carry list:
-    [f32 buffer?, i32 buffer?, *passthrough leaves]."""
-    out = []
-    for cls, dt in (("f32", jnp.float32), ("i32", jnp.int32)):
-        idxs = plan["groups"][cls]
-        if not idxs:
-            continue
-        parts = []
-        for i in idxs:
-            r, s, dtype = plan["meta"][i]
-            p = _pack_rows(leaves[i], r, s)
-            if dtype != dt:  # u32 rows ride the i32 buffer bitwise
-                p = lax.bitcast_convert_type(p, dt)
-            parts.append(p)
-        out.append(
-            parts[0] if len(parts) == 1 else lax.concatenate(parts, 0)
-        )
-    for i in plan["passthrough"]:
-        out.append(leaves[i])
-    return out
+    return carry.pack(leaves, plan)
 
 
 def _unpack(packed, plan, L):
-    """Inverse of :func:`_pack`: packed carry list -> leaves in original
-    order (row slices + bitcast + leading-dim reshape, all Mosaic-clean
-    wide-array ops)."""
-    n = len(plan["meta"])
-    leaves = [None] * n
-    k = 0
-    for cls, dt in (("f32", jnp.float32), ("i32", jnp.int32)):
-        idxs = plan["groups"][cls]
-        if not idxs:
-            continue
-        buf = packed[k]
-        k += 1
-        o = 0
-        for i in idxs:
-            r, s, dtype = plan["meta"][i]
-            if len(idxs) == 1:
-                p = buf
-            else:
-                p = lax.slice(buf, (o, 0), (o + r, L))
-            o += r
-            if dtype != dt:
-                p = lax.bitcast_convert_type(p, dtype)
-            if s == ():
-                p = lax.reshape(p, (L,))
-            elif len(s) != 1:
-                p = lax.reshape(p, s + (L,))
-            leaves[i] = p
-    for i in plan["passthrough"]:
-        leaves[i] = packed[k]
-        k += 1
-    return leaves
+    return carry.unpack(packed, plan, L)
 
 
 def make_kernel_run(

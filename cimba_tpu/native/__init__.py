@@ -158,12 +158,18 @@ def mm1_single(
 ) -> dict:
     """Single-stream M/M/1 on the host core at engine semantics — the
     native latency path (run_mm1_fast in cimba_native.cpp); results are
-    bitwise-equal to :func:`oracle_mm1` (pinned by test_native.py)."""
+    bitwise-equal to :func:`oracle_mm1` (pinned by test_native.py).
+
+    ``fast_path_overflow`` reports a slot-table invariant violation in
+    the fast path: the result then came from the general run_mm1 engine
+    (structured fallback — the fast path must never abort the process)."""
     lib = load()
     assert lib is not None
-    out = (ctypes.c_double * 7)()
+    out = (ctypes.c_double * 8)()
     lib.cimba_mm1_single(seed, rep, n_objects, arr_mean, srv_mean, out)
-    return _summary(out)
+    d = _summary(out)
+    d["fast_path_overflow"] = bool(out[7])
+    return d
 
 
 def oracle_mmc(

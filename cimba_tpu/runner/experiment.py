@@ -90,6 +90,7 @@ def run_experiment(
     t_end: Optional[float] = None,
     with_report: bool = False,
     profile_dir: Optional[str] = None,
+    pack: Optional[bool] = None,
 ):
     """Run ``n_replications`` independent replications of ``spec``.
 
@@ -104,8 +105,13 @@ def run_experiment(
     when the metrics registry is enabled — the pooled metrics snapshot.
     ``profile_dir`` additionally wraps the execute leg in a
     ``jax.profiler.trace`` context writing there.
+
+    ``pack`` selects the while-loop carry layout (see
+    :func:`cimba_tpu.core.loop.make_run`; None = the
+    ``CIMBA_XLA_PACK``/backend auto default) — trajectory-identical
+    either way, bench.py measures both arms through this knob.
     """
-    run = make_run(spec, t_end=t_end)
+    run = make_run(spec, t_end=t_end, pack=pack)
     pb = _broadcast_params(params, n_replications)
     reps = jnp.arange(n_replications)
 
@@ -179,6 +185,7 @@ def run_experiment_regrow(
     mesh: Optional[Mesh] = None,
     t_end: Optional[float] = None,
     max_regrows: int = 4,
+    pack: Optional[bool] = None,
 ):
     """``run_experiment`` with the capacity escape hatch: if any
     replication died with ``ERR_EVENT_OVERFLOW``/``ERR_GUARD_OVERFLOW``,
@@ -206,7 +213,8 @@ def run_experiment_regrow(
     grow_errs = (_cl.ERR_EVENT_OVERFLOW,)
     for n_regrows in range(max_regrows + 1):
         result = run_experiment(
-            spec, params, n_replications, seed=seed, mesh=mesh, t_end=t_end
+            spec, params, n_replications, seed=seed, mesh=mesh,
+            t_end=t_end, pack=pack,
         )
         err = np.asarray(result.sims.err)
         if not np.isin(err, grow_errs).any():
@@ -230,7 +238,9 @@ def pooled_summary(batched: sm.Summary) -> sm.Summary:
 
 def make_sharded_experiment(
     spec: ModelSpec, n_replications: int, mesh: Mesh, *,
-    summary_path=lambda sims: sims.user["wait"], t_end: Optional[float] = None
+    summary_path=lambda sims: sims.user["wait"],
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
 ):
     """Build the fully-fused multi-chip experiment step: run all local
     replications AND reduce statistics over the mesh inside one jitted
@@ -247,7 +257,7 @@ def make_sharded_experiment(
     """
     from cimba_tpu.obs import metrics as _metrics
 
-    run = make_run(spec, t_end=t_end)
+    run = make_run(spec, t_end=t_end, pack=pack)
     reps = jnp.arange(n_replications)
     with_metrics = _metrics.enabled()
 

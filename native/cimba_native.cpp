@@ -136,6 +136,12 @@ struct MM1Result {
   double clock;
   double n, mean, m2, min, max;
   uint64_t events;
+  // run_mm1_fast only: its fixed 4-slot table's invariant (mm1 carries
+  // <= 3 live events) was violated — the result is partial and the
+  // caller must fall back to run_mm1.  A structured flag instead of
+  // std::abort(): an invariant violation in a bench fast path must
+  // never kill the embedding Python process.
+  uint64_t overflow = 0;
 };
 
 // Scalar M/M/1 oracle mirroring the FUSED-verb flagship cycle
@@ -249,6 +255,7 @@ MM1Result run_mm1_fast(uint64_t seed, uint64_t rep, uint64_t n_objects,
   Slot slots[4] = {};
   int32_t seq = 0;
   int n_live = 0;
+  bool slots_overflow = false;
   auto sched = [&](double t, int32_t target, double payload,
                    double payload2 = 0.0) {
     for (auto& s : slots) {
@@ -258,7 +265,10 @@ MM1Result run_mm1_fast(uint64_t seed, uint64_t rep, uint64_t n_objects,
         return;
       }
     }
-    std::abort();  // mm1 never carries more than 3 live events
+    // mm1 never carries more than 3 live events; a violation flags the
+    // result as overflowed (the loop bails) instead of aborting the
+    // process — cimba_mm1_single falls back to run_mm1
+    slots_overflow = true;
   };
 
   std::vector<double> ring(1u << 4);  // FIFO ring; starts small so the
@@ -307,7 +317,7 @@ MM1Result run_mm1_fast(uint64_t seed, uint64_t rep, uint64_t n_objects,
   sched(0.0, 2, 0.0);  // service start
 
   bool done = false;
-  while (n_live > 0 && !done) {
+  while (n_live > 0 && !done && !slots_overflow) {
     int best = -1;
     for (int i = 0; i < 4; ++i) {
       if (!slots[i].live) continue;
@@ -352,7 +362,9 @@ MM1Result run_mm1_fast(uint64_t seed, uint64_t rep, uint64_t n_objects,
         break;
     }
   }
-  return MM1Result{clock, sn, smean, sm2, smin, smax, events};
+  MM1Result r{clock, sn, smean, sm2, smin, smax, events};
+  r.overflow = slots_overflow ? 1 : 0;
+  return r;
 }
 
 // ---------------------------------------------------------------------------
@@ -526,17 +538,26 @@ void cimba_oracle_mm1(uint64_t seed, uint64_t rep, uint64_t n_objects,
 
 // Single-stream M/M/1 at engine semantics (run_mm1_fast): the native
 // host-core latency path behind bench.py --config mm1_single; same
-// output layout as cimba_oracle_mm1 and bitwise-equal results.
+// output layout as cimba_oracle_mm1 (+ out8[7] = fast-path overflow)
+// and bitwise-equal results.  A slot-table invariant violation in the
+// fast path falls back to the general run_mm1 engine and reports the
+// event via out8[7] — a structured bench failure, never an abort.
 void cimba_mm1_single(uint64_t seed, uint64_t rep, uint64_t n_objects,
-                      double arr_mean, double srv_mean, double* out7) {
-  const MM1Result r = run_mm1_fast(seed, rep, n_objects, arr_mean, srv_mean);
-  out7[0] = r.clock;
-  out7[1] = r.n;
-  out7[2] = r.mean;
-  out7[3] = r.m2;
-  out7[4] = r.min;
-  out7[5] = r.max;
-  out7[6] = static_cast<double>(r.events);
+                      double arr_mean, double srv_mean, double* out8) {
+  MM1Result r = run_mm1_fast(seed, rep, n_objects, arr_mean, srv_mean);
+  double fast_overflow = 0.0;
+  if (r.overflow) {
+    fast_overflow = 1.0;
+    r = run_mm1(seed, rep, n_objects, arr_mean, srv_mean);
+  }
+  out8[0] = r.clock;
+  out8[1] = r.n;
+  out8[2] = r.mean;
+  out8[3] = r.m2;
+  out8[4] = r.min;
+  out8[5] = r.max;
+  out8[6] = static_cast<double>(r.events);
+  out8[7] = fast_overflow;
 }
 
 // Scalar M/M/c oracle; same output layout as cimba_oracle_mm1.

@@ -1,0 +1,325 @@
+"""Hierarchical event-set minima vs the flat-scan oracle.
+
+The two-level tournament (eventset.BlockMin) must be BITWISE the flat
+lexmin: same (time, prio DESC, seq) winner, same Event payloads, same
+post-consume table.  Randomized op sequences exercise
+insert/cancel/reschedule/reprioritize/pattern_count/pattern_cancel/pop
+and the merged pop against the oracle, under jit+vmap, in both dtype
+profiles; a timer-heavy model run pins the whole-Sim trajectory; the
+regrow test pins that a capacity doubling crossing the hierarchy
+threshold rebuilds block minima consistently.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cimba_tpu import config
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core import eventset as ev
+from cimba_tpu.core import loop as cl
+from cimba_tpu.core.model import Model
+
+
+class _layout:
+    """Scoped hier/flat layout override (config tri-states)."""
+
+    def __init__(self, hier, block=None):
+        self.hier, self.block = hier, block
+
+    def __enter__(self):
+        self._prev = (config.EVENTSET_HIER, config.EVENTSET_BLOCK)
+        config.EVENTSET_HIER = self.hier
+        config.EVENTSET_BLOCK = self.block
+
+    def __exit__(self, *exc):
+        config.EVENTSET_HIER, config.EVENTSET_BLOCK = self._prev
+
+
+def _op_program(seed, cap, n_ops):
+    """A fixed pseudo-random op sequence (shared by both arms)."""
+    rng = random.Random(seed)
+    ops = []
+    for i in range(n_ops):
+        r = rng.random()
+        if r < 0.42:
+            ops.append((
+                "schedule", rng.uniform(0.0, 40.0), rng.randint(-2, 2),
+                rng.randint(0, 4), rng.randint(0, 3), i,
+            ))
+        elif r < 0.56:
+            ops.append(("cancel", rng.randrange(max(1, i))))
+        elif r < 0.66:
+            ops.append((
+                "reschedule", rng.randrange(max(1, i)),
+                rng.uniform(0.0, 40.0),
+            ))
+        elif r < 0.74:
+            ops.append((
+                "reprioritize", rng.randrange(max(1, i)),
+                rng.randint(-3, 3),
+            ))
+        elif r < 0.80:
+            ops.append(("pattern_cancel", rng.randint(0, 4)))
+        else:
+            ops.append(("pop",))
+    return ops
+
+
+def _apply_ops(ops, cap, offset):
+    """Trace the op sequence against one lane's EventSet (offset shifts
+    every scheduled time, so vmap lanes diverge); returns stacked
+    observables — every Event field, handles, counts, min_time."""
+    es = ev.create(cap)
+    handles = []
+    out = []
+    for op in ops:
+        if op[0] == "schedule":
+            _, t, p, k, s, a = op
+            es, h = ev.schedule(es, t + offset, p, k, s, a)
+            handles.append(h)
+            out.append(h.astype(jnp.float32))
+        elif op[0] == "cancel":
+            es, ok = ev.cancel(es, handles[op[1] % len(handles)]
+                               if handles else jnp.int32(-1))
+            out.append(ok.astype(jnp.float32))
+        elif op[0] == "reschedule":
+            es, ok = ev.reschedule(
+                es, handles[op[1] % len(handles)] if handles
+                else jnp.int32(-1), op[2] + offset,
+            )
+            out.append(ok.astype(jnp.float32))
+        elif op[0] == "reprioritize":
+            es, ok = ev.reprioritize(
+                es, handles[op[1] % len(handles)] if handles
+                else jnp.int32(-1), op[2],
+            )
+            out.append(ok.astype(jnp.float32))
+        elif op[0] == "pattern_cancel":
+            es, n = ev.pattern_cancel(es, kind=op[1])
+            out.append(n.astype(jnp.float32))
+        else:
+            es, e = ev.pop(es)
+            out.extend([
+                e.time.astype(jnp.float32), e.prio.astype(jnp.float32),
+                e.kind.astype(jnp.float32), e.subj.astype(jnp.float32),
+                e.arg.astype(jnp.float32), e.found.astype(jnp.float32),
+                e.handle.astype(jnp.float32),
+            ])
+        out.append(ev.pattern_count(es).astype(jnp.float32))
+        out.append(ev.min_time(es).astype(jnp.float32))
+    # final drain order is the strongest ordering probe
+    for _ in range(cap):
+        es, e = ev.pop(es)
+        out.extend([
+            e.time.astype(jnp.float32), e.kind.astype(jnp.float32),
+            e.found.astype(jnp.float32),
+        ])
+    return jnp.stack(out), es
+
+
+def _run_arm(ops, cap, hier, block):
+    with _layout(hier, block):
+        def one(off):
+            obs, es = _apply_ops(ops, cap, off)
+            return obs, es.time, es.prio, es.seq, es.gen, es.next_seq
+        return jax.jit(jax.vmap(one))(
+            jnp.arange(4, dtype=config.TIME)
+        )
+
+
+def test_randomized_ops_match_flat_oracle_f64():
+    ops = _op_program(seed=3, cap=16, n_ops=26)
+    flat = _run_arm(ops, 16, hier=False, block=None)
+    hier = _run_arm(ops, 16, hier=True, block=4)
+    for a, b in zip(flat, hier):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_randomized_ops_match_flat_oracle_f32():
+    with config.profile("f32"):
+        ops = _op_program(seed=8, cap=16, n_ops=44)
+        flat = _run_arm(ops, 16, hier=False, block=None)
+        hier = _run_arm(ops, 16, hier=True, block=4)
+        for a, b in zip(flat, hier):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_merged_pop_and_pred_gating_match_flat():
+    """pop_merged + pred-gated consume (the kernel driver's defer shape)
+    agree with the oracle; a gated-off consume leaves summary AND table
+    untouched.  (The tier-1 randomized battery covers pop/pop_merged
+    ordering; this adds the pred-gated defer arm.)"""
+    def arm(hier):
+        with _layout(hier, 4):
+            def one(off):
+                es = ev.create(16)
+                for i in range(6):
+                    es, _ = ev.schedule(
+                        es, 2.0 + off + 0.5 * i, i % 3, 2, i, i
+                    )
+                wk = ev.wakes_create(4)._replace(
+                    time=jnp.stack(
+                        [2.0 + off, jnp.inf, 3.0 + off, jnp.inf]
+                    ),
+                    seq=jnp.asarray([50, 0, 51, 0], jnp.int32),
+                )
+                prio = jnp.asarray([1, 0, 0, 0], jnp.int32)
+                outs = []
+                # one deferred (pred=False) peek between real pops
+                for j in range(9):
+                    event, te, tw = ev.peek_merged(es, wk, prio, 0)
+                    take = jnp.asarray(j != 4)  # defer step 4
+                    es, wk = ev.consume_merged(es, wk, te, tw, take)
+                    outs.extend([
+                        event.time, event.prio.astype(config.TIME),
+                        event.kind.astype(config.TIME),
+                        event.subj.astype(config.TIME),
+                        event.found.astype(config.TIME),
+                        event.handle.astype(config.TIME),
+                    ])
+                return jnp.stack(outs), es.time, es.gen
+            return jax.jit(jax.vmap(one))(
+                jnp.arange(2, dtype=config.TIME)
+            )
+
+    for a, b in zip(arm(False), arm(True)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_layout_flags_and_structure():
+    # flat flag or a capacity below two blocks -> no summary leaves:
+    # the historical pytree, bit for bit
+    with _layout(False):
+        assert ev.create(2048).blk is None
+    with _layout(True, 128):
+        assert ev.create(64).blk is None      # < 2 blocks
+        assert ev.create(192).blk is None     # doesn't tile
+        es = ev.create(2048)
+        assert es.blk is not None
+        assert es.blk.time.shape == (16,)
+        # summary of an empty table == a fresh rebuild
+        for a, b in zip(es.blk, ev._refresh_all(es)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _timer_model(event_cap, per_resume, n_sched, n_exit):
+    """One process schedules ``per_resume`` far-future timers on each of
+    its first ``n_sched`` resumes (holding 0.1 between them, so the
+    table fills to per_resume * n_sched live timers before any fires),
+    then exits after ``n_exit`` total resumes — a general-table-heavy
+    workload (the shipped models keep the general table nearly empty).
+    Timer fires abort in-progress holds, so the pop interleavings cross
+    both tables."""
+    m = Model("tmr", n_ilocals=1, event_cap=event_cap)
+
+    @m.block
+    def tick(sim, p, sig):
+        k = api.local_i(sim, p, 0)
+        sim = api.add_local_i(sim, p, 0, 1)
+        arming = k < n_sched
+        for i in range(per_resume):
+            sim2, _ = api.timer_add(
+                sim, p, 3.0 + (i % 7) * 0.61 + (i % 3) * 1.7, 0
+            )
+            sim = cl._tree_select(arming, sim2, sim)
+        fin = k >= n_exit
+        return sim, cmd.select(
+            fin, cmd.exit_(), cmd.hold(0.1, next_pc=tick.pc)
+        )
+
+    m.process("ticker", entry=tick)
+    return m.build()
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells (tier-1 keeps test_xla_pack's combined packed+hier twin)
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_timer_model_trajectory_matches_flat(profile):
+    """Whole-Sim bitwise equality, hier vs flat, on a model that keeps
+    the general table heavily populated (cap=256 -> real 128-block
+    geometry), vmapped over 4 replications."""
+    with config.profile(profile):
+        def arm(hier):
+            with _layout(hier):
+                spec = _timer_model(
+                    256, per_resume=12, n_sched=8, n_exit=20
+                )
+                sims = jax.vmap(
+                    lambda r: cl.init_sim(spec, 11, r, None)
+                )(jnp.arange(4))
+                return jax.jit(jax.vmap(cl.make_run(spec)))(sims)
+
+        flat, hier = arm(False), arm(True)
+        assert int(jnp.sum(flat.n_events)) > 40
+        assert not bool(jnp.any(flat.err != 0))
+        fl = jax.tree_util.tree_flatten_with_path(flat)[0]
+        hl = dict(
+            (jax.tree_util.keystr(p), l)
+            for p, l in jax.tree_util.tree_flatten_with_path(hier)[0]
+        )
+        for path, a in fl:
+            b = hl[jax.tree_util.keystr(path)]
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=str(path)
+            )
+        # the carried summary equals a from-scratch rebuild per lane
+        rebuilt = jax.vmap(ev._refresh_all)(hier.events)
+        for a, b in zip(hier.events.blk, rebuilt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_regrow_crossing_threshold_rebuilds_block_minima():
+    """run_experiment_regrow doubling event_cap across the hierarchy
+    threshold (128 -> 256) must succeed and stay bitwise-equal to the
+    flat oracle at the grown capacity (satellite: capacity-regrow
+    interaction)."""
+    from cimba_tpu.runner import experiment as ex
+
+    spec = _timer_model(128, per_resume=16, n_sched=10, n_exit=24)
+    with _layout(True):
+        res, final_spec, n_regrows = ex.run_experiment_regrow(
+            spec, None, 4, seed=5
+        )
+        assert n_regrows == 1 and final_spec.event_cap == 256
+        assert int(res.n_failed) == 0
+        assert res.sims.events.blk is not None
+        rebuilt = jax.vmap(ev._refresh_all)(res.sims.events)
+        for a, b in zip(res.sims.events.blk, rebuilt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with _layout(False):
+        import dataclasses
+
+        oracle = ex.run_experiment(
+            dataclasses.replace(spec, event_cap=256), None, 4, seed=5
+        )
+        assert oracle.sims.events.blk is None
+    hl = dict(
+        (jax.tree_util.keystr(p), l)
+        for p, l in jax.tree_util.tree_flatten_with_path(res.sims)[0]
+    )
+    for path, a in jax.tree_util.tree_flatten_with_path(oracle.sims)[0]:
+        b = hl[jax.tree_util.keystr(path)]
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(path)
+        )
+
+
+def test_kernel_mode_raises_loudly():
+    """Kernel-mode tracing over a hierarchical EventSet must fail at
+    build time with a named error (the obs/trace precedent), never
+    miscompile."""
+    with _layout(True, 4):
+        es = ev.create(16)
+        prev = config.KERNEL_MODE
+        config.KERNEL_MODE = True
+        try:
+            with pytest.raises(ValueError, match="XLA-path only"):
+                ev.pop(es)
+        finally:
+            config.KERNEL_MODE = prev

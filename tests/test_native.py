@@ -152,4 +152,7 @@ def test_mm1_single_fast_path_bitwise_equals_oracle():
         for rep in (0, 7):
             a = native.oracle_mm1(seed, rep, 20000, 1.0 / 0.9, 1.0)
             b = native.mm1_single(seed, rep, 20000, 1.0 / 0.9, 1.0)
+            # the fast path must run clean (no overflow fallback) on the
+            # mm1 workload — its <= 3-live-event invariant holds here
+            assert b.pop("fast_path_overflow") is False, (seed, rep)
             assert a == b, (seed, rep)

@@ -1,0 +1,147 @@
+"""Packed XLA while-loop carry (core/carry.py via loop.make_run).
+
+Packing is a carry-LAYOUT change, never a semantic one: the packed run
+must be bitwise the per-leaf run on every Sim leaf, in both dtype
+profiles, batched and unbatched — and with the hierarchical event set
+riding along (the combined packed+hierarchical arm is the bench's new
+measured configuration).  ``pack=False`` / CPU default must reproduce
+the historical jaxpr exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cimba_tpu import config
+from cimba_tpu.core import carry
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mm1
+
+
+def _assert_trees_equal(a, b):
+    al, bl = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(al) == len(bl)
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pack_unpack_roundtrip_is_identity():
+    """pack -> unpack is bitwise identity on a real Sim's leaves (both
+    layouts), including u32 rows riding the int buffer via bitcast."""
+    spec, _ = mm1.build(record=True)
+    sim = cl.init_sim(spec, 1, 0, mm1.params(10))
+    leaves = jax.tree.leaves(sim)
+    avals = [
+        jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l))
+        for l in leaves
+    ]
+    plan = carry.pack_plan(avals, lane_last=False)
+    assert carry.n_buffers(plan) < len(leaves) // 4, (
+        "packing should collapse the ~50-leaf carry to a handful of "
+        f"buffers, got {carry.n_buffers(plan)} of {len(leaves)}"
+    )
+    back = carry.unpack(carry.pack(leaves, plan), plan)
+    for x, y in zip(leaves, back):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize(
+    "profile",
+    [
+        # heavyweight twin: over the timed tier-1 budget; tools/ci.sh cells
+        pytest.param("f64", marks=pytest.mark.slow),
+        "f32",  # the accelerator battery's headline profile stays tier-1
+    ],
+)
+def test_mm1_packed_matches_flat_bitwise(profile):
+    with config.profile(profile):
+        spec, _ = mm1.build(record=True)
+        sims = jax.vmap(
+            lambda r: cl.init_sim(spec, 7, r, mm1.params(50))
+        )(jnp.arange(4))
+        flat = jax.jit(jax.vmap(cl.make_run(spec, pack=False)))(sims)
+        packed = jax.jit(jax.vmap(cl.make_run(spec, pack=True)))(sims)
+        assert int(jnp.sum(flat.n_events)) > 300
+        _assert_trees_equal(flat, packed)
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_unbatched_packed_matches_flat():
+    spec, _ = mm1.build(record=False)
+    sim = cl.init_sim(spec, 3, 0, mm1.params(40))
+    _assert_trees_equal(
+        jax.jit(cl.make_run(spec, pack=False))(sim),
+        jax.jit(cl.make_run(spec, pack=True))(sim),
+    )
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_default_is_flat_jaxpr_on_cpu():
+    """The trace-time gate: with CIMBA_XLA_PACK unset on the CPU backend
+    (and pack=0 always), make_run's jaxpr is today's per-leaf one —
+    character-identical, the same pin test_trace uses for the
+    observability zero-op contract."""
+    if jax.default_backend() != "cpu":
+        pytest.skip("default-gate pin is for the CPU backend")
+    spec, _ = mm1.build(record=False)
+    sim = cl.init_sim(spec, 1, 0, mm1.params(10))
+    j_default = str(jax.make_jaxpr(cl.make_run(spec))(sim))
+    j_flat = str(jax.make_jaxpr(cl.make_run(spec, pack=False))(sim))
+    assert j_default == j_flat
+    j_packed = str(jax.make_jaxpr(cl.make_run(spec, pack=True))(sim))
+    assert j_packed != j_flat  # the knob is live
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells (the ci.sh packed+hier smoke keeps a quick twin)
+def test_packed_plus_hier_combined_matches_flat():
+    """The full new arm (packed carry + hierarchical event set) against
+    the full old arm (per-leaf carry + flat scan) on a general-table-
+    heavy model: every shared Sim leaf bitwise equal."""
+    from test_eventset_hier import _layout, _timer_model
+
+    def arm(hier, pack):
+        with _layout(hier):
+            spec = _timer_model(256, per_resume=10, n_sched=6, n_exit=16)
+            sims = jax.vmap(
+                lambda r: cl.init_sim(spec, 13, r, None)
+            )(jnp.arange(3))
+            return jax.jit(jax.vmap(cl.make_run(spec, pack=pack)))(sims)
+
+    old = arm(hier=False, pack=False)
+    new = arm(hier=True, pack=True)
+    assert not bool(jnp.any(old.err != 0))
+    new_by_path = dict(
+        (jax.tree_util.keystr(p), l)
+        for p, l in jax.tree_util.tree_flatten_with_path(new)[0]
+    )
+    for path, a in jax.tree_util.tree_flatten_with_path(old)[0]:
+        b = new_by_path[jax.tree_util.keystr(path)]
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(path)
+        )
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_mg1_sweep_packed_matches_flat_pooled(profile):
+    """M/G/1 sweep pooled statistics, packed vs flat, both profiles
+    (the acceptance battery's second model)."""
+    from cimba_tpu.models import mg1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    with config.profile(profile):
+        spec, _ = mg1.build()
+        params, cells = mg1.sweep_params(120, reps_per_cell=2)
+        R = len(cells)
+        outs = []
+        for pack in (False, True):
+            res = ex.run_experiment(spec, params, R, seed=9, pack=pack)
+            assert int(res.n_failed) == 0
+            outs.append(res)
+        _assert_trees_equal(outs[0].sims, outs[1].sims)
+        pooled = [
+            jax.jit(sm.merge_tree)(r.sims.user["wait"]) for r in outs
+        ]
+        _assert_trees_equal(pooled[0], pooled[1])

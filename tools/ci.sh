@@ -65,6 +65,45 @@ print(f"cpu smoke rate {rate:.0f} ev/s (floor {floor:.0f})")
 sys.exit(0 if rate >= floor else 1)
 EOF
 
+# packed+hierarchical smoke: the mm1 headline must measure BOTH dispatch
+# arms (packed carry + hierarchical event-set min vs the flat oracle) in
+# one battery line (docs/11_dispatch_cost.md), and a timer-heavy model
+# must run bitwise-identical under the new arm
+run_cell "packed+hier smoke" python - <<'EOF'
+import json, os, subprocess, sys
+env = dict(os.environ)
+env["CIMBA_BENCH_FORCE_CPU"] = "1"
+env["CIMBA_BENCH_R"] = "32"
+env["CIMBA_BENCH_OBJECTS"] = "200"
+env["CIMBA_BENCH_METRICS"] = "0"
+out = subprocess.run(
+    [sys.executable, "bench.py"], env=env, capture_output=True, text=True,
+    timeout=900,
+).stdout.strip().splitlines()[-1]
+line = json.loads(out)
+arms = line["detail"]["dispatch_arms"]
+assert set(arms) == {"packed_hier", "flat"}, arms
+for a in arms.values():
+    assert a["events_per_sec"] > 0 and a["failed_replications"] == 0, arms
+print("dispatch arms OK:",
+      {k: round(v["events_per_sec"]) for k, v in arms.items()})
+
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, "tests")
+from test_eventset_hier import _layout, _timer_model
+from cimba_tpu.core import loop as cl
+def arm(hier, pack):
+    with _layout(hier):
+        spec = _timer_model(256, per_resume=10, n_sched=6, n_exit=16)
+        sims = jax.vmap(lambda r: cl.init_sim(spec, 2, r, None))(jnp.arange(2))
+        return jax.jit(jax.vmap(cl.make_run(spec, pack=pack)))(sims)
+old, new = arm(False, False), arm(True, True)
+assert int(jnp.sum(old.n_events)) > 0 and not bool(jnp.any(old.err != 0))
+np.testing.assert_array_equal(np.asarray(old.clock), np.asarray(new.clock))
+np.testing.assert_array_equal(np.asarray(old.n_events), np.asarray(new.n_events))
+print("packed+hier trajectory smoke OK:", int(jnp.sum(old.n_events)), "events")
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
