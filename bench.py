@@ -264,7 +264,7 @@ def _obs_section():
 
 
 def _line(metric, rate, vs_baseline, detail):
-    _last_activity[0] = time.monotonic()
+    _heartbeat()
     detail["backend"] = jax.default_backend()
     if _fallback_reason is not None:
         detail["backend_fallback"] = _fallback_reason
@@ -425,7 +425,7 @@ def bench_mm1():
         # the child's wait is legitimate inactivity up to its own
         # timeout: refresh the heartbeat at spawn so the watchdog's
         # window starts now, not at the previous config's line
-        _last_activity[0] = time.monotonic()
+        _heartbeat()
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
@@ -454,7 +454,7 @@ def bench_mm1():
         # the child's wait is bounded by its own timeout above, not by
         # the watchdog: count its completion as activity so the parent's
         # remaining XLA measurements get the full deadline window
-        _last_activity[0] = time.monotonic()
+        _heartbeat()
         detail = (parsed or {}).get("detail", {})
         kernel_ok = (
             parsed
@@ -500,7 +500,9 @@ def bench_mm1():
             ):
                 # kernel within 2x: decide at the SAME operating point —
                 # re-measure the XLA arm at the child's (R, N)
-                xla_cmp, _ = _mm1_xla_arms(int(k_r or R), int(k_n), prof)
+                xla_cmp, _ = _mm1_xla_arms(
+                    int(k_r or R), int(k_n), prof, stream=False
+                )
                 xla_detail["xla_at_kernel_point"] = {
                     "replications": int(k_r or R),
                     "objects_per_replication": int(k_n),
@@ -517,7 +519,7 @@ def bench_mm1():
             for k in _F64_TWIN_KEYS:
                 if k in xla_detail:
                     parsed["detail"][k] = xla_detail[k]
-            _last_activity[0] = time.monotonic()  # headline = activity
+            _heartbeat()  # headline = activity
             print(json.dumps(parsed), flush=True)
         else:
             if kernel_ok:
@@ -621,11 +623,13 @@ class _dispatch_arm:
         _cfg.XLA_PACK, _cfg.EVENTSET_HIER = self._prev
 
 
-def _mm1_xla_arms(R, N, prof="f64"):
+def _mm1_xla_arms(R, N, prof="f64", stream=True):
     """Measure the mm1 XLA path in BOTH dispatch arms at the same R x N;
     returns (best_rate, detail-of-best) with the per-arm numbers under
     ``detail.dispatch_arms`` — the packed+hierarchical-vs-flat battery
-    the headline now always carries."""
+    the headline now always carries — and (``stream=True``) the
+    chunked/streamed arm at the same R x N under ``detail.stream_arm``
+    (docs/12_streaming.md)."""
     arms = {}
     best = None
     for arm in ("packed_hier", "flat"):
@@ -641,7 +645,154 @@ def _mm1_xla_arms(R, N, prof="f64"):
             best = (rate, detail)
     rate, detail = best
     detail["dispatch_arms"] = arms
+    if stream and os.environ.get("CIMBA_BENCH_STREAM", "1") != "0":
+        try:
+            detail["stream_arm"] = _mm1_stream_arm(R, N, prof, rate)
+        except Exception as e:  # the arm must never kill the headline
+            detail["stream_arm"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]
+            }
     return rate, detail
+
+
+def _heartbeat(*_args):
+    """Watchdog heartbeat for loop-internal progress: a long streamed
+    battery refreshes per wave/chunk, not only per config line — the
+    2400 s deadline must measure inactivity, not one config's honest
+    wall time (the kernel-child spawn fix of round 6, applied to the
+    chunk loop)."""
+    _last_activity[0] = time.monotonic()
+
+
+def _stream_chunk_default():
+    """Default chunk size for the chunked/streamed arms: big enough that
+    per-chunk dispatch amortizes, small enough that one chunk's device
+    program stays well under the ~3 min runtime watchdog."""
+    return int(
+        os.environ.get(
+            "CIMBA_BENCH_STREAM_CHUNK", "4096" if _accel() else "256"
+        )
+    )
+
+
+def _warm_stream(spec, R, wave, chunk, cache):
+    """Warm the stream's init/chunk/fold programs at one full wave PLUS
+    the ragged remainder shape (when R does not tile into waves): the
+    timed stream then reuses every compiled shape — a remainder-shaped
+    compile inside the timed region would dominate a CPU measurement.
+    Tiny per-lane workload; reuse requires the timed call to pass the
+    SAME spec object and cache dict."""
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+
+    ex.run_experiment_stream(
+        spec, mm1.params(1), wave + R % wave, wave_size=wave,
+        chunk_steps=chunk, seed=2026, on_wave=_heartbeat,
+        on_chunk=_heartbeat, program_cache=cache,
+    )
+
+
+def _mm1_stream_arm(R, N, prof, mono_rate):
+    """The chunked + streamed arms at the SAME R x N as the monolithic
+    headline (warm-then-time, mirroring ``_time_vmapped``): chunked =
+    one donated chunk program re-dispatched by the host
+    (loop.make_chunked_run — the watchdog-proof path), streamed = the
+    same chunk program fed waves of R/4 lanes with on-device pooled-
+    summary folding (runner.run_experiment_stream).
+
+    The chunked arm's overhead is the number the donation contract
+    promises stays small (<= ~5% at the CPU default point).  It is
+    computed against a monolithic TWIN measured HERE, interleaved
+    best-of-``CIMBA_BENCH_STREAM_REPEATS`` with the chunked arm — the
+    headline monolithic rate is measured at a different moment in the
+    battery, and on a noisy shared host the load difference alone can
+    dwarf the real per-chunk cost (the headline rate still rides along
+    as ``headline_monolithic_events_per_sec``)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    chunk = _stream_chunk_default()
+    repeats = max(1, int(os.environ.get(
+        "CIMBA_BENCH_STREAM_REPEATS", "3" if not _accel() else "1"
+    )))
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        crun = cl.make_chunked_run(
+            spec, chunk_steps=chunk, poll_every=4, on_chunk=_heartbeat
+        )
+        mrun = jax.jit(jax.vmap(cl.make_run(spec)))
+        ijit = jax.jit(
+            jax.vmap(
+                lambda r, n: cl.init_sim(spec, 2026, r, mm1.params(n)),
+                in_axes=(0, None),
+            )
+        )
+        # warm both arms at the real shapes
+        jax.block_until_ready(
+            jax.tree.leaves(mrun(ijit(jnp.arange(R), jnp.int32(1))))
+        )
+        jax.block_until_ready(
+            jax.tree.leaves(crun(ijit(jnp.arange(R), jnp.int32(1))))
+        )
+        mono_wall, wall = None, None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            mout = mrun(ijit(jnp.arange(R), jnp.int32(N)))
+            jax.block_until_ready(jax.tree.leaves(mout))
+            dt = time.perf_counter() - t0
+            mono_wall = dt if mono_wall is None else min(mono_wall, dt)
+            _heartbeat()
+            t0 = time.perf_counter()
+            out = crun(ijit(jnp.arange(R), jnp.int32(N)))
+            jax.block_until_ready(jax.tree.leaves(out))
+            dt = time.perf_counter() - t0
+            wall = dt if wall is None else min(wall, dt)
+        ev = int(jnp.sum(out.n_events.astype(jnp.int64)))
+        failed = int((out.err != 0).sum())
+        rate = ev / wall
+        twin_rate = ev / mono_wall
+        detail = {
+            "chunk_steps": chunk,
+            "replications": R,
+            "objects_per_replication": N,
+            "repeats_best_of": repeats,
+            "monolithic_twin_events_per_sec": twin_rate,
+            "headline_monolithic_events_per_sec": mono_rate,
+            "chunked": {
+                "events_per_sec": rate,
+                "total_events": ev,
+                "wall_s": wall,
+                "failed_replications": failed,
+                "overhead_vs_monolithic_pct": (
+                    (twin_rate - rate) / twin_rate * 100.0
+                ),
+            },
+        }
+        # streamed leg: 4 waves through the one compiled chunk program,
+        # pooled on device (program_cache keeps the timed call warm)
+        wave = max(R // 4, 1)
+        cache = {}
+        _warm_stream(spec, R, wave, chunk, cache)
+        t0 = time.perf_counter()
+        st = ex.run_experiment_stream(
+            spec, mm1.params(N), R, wave_size=wave, chunk_steps=chunk,
+            seed=2026, on_wave=_heartbeat, on_chunk=_heartbeat,
+            program_cache=cache,
+        )
+        sev = int(jax.block_until_ready(st.total_events))
+        swall = time.perf_counter() - t0
+        detail["streamed"] = {
+            "events_per_sec": sev / swall,
+            "total_events": sev,
+            "wall_s": swall,
+            "wave_size": wave,
+            "n_waves": st.n_waves,
+            "failed_replications": int(st.n_failed),
+            "pooled_mean_sojourn": float(sm.mean(st.summary)),
+        }
+    return detail
 
 
 def _attach_f64_twin(detail, R, N):
@@ -686,6 +837,78 @@ def _mm1_xla(R, N, prof="f64", arm=None):
         if failed:
             detail["regrow"] = _regrow_pass(spec, mm1.params(N), R)
     return ev / wall, detail
+
+
+def bench_mm1_stream():
+    """Large-R streamed M/M/1: pooled sojourn statistics for R beyond
+    the single-dispatch lane budget (the "heavy traffic from millions of
+    users" shape of the ROADMAP north star).  Waves of
+    ``CIMBA_BENCH_STREAM_WAVE`` lanes stream through one compiled,
+    donated chunk program; per-wave Pébay summaries fold on device, so
+    device memory holds ONE wave of sims regardless of R — the
+    monolithic path at these R would need every Sim HBM-resident
+    simultaneously (131072 lanes was its measured ceiling).
+
+    Overrides: CIMBA_BENCH_STREAM_R (total replications),
+    CIMBA_BENCH_STREAM_WAVE (lanes per wave), CIMBA_BENCH_OBJECTS
+    (per-lane workload), CIMBA_BENCH_STREAM_CHUNK (events per chunk
+    dispatch)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    accel = _accel()
+    R = int(
+        os.environ.get(
+            "CIMBA_BENCH_STREAM_R", str(2**20 if accel else 8192)
+        )
+    )
+    wave = min(
+        int(
+            os.environ.get(
+                "CIMBA_BENCH_STREAM_WAVE", str(65536 if accel else 1024)
+            )
+        ),
+        R,
+    )
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = _stream_chunk_default()
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = {}
+        _warm_stream(spec, R, wave, chunk, cache)
+        t0 = time.perf_counter()
+        st = ex.run_experiment_stream(
+            spec, mm1.params(N), R, wave_size=wave, chunk_steps=chunk,
+            seed=2026, on_wave=_heartbeat, on_chunk=_heartbeat,
+            program_cache=cache,
+        )
+        ev = int(jax.block_until_ready(st.total_events))
+        wall = time.perf_counter() - t0
+    rate = ev / wall
+    _line(
+        "mm1_stream_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "path": "xla_while_streamed",
+            "profile": prof,
+            "replications": R,
+            "wave_size": wave,
+            "n_waves": st.n_waves,
+            "chunk_steps": chunk,
+            "objects_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": int(st.n_failed),
+            "pooled_mean_sojourn": float(sm.mean(st.summary)),
+            "pooled_n": float(st.summary.n),
+            # 1/(mu - lambda) for the config's rates — the sanity anchor
+            "theory_mean_sojourn": 10.0,
+        },
+    )
 
 
 def bench_mm1_single():
@@ -1027,6 +1250,7 @@ def bench_awacs():
 
 CONFIGS = {
     "mm1": bench_mm1,
+    "mm1_stream": bench_mm1_stream,
     "mm1_single": bench_mm1_single,
     "mmc": bench_mmc,
     "mg1": bench_mg1,

@@ -6,7 +6,15 @@ jit-compiled while-loop.
 """
 
 from cimba_tpu.core import api, eventset, guard, loop, model, process
-from cimba_tpu.core.loop import Sim, init_sim, make_run, make_step
+from cimba_tpu.core.loop import (
+    Sim,
+    drive_chunks,
+    init_sim,
+    make_chunk,
+    make_chunked_run,
+    make_run,
+    make_step,
+)
 from cimba_tpu.core.model import Model, ModelSpec
 from cimba_tpu.core import process as cmd  # command constructors namespace
 
@@ -19,7 +27,10 @@ __all__ = [
     "model",
     "process",
     "Sim",
+    "drive_chunks",
     "init_sim",
+    "make_chunk",
+    "make_chunked_run",
     "make_run",
     "make_step",
     "Model",

@@ -1206,7 +1206,15 @@ def _infer_used_tags(spec: ModelSpec, sim: Sim):
     try:
         p0 = jnp.zeros((), _I)
         for blk in spec.blocks:
-            jax.eval_shape(blk, sim, p0, p0)
+            # fresh wrapper per trace: jax.eval_shape memoizes on
+            # (function, avals), and a cache hit would skip the block
+            # body — the collector's side effect — entirely.  Two specs
+            # sharing block functions at identical Sim avals (e.g. a
+            # dataclasses.replace twin of a spec whose tags were already
+            # inferred) would then "infer" an EMPTY tag set and route
+            # every command to h_invalid/ERR_USER (found by the stream
+            # regrow battery, pinned in tests/test_stream.py).
+            jax.eval_shape(lambda *a: blk(*a), sim, p0, p0)
     except Exception:
         return None
     finally:
@@ -2231,6 +2239,7 @@ def make_run(
     spec: ModelSpec,
     t_end: Optional[float] = None,
     pack: Optional[bool] = None,
+    max_steps: Optional[int] = None,
 ):
     """Build ``run(sim) -> sim``: dispatch events until the model stops
     (api.stop), fails, runs out of events, or passes ``t_end``
@@ -2244,14 +2253,41 @@ def make_run(
     (core/carry.py, the same packing the Pallas chunk loop uses under
     ``CIMBA_KERNEL_PACK``).  Pack/unpack are bitwise-lossless structural
     ops, so trajectories are identical; ``pack=False`` reproduces
-    today's per-leaf jaxpr exactly.  See docs/11_dispatch_cost.md."""
+    today's per-leaf jaxpr exactly.  See docs/11_dispatch_cost.md.
+
+    ``max_steps`` bounds one invocation to at most that many dispatches
+    (the bounded-chunk variant, docs/12_streaming.md): the loop carries
+    a per-replication step counter and exits when either the liveness
+    cond fails or the counter hits the bound, so a host loop can
+    re-dispatch the returned Sim until :func:`make_cond` reports it
+    done.  Truncation is exact: each lane executes the identical step
+    sequence the unbounded loop would, merely split across invocations
+    — chunked trajectories are bitwise the monolithic ones (pinned by
+    tests/test_stream.py).  ``None`` (the default) keeps today's
+    unbounded loop, jaxpr-identical to before this knob existed."""
     step = make_step(spec)
     cond = make_cond(spec, t_end)
     if pack is None:
         pack = config.xla_pack_enabled()
+    if max_steps is not None and max_steps <= 0:
+        raise ValueError(f"max_steps must be positive, got {max_steps}")
     if not pack:
+        if max_steps is None:
+            def run(sim: Sim) -> Sim:
+                return lax.while_loop(cond, step, sim)
+
+            return run
+
         def run(sim: Sim) -> Sim:
-            return lax.while_loop(cond, step, sim)
+            def kcond(kc):
+                return cond(kc[1]) & (kc[0] < max_steps)
+
+            def kbody(kc):
+                return kc[0] + jnp.asarray(1, _I), step(kc[1])
+
+            return lax.while_loop(
+                kcond, kbody, (jnp.zeros((), _I), sim)
+            )[1]
 
         return run
 
@@ -2272,17 +2308,151 @@ def make_run(
                 treedef, _carry.unpack(list(bufs), plan)
             )
 
-        def pcond(bufs):
-            return cond(unflatten(bufs))
-
-        def pbody(bufs):
+        def pstep(bufs):
             return tuple(
                 _carry.pack(jax.tree.leaves(step(unflatten(bufs))), plan)
             )
 
-        out = lax.while_loop(
-            pcond, pbody, tuple(_carry.pack(leaves, plan))
-        )
-        return unflatten(out)
+        if max_steps is None:
+            def pcond(bufs):
+                return cond(unflatten(bufs))
 
+            out = lax.while_loop(
+                pcond, pstep, tuple(_carry.pack(leaves, plan))
+            )
+            return unflatten(out)
+
+        def kcond(kb):
+            return cond(unflatten(kb[1])) & (kb[0] < max_steps)
+
+        def kbody(kb):
+            return kb[0] + jnp.asarray(1, _I), pstep(kb[1])
+
+        out = lax.while_loop(
+            kcond, kbody,
+            (jnp.zeros((), _I), tuple(_carry.pack(leaves, plan))),
+        )
+        return unflatten(out[1])
+
+    return run
+
+
+# --- chunked dispatch: watchdog-proof runs of any length ---------------------
+
+
+def make_chunk(
+    spec: ModelSpec,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+    max_steps: int = 1024,
+):
+    """Build ``chunk(sims) -> (sims, any_live)`` over a BATCHED Sim
+    (leading lane axis): one bounded dispatch chunk (each lane advances
+    at most ``max_steps`` events) plus the cheap liveness scalar the
+    host loop polls.  Not jitted — callers jit it with donation
+    (:func:`make_chunked_run`) or wrap it in ``shard_map`` first
+    (``runner.experiment`` composes it with the replication mesh)."""
+    bounded = make_run(spec, t_end=t_end, pack=pack, max_steps=max_steps)
+    cond = make_cond(spec, t_end)
+
+    def chunk(sims: Sim):
+        sims = jax.vmap(bounded)(sims)
+        return sims, jnp.any(jax.vmap(cond)(sims))
+
+    return chunk
+
+
+def drive_chunks(
+    chunk,
+    sims: Sim,
+    *,
+    poll_every: int = 4,
+    on_chunk=None,
+    on_state=None,
+    on_state_every: int = 0,
+    max_chunks: Optional[int] = None,
+    n0: int = 0,
+) -> Sim:
+    """Host loop over a jitted, donated ``chunk(sims) -> (sims,
+    any_live)``: re-dispatch until every lane is done.
+
+    The ``any_live`` scalar is polled ASYNCHRONOUSLY: up to
+    ``poll_every`` chunks are queued before the oldest flag is read, so
+    jax's async dispatch keeps the device pipeline full instead of
+    round-tripping a host sync per chunk.  Over-dispatched chunks after
+    global completion are exact no-ops (every lane's cond is false, the
+    while loop exits at iteration 0, and donation aliases the buffers
+    straight through), so late polling never perturbs the result.
+
+    ``on_chunk(n)`` fires after each dispatch — bench.py refreshes its
+    watchdog heartbeat here.  ``on_state(sims, n)`` fires every
+    ``on_state_every`` chunks with the CURRENT batched Sim, *before* it
+    is donated into the next chunk — the checkpoint hook (chunk
+    boundaries are the natural checkpoints; ``runner.checkpoint``
+    serializes from here).  ``n0`` offsets the chunk counter (a resumed
+    run keeps counting where the checkpoint left off).  ``max_chunks``
+    is an optional hard stop (the returned Sim may then be unfinished;
+    :func:`make_cond` tells).
+    """
+    from collections import deque
+
+    poll_every = max(int(poll_every), 1)
+    pending = deque()
+    n = n0
+    while max_chunks is None or n - n0 < max_chunks:
+        sims, any_live = chunk(sims)
+        n += 1
+        if on_chunk is not None:
+            on_chunk(n)
+        if (
+            on_state is not None
+            and on_state_every > 0
+            and n % on_state_every == 0
+        ):
+            on_state(sims, n)
+        pending.append(any_live)
+        if len(pending) >= poll_every and not bool(pending.popleft()):
+            break
+    return sims
+
+
+def make_chunked_run(
+    spec: ModelSpec,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+    chunk_steps: int = 1024,
+    poll_every: int = 4,
+    donate: bool = True,
+    on_chunk=None,
+    max_chunks: Optional[int] = None,
+):
+    """Build ``run(sims) -> sims`` over a batched Sim: the chunked,
+    device-resident twin of ``jit(vmap(make_run(spec)))``.
+
+    One jitted chunk program advances every lane at most ``chunk_steps``
+    dispatches; the host re-dispatches it with ``donate_argnums`` so the
+    batched Sim stays on device with ZERO inter-chunk copies (XLA
+    aliases each chunk's input buffers to its outputs), polling the
+    ``any_live`` scalar every ``poll_every`` chunks (see
+    :func:`drive_chunks`).  Trajectories are bitwise the monolithic
+    run's — chunking only splits the while loop across dispatches — but
+    no single device program runs longer than one chunk, so runs of any
+    length clear the TPU runtime's ~3-minute program watchdog
+    (docs/12_streaming.md).
+
+    The jitted chunk is exposed as ``run.chunk`` (tests verify its
+    donation) and compiles ONCE per batch shape — warm re-runs reuse it.
+    """
+    chunk = jax.jit(
+        make_chunk(spec, t_end=t_end, pack=pack, max_steps=chunk_steps),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def run(sims: Sim) -> Sim:
+        return drive_chunks(
+            chunk, sims, poll_every=poll_every, on_chunk=on_chunk,
+            max_chunks=max_chunks,
+        )
+
+    run.chunk = chunk
     return run

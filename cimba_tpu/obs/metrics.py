@@ -181,6 +181,21 @@ def pool(m: Metrics) -> Metrics:
     )
 
 
+def merge(a: Metrics, b: Metrics) -> Metrics:
+    """Merge two (already lane-pooled) registries into one — the stream
+    runner's wave fold (``run_experiment_stream``): counters and
+    histogram bins add, high-water gauges max.  The same associative,
+    commutative algebra :func:`pool` applies along the lane axis, so
+    folding waves one at a time equals pooling all lanes at once."""
+    return Metrics(
+        dispatch_by_kind=a.dispatch_by_kind + b.dispatch_by_kind,
+        guard_retries=a.guard_retries + b.guard_retries,
+        queue_hwm=jnp.maximum(a.queue_hwm, b.queue_hwm),
+        event_hwm=jnp.maximum(a.event_hwm, b.event_hwm),
+        chain_hist=a.chain_hist + b.chain_hist,
+    )
+
+
 def pool_across(m: Metrics, axis_name: str) -> Metrics:
     """Pool an (already lane-pooled) registry across a mesh axis inside
     ``shard_map`` — the ICI leg: ``psum`` for the summable fields,

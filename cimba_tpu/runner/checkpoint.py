@@ -84,11 +84,84 @@ def spec_tag(spec: Any) -> str:
     })
 
 
+def run_tag(
+    spec: Any, *, seed: int, params: Any = None, t_end: Any = None,
+) -> str:
+    """:func:`spec_tag` extended with the run's ``seed``, horizon, and a
+    digest of its (broadcast) params: a chunked checkpoint restored
+    under a different seed, ``t_end``, or swept parameters would
+    silently continue/hybridize the OLD run — the shapes all match — so
+    the runner fingerprints every trajectory-changing knob and a
+    mismatched resume fails loudly instead (chunk_steps/pack are
+    trajectory-neutral and stay out of the tag)."""
+    import hashlib
+
+    base = json.loads(spec_tag(spec))
+    base["seed"] = int(seed)
+    base["t_end"] = None if t_end is None else float(t_end)
+    if params is not None:
+        h = hashlib.sha256()
+        for x in _flatten(params)[0]:
+            a = np.asarray(x)
+            h.update(f"{a.shape}:{a.dtype}:".encode())
+            h.update(a.tobytes())
+        base["params_sha256"] = h.hexdigest()
+    return json.dumps(base)
+
+
+def save_resumable(
+    path: str, sims: Any, *, spec: Any = None, progress: int = 0,
+    tag: Optional[str] = None,
+) -> None:
+    """Checkpoint a chunked run at a chunk boundary: the batched Sim
+    plus its chunk counter, spec-fingerprinted (chunk boundaries are
+    the natural checkpoints — between chunks the COMPLETE state of
+    every replication, RNG position included, is the Sim pytree the
+    host loop holds; ``run_experiment_chunked`` calls this from its
+    ``on_state`` hook).  ``spec`` supplies the fingerprint tag via
+    :func:`spec_tag` unless an explicit ``tag`` is given.
+
+    ``spec_tag`` alone does NOT guard against resuming under a
+    different seed, horizon, or swept params — those all produce
+    identical shapes and spec identity.  Callers checkpointing a
+    specific run should pass ``tag=run_tag(spec, seed=..., params=...,
+    t_end=...)`` as ``run_experiment_chunked`` does; the bare ``spec=``
+    form only proves the model matches."""
+    if tag is None and spec is not None:
+        tag = spec_tag(spec)
+    save(
+        path,
+        (sims, jnp.asarray(int(progress), jnp.int32)),
+        tag=tag,
+    )
+
+
+def restore_resumable(
+    path: str, like: Any, *, spec: Any = None, tag: Optional[str] = None,
+):
+    """Inverse of :func:`save_resumable`: returns ``(sims, progress)``.
+    ``like`` is a same-shaped batched Sim — a fresh init of the same
+    experiment or its ``jax.eval_shape`` aval tree (no materialization);
+    validation is :func:`restore`'s — the first mismatching leaf or a
+    spec-fingerprint change fails loudly.  As with
+    :func:`save_resumable`, pass ``tag=run_tag(...)`` to also pin the
+    run's seed/params/horizon; ``spec=`` alone only proves the model
+    matches."""
+    if tag is None and spec is not None:
+        tag = spec_tag(spec)
+    sims, progress = restore(
+        path, (like, jnp.zeros((), jnp.int32)), tag=tag
+    )
+    return sims, int(progress)
+
+
 def restore(path: str, like: Any, *, tag: Optional[str] = None) -> Any:
     """Read a checkpoint written by :func:`save`; ``like`` supplies the
-    pytree structure and every leaf's expected shape and dtype (e.g. a
-    freshly-initialized batch).  Raises ``ValueError`` naming the first
-    mismatch if the file disagrees with ``like`` or with ``tag``."""
+    pytree structure and every leaf's expected shape and dtype — a
+    freshly-initialized batch, or its ``jax.eval_shape`` aval tree
+    (``ShapeDtypeStruct`` leaves carry exactly what validation reads,
+    without materializing a batch).  Raises ``ValueError`` naming the
+    first mismatch if the file disagrees with ``like`` or with ``tag``."""
     leaves, treedef = _flatten(like)
     with np.load(path) as data:
         names = [f for f in data.files if f != "__spec__"]
@@ -123,7 +196,10 @@ def restore(path: str, like: Any, *, tag: Optional[str] = None) -> Any:
         for i, x in enumerate(leaves):
             arr = data[f"leaf_{i}"]
             want_shape = tuple(np.shape(x))
-            want_dtype = np.asarray(x).dtype
+            # ShapeDtypeStruct / jax array leaves carry .dtype; plain
+            # python scalars fall back through asarray
+            dt = getattr(x, "dtype", None)
+            want_dtype = np.dtype(dt) if dt is not None else np.asarray(x).dtype
             if tuple(arr.shape) != want_shape:
                 raise ValueError(
                     f"checkpoint leaf {i}: shape {tuple(arr.shape)} != "

@@ -48,6 +48,13 @@ def run_dryrun(n_devices: int) -> None:
         golden = 4.112945867223963
         assert abs(mean - golden) <= 1e-9 * golden, (mean, golden)
 
+    # the chunked/streamed arm over the same mesh: waves of (local lanes
+    # x devices) through one donated chunk program under shard_map must
+    # reproduce the monolithic sharded experiment's event count and
+    # pooled statistics (stream fold = associative Pébay merge)
+    stream_events = _dryrun_stream_mesh(
+        mesh, n_devices, spec, reps, int(events), pooled
+    )
     # the Pallas kernel path over the same mesh (interpret mode on the
     # virtual devices; Mosaic-compiled on real chips): per-device chunk
     # kernels under shard_map must agree with the XLA path's event counts
@@ -59,10 +66,39 @@ def run_dryrun(n_devices: int) -> None:
     print(
         f"dryrun_multichip OK: {n_devices} devices, "
         f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}, "
+        f"stream-mesh events {stream_events}, "
         f"kernel-mesh events {kernel_events}, "
         f"awacs-boundary-mesh events {awacs_events}",
         flush=True,
     )
+
+
+def _dryrun_stream_mesh(mesh, n_devices, spec, n_reps, mono_events,
+                        mono_pooled) -> int:
+    """Streamed waves over the mesh (runner.run_experiment_stream): the
+    wave chunk program shards lanes per device (shard_map + donated
+    re-dispatch, liveness psum-polled over ICI); pooled statistics must
+    match the monolithic sharded experiment."""
+    import jax
+
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    st = ex.run_experiment_stream(
+        spec, mm1.params(50), n_reps,
+        wave_size=8 * n_devices, chunk_steps=32, seed=1, mesh=mesh,
+    )
+    st = jax.block_until_ready(st)
+    assert int(st.n_failed) == 0, f"stream dryrun failures: {st.n_failed}"
+    assert int(st.total_events) == mono_events, (
+        int(st.total_events), mono_events,
+    )
+    assert float(st.summary.n) == float(mono_pooled.n)
+    m_mono, m_st = float(sm.mean(mono_pooled)), float(sm.mean(st.summary))
+    assert abs(m_st - m_mono) <= 1e-9 * abs(m_mono), (m_st, m_mono)
+    assert st.n_waves == n_reps // (8 * n_devices), st.n_waves
+    return int(st.total_events)
 
 
 def _dryrun_model_mesh(mesh, n_devices: int, build, params, label) -> int:

@@ -48,7 +48,13 @@ def shard_map(f, **kw):
         kw["check_rep"] = kw.pop("check_vma")
     return _shard_map_impl(f, **kw)
 
-from cimba_tpu.core.loop import Sim, init_sim, make_run
+from cimba_tpu.core.loop import (
+    Sim,
+    drive_chunks,
+    init_sim,
+    make_chunk,
+    make_run,
+)
 from cimba_tpu.core.model import ModelSpec
 from cimba_tpu.stats import summary as sm
 
@@ -59,6 +65,19 @@ class ExperimentResult(NamedTuple):
     sims: Sim                 # batched: every leaf has leading axis [R]
     n_failed: jnp.ndarray     # replications with err != 0
     total_events: jnp.ndarray # dispatched events across all replications
+
+
+class StreamResult(NamedTuple):
+    """What :func:`run_experiment_stream` returns: pooled statistics for
+    all R replications WITHOUT the batched sims (they were streamed
+    through the device in waves and folded into these accumulators)."""
+
+    summary: sm.Summary        # pooled over every replication
+    n_failed: jnp.ndarray      # replications with err != 0, all waves
+    total_events: jnp.ndarray  # i64 dispatched events, all waves
+    n_waves: int
+    n_regrows: int             # wave-granular capacity regrows performed
+    metrics: Any = None        # pooled obs.metrics registry when enabled
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -78,6 +97,29 @@ def _broadcast_params(params: Any, n: int):
         return jnp.broadcast_to(x, (n,) + x.shape)
 
     return jax.tree.map(bc, params)
+
+
+def _slice_params(params: Any, n_total: int, lo: int, n: int):
+    """The wave view of an experiment array: swept leaves (leading axis
+    ``n_total``) are sliced to rows ``[lo, lo+n)``; every other leaf is
+    broadcast to the wave exactly as ``_broadcast_params`` would have
+    broadcast it to the full batch.
+
+    ``_slice_params(p, R, lo, n)`` is bitwise
+    ``_broadcast_params(p, R)[lo:lo+n]`` on every leaf — the wave's
+    lanes see exactly the parameter rows the monolithic run's lanes
+    ``lo..lo+n-1`` see, WITHOUT materializing any [R]-sized array (the
+    M/G/1 sweep regression, pinned in tests/test_stream.py).  Shared
+    leaves are broadcast here (not left to a later ``_broadcast_params``
+    pass) so a shared leaf whose leading axis happens to equal the wave
+    size cannot be misread as per-lane data."""
+    def sl(x):
+        x = jnp.asarray(x)
+        if x.ndim > 0 and x.shape[0] == n_total:
+            return x[lo : lo + n]
+        return jnp.broadcast_to(x, (n,) + x.shape)
+
+    return jax.tree.map(sl, params)
 
 
 def run_experiment(
@@ -228,6 +270,391 @@ def run_experiment_regrow(
         f"{max_regrows} doublings (last run at event_cap={spec.event_cap}) "
         "— the model schedules unboundedly or the cap estimate is "
         "pathologically low"
+    )
+
+
+def _chunk_program(
+    spec: ModelSpec,
+    t_end,
+    pack,
+    chunk_steps: int,
+    mesh: Optional[Mesh],
+    donate: bool = True,
+):
+    """One compiled chunk program: ``chunk(sims) -> (sims, any_live)``,
+    jitted with the batched Sim DONATED so chunk n+1 aliases chunk n's
+    output buffers — zero inter-chunk copies, flat steady-state device
+    memory (the donation contract, docs/12_streaming.md).  Under a mesh
+    the chunk runs per-shard with the liveness flag psum-reduced over
+    ICI, so the host polls one replicated scalar."""
+    chunk_local = make_chunk(
+        spec, t_end=t_end, pack=pack, max_steps=chunk_steps
+    )
+    if mesh is None:
+        chunk = chunk_local
+    else:
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(REP_AXIS),),
+            out_specs=(P(REP_AXIS), P()),
+            check_vma=False,
+        )
+        def chunk(sims):
+            sims, live_local = chunk_local(sims)
+            n_live = jax.lax.psum(
+                live_local.astype(jnp.int32), REP_AXIS
+            )
+            return sims, n_live > 0
+
+    return jax.jit(chunk, donate_argnums=(0,) if donate else ())
+
+
+def _init_program(spec: ModelSpec, seed, mesh: Optional[Mesh]):
+    """``init(reps, params) -> batched Sim`` (sharded over the mesh when
+    one is given, so the chunk program never reshards)."""
+    def init(reps, p):
+        return jax.vmap(lambda r, q: init_sim(spec, seed, r, q))(reps, p)
+
+    if mesh is not None:
+        init = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(REP_AXIS), P(REP_AXIS)),
+            out_specs=P(REP_AXIS),
+            check_vma=False,
+        )(init)
+    return jax.jit(init)
+
+
+def run_experiment_chunked(
+    spec: ModelSpec,
+    params: Any,
+    n_replications: int,
+    *,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+    chunk_steps: int = 1024,
+    poll_every: int = 4,
+    donate: bool = True,
+    on_chunk=None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+):
+    """:func:`run_experiment` with chunked, donated dispatch: the batched
+    Sim stays device-resident while the host re-dispatches one compiled
+    chunk program (every lane advances at most ``chunk_steps`` events
+    per dispatch) until all lanes finish.
+
+    Trajectories are bitwise the monolithic run's — chunking only splits
+    the while loop across dispatches — but no single device program
+    outlives one chunk, so arbitrarily long runs clear the TPU runtime's
+    ~3-minute program watchdog, and the ``any_live`` poll (every
+    ``poll_every`` chunks, asynchronous) keeps the dispatch pipeline
+    full.  See docs/12_streaming.md.
+
+    ``checkpoint_path`` + ``checkpoint_every`` save the batched Sim at
+    chunk boundaries (``runner.checkpoint.save_resumable``, tagged with
+    spec identity, ``seed``, and a params digest); ``resume=True``
+    restores from an existing checkpoint first — the resumed run is
+    bit-identical to an uninterrupted one (the Sim is the complete
+    state, RNG position included), and a resume under a different spec,
+    seed, or params fails loudly on the fingerprint instead of silently
+    continuing the old run.
+    """
+    import os as _os
+
+    pb = _broadcast_params(params, n_replications)
+    reps = jnp.arange(n_replications)
+    if mesh is not None and n_replications % mesh.devices.size:
+        raise ValueError(
+            f"n_replications={n_replications} must divide evenly over "
+            f"{mesh.devices.size} devices"
+        )
+    init_j = _init_program(spec, seed, mesh)
+
+    n0 = 0
+    sims = None
+    ckpt_tag = None
+    if checkpoint_path:
+        from cimba_tpu.runner import checkpoint as _ckpt
+
+        # the tag carries seed + horizon + params digest beyond spec
+        # identity: a resume under different seed/t_end/params has
+        # matching shapes and would otherwise silently continue the OLD
+        # run's trajectories
+        ckpt_tag = _ckpt.run_tag(spec, seed=seed, params=pb, t_end=t_end)
+    if checkpoint_path and resume:
+        if _os.path.exists(checkpoint_path):
+            # restore validates against an ABSTRACT init (eval_shape):
+            # materializing a full fresh batch just to serve as the
+            # shape/dtype template would waste the init compute and
+            # transiently hold TWO full batched Sims on exactly the
+            # memory-bound runs checkpointing targets
+            sims, n0 = _ckpt.restore_resumable(
+                checkpoint_path, jax.eval_shape(init_j, reps, pb),
+                tag=ckpt_tag,
+            )
+    if sims is None:
+        sims = init_j(reps, pb)
+
+    on_state = None
+    if checkpoint_path and checkpoint_every:
+        def on_state(s, n):
+            _ckpt.save_resumable(
+                checkpoint_path, s, tag=ckpt_tag, progress=n
+            )
+
+    chunk = _chunk_program(spec, t_end, pack, chunk_steps, mesh, donate)
+    sims = drive_chunks(
+        chunk, sims, poll_every=poll_every, on_chunk=on_chunk,
+        on_state=on_state, on_state_every=checkpoint_every, n0=n0,
+    )
+    return ExperimentResult(
+        sims=sims,
+        n_failed=jnp.sum((sims.err != 0).astype(jnp.int32)),
+        total_events=jnp.sum(sims.n_events),
+    )
+
+
+def run_experiment_stream(
+    spec: ModelSpec,
+    params: Any,
+    n_replications: int,
+    *,
+    wave_size: Optional[int] = None,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+    chunk_steps: int = 1024,
+    poll_every: int = 4,
+    summary_path=lambda sims: sims.user["wait"],
+    max_regrows: int = 0,
+    on_wave=None,
+    on_chunk=None,
+    program_cache: Optional[dict] = None,
+) -> StreamResult:
+    """Pooled statistics for R replications with R beyond the
+    per-dispatch lane budget: stream waves of ``wave_size`` lanes
+    through ONE compiled chunk program (chunked, donated dispatch — see
+    :func:`run_experiment_chunked`), folding each finished wave's pooled
+    Pébay summary, metrics registry (when ``obs.metrics`` is enabled),
+    failure count, and event total into on-device accumulators.  The
+    batched sims of a wave are freed before the next wave initializes,
+    so peak device memory is one wave regardless of R — pooled
+    statistics for R in the millions without ever materializing all
+    sims.
+
+    Lane r of wave w is replication ``w*wave_size + r``: identical
+    (seed, rep)-derived streams and bitwise-identical per-wave parameter
+    rows (:func:`_slice_params`) make every replication's trajectory
+    bitwise the monolithic run's; the summary fold is the associative
+    Pébay merge, so the pooled moments match the monolithic pool up to
+    float merge-order rounding (counts and event totals exactly).
+
+    Composition: ``mesh`` shards each wave over devices (wave = local
+    lanes x devices, the ``make_sharded_experiment`` topology);
+    ``max_regrows > 0`` retries a wave under a doubled event cap when it
+    hit ``ERR_EVENT_OVERFLOW`` (regrow at wave granularity — later waves
+    keep the grown spec; healthy lanes reproduce bit-identically under
+    any capacity).  A final partial wave re-specializes the same
+    programs at the remainder shape (one extra compile).
+
+    ``on_wave(n_waves, lanes_done)`` and ``on_chunk(n)`` are progress
+    hooks (bench.py refreshes its watchdog heartbeat there).
+
+    ``program_cache``: pass the SAME dict to repeated calls to reuse
+    the compiled init/chunk/fold programs across calls (bench.py's
+    warm-then-time protocol).  Every setting a program bakes in —
+    ``spec`` identity, ``seed``, the dtype profile, the ``obs.metrics``
+    and ``obs.trace`` states, the event-set layout flags, the resolved
+    ``pack`` arm, ``t_end``, ``chunk_steps``, ``mesh``, and
+    ``summary_path`` identity — is part of the cache key, so a
+    mismatched call recompiles rather than replaying stale programs
+    (reuse requires passing the SAME spec object); jitted programs
+    additionally
+    re-specialize per wave shape internally, so full waves always share
+    one compile.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from cimba_tpu import config as _config
+    from cimba_tpu.core import loop as _cl
+    from cimba_tpu.obs import metrics as _metrics
+    from cimba_tpu.obs import trace as _trace
+
+    R = int(n_replications)
+    if R <= 0:
+        raise ValueError(f"n_replications must be positive, got {R}")
+    if wave_size is None or wave_size >= R:
+        wave_size = R
+    if wave_size <= 0:
+        raise ValueError(f"wave_size must be positive, got {wave_size}")
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        if wave_size % n_dev or R % n_dev:
+            raise ValueError(
+                f"wave_size={wave_size} and n_replications={R} must "
+                f"divide evenly over {n_dev} devices"
+            )
+
+    with_metrics = _metrics.enabled()
+    acc = (
+        sm.empty(),
+        jnp.zeros((), jnp.int64),
+        jnp.zeros((), jnp.int64),
+    )
+    if with_metrics:
+        acc = acc + (
+            _metrics.create(
+                _cl.N_KINDS + len(spec.user_handlers), len(spec.queues)
+            ),
+        )
+
+    def fold(acc, sims):
+        if (sims.metrics is None) == with_metrics:
+            raise RuntimeError(
+                "run_experiment_stream: obs.metrics was "
+                f"{'enabled' if with_metrics else 'disabled'} when the "
+                "stream started but flipped mid-stream — the flag binds "
+                "for the whole stream"
+            )
+        pooled = sm.merge_tree(summary_path(sims))
+        out = (
+            sm.merge(acc[0], pooled),
+            acc[1] + jnp.sum((sims.err != 0).astype(jnp.int64)),
+            acc[2] + jnp.sum(sims.n_events.astype(jnp.int64)),
+        )
+        if with_metrics:
+            out = out + (
+                _metrics.merge(acc[3], _metrics.pool(sims.metrics)),
+            )
+        return out
+
+    # no donation on the accumulator: its leaves are scalars (aliasing
+    # buys nothing) and sm.empty() aliases one zero buffer across
+    # moments, which XLA's donation path rejects as a double-donate
+    programs = program_cache if program_cache is not None else {}
+    # every setting a compiled program bakes in is part of its key, so a
+    # cache reused across mismatched calls recompiles instead of
+    # silently replaying the first call's horizon/arm/statistic.  The
+    # trace-time globals (dtype profile below, flight-recorder flag,
+    # eventset hierarchy, and pack=None's backend/env resolution) are
+    # resolved NOW so a flag flip between calls misses the cache rather
+    # than replaying the stale arm
+    run_key = (
+        t_end,
+        pack if pack is not None else _config.xla_pack_enabled(),
+        chunk_steps,
+        mesh,
+        _trace.enabled(),
+        _config.eventset_hier_enabled(),
+        _config.eventset_block(),
+    )
+    fold_key = ("fold", with_metrics, summary_path)
+    if fold_key not in programs:
+        programs[fold_key] = jax.jit(fold)
+    fold_j = programs[fold_key]
+
+    # one (init, chunk) program pair per spec object; jit re-specializes
+    # per wave shape internally (full waves share one compile)
+
+    def get_programs(spec):
+        # the spec's blocks/handlers/caps, the seed (init_sim closes
+        # over it), the dtype profile (trace-time global), and the
+        # obs.metrics flag are all baked into the traced programs, so
+        # all join run_key — any one of them silently replaying stale
+        # would return a DIFFERENT model's trajectories with no error.
+        # Spec identity is by object (id stays valid: the cache entry
+        # holds the spec, so the id cannot be recycled while cached);
+        # a semantically-equal rebuilt spec merely recompiles, which is
+        # safe.  Regrow's dataclasses.replace yields a new object, so
+        # grown capacities get their own programs as before.
+        key = (
+            id(spec), seed, _config.active_profile(), with_metrics,
+        ) + run_key
+        if key not in programs:
+            programs[key] = (
+                _init_program(spec, seed, mesh),
+                _chunk_program(spec, t_end, pack, chunk_steps, mesh),
+                spec,
+            )
+        return programs[key][:2]
+
+    # pre-flight: trace summary_path over the first wave's ABSTRACT sims
+    # (eval_shape of init∘path — milliseconds, tracers not structs so
+    # compute-style paths work) so a path that doesn't exist on this
+    # model's Sim fails here with the knob named, not as an opaque
+    # KeyError from inside the fold after a full wave of compute.
+    # Cached so a warmed program_cache skips the re-trace inside
+    # bench's timed region (the entry pins spec, keeping its id valid)
+    pf_key = ("preflight", id(spec), summary_path, with_metrics)
+    if pf_key not in programs:
+        n_first = min(wave_size, R)
+        init_probe, _ = get_programs(spec)
+        try:
+            jax.eval_shape(
+                lambda r, p: summary_path(init_probe(r, p)),
+                jnp.arange(n_first), _slice_params(params, R, 0, n_first),
+            )
+        except Exception as e:
+            raise ValueError(
+                "run_experiment_stream: summary_path failed on this "
+                f"model's Sim structure ({e!r}) — pass summary_path= "
+                "pointing at a statistic this model records"
+            ) from e
+        programs[pf_key] = spec
+
+    grow_errs = (_cl.ERR_EVENT_OVERFLOW,)
+    n_waves = 0
+    n_regrows = 0
+    lo = 0
+    while lo < R:
+        n = min(wave_size, R - lo)
+        reps = jnp.arange(lo, lo + n)
+        pw = _slice_params(params, R, lo, n)
+        while True:
+            init_j, chunk_j = get_programs(spec)
+            sims = init_j(reps, pw)
+            sims = drive_chunks(
+                chunk_j, sims, poll_every=poll_every, on_chunk=on_chunk
+            )
+            if n_regrows >= max_regrows:
+                break
+            err = np.asarray(sims.err)
+            if not np.isin(err, grow_errs).any():
+                break
+            # wave-granular regrow: double the event cap and re-run THIS
+            # wave (healthy lanes reproduce bit-identically — streams are
+            # counter-derived); later waves keep the grown spec.  Drop the
+            # failed wave's sims before the re-init allocates — holding
+            # the name across init_j would peak at TWO waves of HBM
+            spec = dataclasses.replace(spec, event_cap=2 * spec.event_cap)
+            n_regrows += 1
+            sims = None
+        acc = fold_j(acc, sims)
+        # release the wave's batched sims before the next wave's init
+        # allocates: the one-wave peak-memory contract (fold_j has the
+        # buffers; the host must not keep a second live reference)
+        sims = None
+        n_waves += 1
+        lo += n
+        if on_wave is not None:
+            on_wave(n_waves, lo)
+
+    return StreamResult(
+        summary=acc[0],
+        n_failed=acc[1],
+        total_events=acc[2],
+        n_waves=n_waves,
+        n_regrows=n_regrows,
+        metrics=acc[3] if with_metrics else None,
     )
 
 

@@ -4,12 +4,14 @@ many-process scaling, physics-hook behavior."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cimba_tpu.core import loop as cl
 from cimba_tpu.models import awacs, jobshop
 from cimba_tpu.stats import summary as sm
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_jobshop_conserves_jobs_and_runs_maintenance():
     spec, refs = jobshop.build(backlog=4.0)
     run = cl.make_run(spec)
@@ -28,6 +30,7 @@ def test_jobshop_conserves_jobs_and_runs_maintenance():
     assert (np.asarray(sims.user["maintenance_runs"]) >= 1).all()
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_jobshop_sojourn_increases_with_load():
     spec, _ = jobshop.build()
     run = cl.make_run(spec)

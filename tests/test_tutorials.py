@@ -7,6 +7,8 @@ its own expected output; these tests just drive them.
 import pathlib
 import sys
 
+import pytest
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from examples import (  # noqa: E402
@@ -24,6 +26,7 @@ def test_tut_2_park_preemption_reconciles():
     assert muggings > 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_tut_3_balking_reneging_jockeying():
     visits, balked, reneged = tut_3_balking.main()
     assert visits > 0

@@ -104,6 +104,37 @@ np.testing.assert_array_equal(np.asarray(old.n_events), np.asarray(new.n_events)
 print("packed+hier trajectory smoke OK:", int(jnp.sum(old.n_events)), "events")
 EOF
 
+# stream smoke: the chunked, donated dispatch path and the wave-streamed
+# experiment must reproduce the monolithic run (docs/12_streaming.md) —
+# event counts bitwise, pooled summaries to merge-order rounding
+run_cell "stream smoke" python - <<'EOF'
+import jax, jax.numpy as jnp, numpy as np
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+R = 32
+res = ex.run_experiment(spec, mm1.params(60), R, seed=11)
+chunked = ex.run_experiment_chunked(
+    spec, mm1.params(60), R, seed=11, chunk_steps=37)
+np.testing.assert_array_equal(
+    np.asarray(res.sims.n_events), np.asarray(chunked.sims.n_events))
+np.testing.assert_array_equal(
+    np.asarray(res.sims.clock), np.asarray(chunked.sims.clock))
+assert int(chunked.n_failed) == 0
+st = ex.run_experiment_stream(
+    spec, mm1.params(60), R, wave_size=8, chunk_steps=37, seed=11)
+assert int(st.total_events) == int(res.total_events), (
+    int(st.total_events), int(res.total_events))
+mono = sm.merge_tree(res.sims.user["wait"])
+assert float(st.summary.n) == float(mono.n)
+assert abs(float(sm.mean(st.summary)) - float(sm.mean(mono))) <= 1e-9
+print("stream smoke OK:", int(st.total_events), "events,",
+      st.n_waves, "waves")
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
