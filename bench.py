@@ -6,7 +6,9 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mmc,mg1,jobshop,awacs}`` runs one named config;
+``--config {mm1,mm1_stream,mm1_single,serve,mmc,mg1,jobshop,awacs}``
+runs one named config (``serve`` is the open-loop serving-layer load,
+docs/13_serving.md);
 ``--config all`` runs the whole battery, one JSON line each (BASELINE.json
 configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
 reports a non-null vs_baseline; the others carry the published reference
@@ -911,6 +913,130 @@ def bench_mm1_stream():
     )
 
 
+def bench_serve():
+    """The serving layer under synthetic open-loop load at the same
+    R x N as ``mm1_stream`` (docs/13_serving.md): the total lane count
+    is split into requests of ``CIMBA_BENCH_SERVE_REQ_R`` replications
+    submitted by ``CIMBA_BENCH_SERVE_CLIENTS`` client threads on a
+    fixed arrival schedule (``CIMBA_BENCH_SERVE_IAT`` seconds apart;
+    0 = burst), all compatible, so the dispatcher packs them into
+    shared waves.  Reports throughput (replications/s and events/s),
+    p50/p95/p99 request latency, the batch-occupancy histogram, and
+    the program-cache counters; every request's result is checked
+    against one direct single-caller run (identical events and pooled
+    mean — the serve correctness anchor inside the bench).  The
+    watchdog heartbeat refreshes per chunk of every dispatched wave."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    accel = _accel()
+    R = int(
+        os.environ.get(
+            "CIMBA_BENCH_STREAM_R", str(2**20 if accel else 8192)
+        )
+    )
+    wave = min(
+        int(
+            os.environ.get(
+                "CIMBA_BENCH_STREAM_WAVE", str(65536 if accel else 1024)
+            )
+        ),
+        R,
+    )
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = _stream_chunk_default()
+    req_r = min(
+        int(os.environ.get("CIMBA_BENCH_SERVE_REQ_R", max(wave // 4, 1))),
+        wave,
+    )
+    n_requests = max(R // req_r, 1)
+    clients = int(os.environ.get("CIMBA_BENCH_SERVE_CLIENTS", "4"))
+    iat = float(os.environ.get("CIMBA_BENCH_SERVE_IAT", "0"))
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = serve.ProgramCache()
+
+        def make_reqs(n_objects, count, tag):
+            return [
+                serve.Request(
+                    spec, mm1.params(n_objects), req_r, seed=2026,
+                    wave_size=req_r, chunk_steps=chunk,
+                    label=f"{tag}{i}",
+                )
+                for i in range(count)
+            ]
+
+        # warm OUTSIDE the timed service: slot shape + a small packed
+        # burst so the common concat shapes are compiled, then a fresh
+        # service over the same cache starts with clean stats
+        serve.warm(
+            cache, spec, mm1.params(1), req_r, chunk_steps=chunk,
+            seed=2026, on_wave=_heartbeat, on_chunk=_heartbeat,
+        )
+        with serve.Service(
+            max_wave=wave, cache=cache, on_chunk=_heartbeat,
+        ) as warm_svc:
+            serve.run_load(
+                warm_svc, make_reqs(1, min(4, n_requests), "warm"),
+                n_clients=clients,
+            )
+        _heartbeat()
+        svc = serve.Service(
+            max_wave=wave, cache=cache, on_chunk=_heartbeat,
+        )
+        report = serve.run_load(
+            svc, make_reqs(N, n_requests, "req"), n_clients=clients,
+            inter_arrival_s=iat,
+        )
+        stats = svc.stats()
+        svc.shutdown()
+        direct = ex.run_experiment_stream(
+            spec, mm1.params(N), req_r, wave_size=req_r,
+            chunk_steps=chunk, seed=2026, program_cache=cache,
+            on_wave=_heartbeat, on_chunk=_heartbeat,
+        )
+    assert report.n_completed == n_requests, report.errors
+    total_ev = 0
+    for _, res in report.results:
+        assert int(res.total_events) == int(direct.total_events)
+        assert float(sm.mean(res.summary)) == float(
+            sm.mean(direct.summary)
+        )
+        total_ev += int(res.total_events)
+    rate = total_ev / report.wall_s
+    _line(
+        "serve_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "path": "serve_packed_waves",
+            "profile": prof,
+            "replications_total": n_requests * req_r,
+            "replications_per_request": req_r,
+            "requests": n_requests,
+            "clients": clients,
+            "inter_arrival_s": iat,
+            "objects_per_replication": N,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "wall_s": report.wall_s,
+            "replications_per_sec": report.replications_per_sec,
+            "total_events": total_ev,
+            "latency": report.latency_percentiles(),
+            "batch_occupancy": stats["batch_occupancy"],
+            "batches": stats["batches"],
+            "queue_depth_hwm": stats["queue_depth_hwm"],
+            "time_to_first_wave": stats["time_to_first_wave"],
+            "program_cache": stats.get("program_cache"),
+            "pooled_mean_sojourn": float(sm.mean(direct.summary)),
+        },
+    )
+
+
 def bench_mm1_single():
     """BASELINE configs[0] twin: ``benchmark/MM1_single.c`` — ONE
     replication, the single-stream latency number (reference: ~32M
@@ -1252,6 +1378,7 @@ CONFIGS = {
     "mm1": bench_mm1,
     "mm1_stream": bench_mm1_stream,
     "mm1_single": bench_mm1_single,
+    "serve": bench_serve,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
     "jobshop": bench_jobshop,

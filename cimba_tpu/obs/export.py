@@ -131,6 +131,19 @@ def dump_chrome_trace(path: str, sims, spec=None) -> dict:
     return doc
 
 
+def dump_service_trace(path: str, service) -> dict:
+    """Export a :class:`cimba_tpu.serve.Service`'s request-lifecycle
+    trace (one complete span per request + the queue-depth counter
+    track — the same Trace Event Format schema as
+    :func:`chrome_trace`, service stats in ``otherData.service``) to
+    ``path`` after validation; returns the dict that was written."""
+    doc = service.chrome_trace()
+    validate_chrome_trace(doc)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
 def validate_chrome_trace(doc: dict) -> None:
     """Structural check used by the CI smoke: required top-level keys,
     non-empty events, per-event required fields, and per-replication

@@ -54,16 +54,42 @@ def save(path: str, sims: Any, *, tag: Optional[str] = None) -> None:
     ``tag`` is an opaque caller string stored in the fingerprint and
     checked verbatim at restore — the runner passes the spec's identity
     (name, capacities, dtype profile) so a same-shape-different-model
-    restore still fails loudly."""
+    restore still fails loudly.
+
+    Atomicity: the bytes land in a UNIQUELY-named temp file in the same
+    directory (``mkstemp`` — two concurrent savers, e.g. a service
+    checkpointing two runs to siblings of one dir, cannot clobber each
+    other's half-written temp), are fsync'd to disk, and only then
+    ``os.replace``d over ``path`` — a preemption or crash at ANY point
+    leaves either the previous complete checkpoint or none, never a
+    torn file, and ``restore`` only ever reads ``path``, so leftover
+    ``*.tmp`` orphans from a killed process are ignored (tested in
+    tests/test_checkpoint_atomic.py)."""
+    import tempfile
+
     leaves, _ = _flatten(sims)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     arrays["__spec__"] = np.frombuffer(
         _fingerprint(leaves, tag).encode(), dtype=np.uint8
     )
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        np.savez(fh, **arrays)
-    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+    path = os.path.abspath(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path),
+        prefix=os.path.basename(path) + ".",
+        suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())  # durable BEFORE the rename publishes it
+        os.replace(tmp, path)  # atomic: never a torn checkpoint at `path`
+    except BaseException:
+        try:  # a failed save must not litter (or leave a decoy temp)
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def spec_tag(spec: Any) -> str:

@@ -55,6 +55,10 @@ def run_dryrun(n_devices: int) -> None:
     stream_events = _dryrun_stream_mesh(
         mesh, n_devices, spec, reps, int(events), pooled
     )
+    # the serving layer over the same mesh: concurrent requests packed
+    # into shared sharded waves must return per-request results
+    # IDENTICAL to direct single-caller streamed runs
+    serve_events = _dryrun_serve_mesh(mesh, n_devices, spec)
     # the Pallas kernel path over the same mesh (interpret mode on the
     # virtual devices; Mosaic-compiled on real chips): per-device chunk
     # kernels under shard_map must agree with the XLA path's event counts
@@ -67,6 +71,7 @@ def run_dryrun(n_devices: int) -> None:
         f"dryrun_multichip OK: {n_devices} devices, "
         f"{int(events)} events, mean wait {float(sm.mean(pooled)):.3f}, "
         f"stream-mesh events {stream_events}, "
+        f"serve-mesh events {serve_events}, "
         f"kernel-mesh events {kernel_events}, "
         f"awacs-boundary-mesh events {awacs_events}",
         flush=True,
@@ -99,6 +104,58 @@ def _dryrun_stream_mesh(mesh, n_devices, spec, n_reps, mono_events,
     assert abs(m_st - m_mono) <= 1e-9 * abs(m_mono), (m_st, m_mono)
     assert st.n_waves == n_reps // (8 * n_devices), st.n_waves
     return int(st.total_events)
+
+
+def _dryrun_serve_mesh(mesh, n_devices, spec) -> int:
+    """The serving layer on the virtual mesh (docs/13_serving.md):
+    three threaded clients — two compatible (packed into one sharded
+    wave), one a stranger (different seed) — each bitwise-identical to
+    the direct mesh-sharded run_experiment_stream call through the
+    same shared program cache."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+
+    cache = serve.ProgramCache()
+    per_req = 8 * n_devices
+    cases = [("a", 40, 1), ("b", 60, 1), ("c", 40, 4)]
+    out = {}
+    with serve.Service(
+        max_wave=4 * per_req, mesh=mesh, cache=cache
+    ) as svc:
+        def client(label, n, seed):
+            out[label] = svc.submit(serve.Request(
+                spec, mm1.params(n), per_req, seed=seed,
+                wave_size=per_req, chunk_steps=32, label=label,
+            )).result(600)
+
+        ts = [threading.Thread(target=client, args=c) for c in cases]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    total = 0
+    for label, n, seed in cases:
+        direct = ex.run_experiment_stream(
+            spec, mm1.params(n), per_req, wave_size=per_req,
+            chunk_steps=32, seed=seed, mesh=mesh, program_cache=cache,
+        )
+        res = out[label]
+        assert int(res.n_failed) == 0, f"serve-mesh {label} failures"
+        for x, y in zip(
+            jax.tree.leaves((res.summary, res.total_events)),
+            jax.tree.leaves((direct.summary, direct.total_events)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=f"serve-mesh {label}"
+            )
+        total += int(res.total_events)
+    return total
 
 
 def _dryrun_model_mesh(mesh, n_devices: int, build, params, label) -> int:

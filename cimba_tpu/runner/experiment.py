@@ -61,6 +61,15 @@ from cimba_tpu.stats import summary as sm
 REP_AXIS = "rep"
 
 
+def default_summary_path(sims):
+    """The default pooled statistic: the per-replication ``wait``
+    summary every shipped queueing model records.  A NAMED module-level
+    function (not a fresh lambda) so every caller that leaves
+    ``summary_path`` unset shares one identity — the fold-program cache
+    and the serving layer's request-compatibility key both key on it."""
+    return sims.user["wait"]
+
+
 class ExperimentResult(NamedTuple):
     sims: Sim                 # batched: every leaf has leading axis [R]
     n_failed: jnp.ndarray     # replications with err != 0
@@ -432,7 +441,7 @@ def run_experiment_stream(
     pack: Optional[bool] = None,
     chunk_steps: int = 1024,
     poll_every: int = 4,
-    summary_path=lambda sims: sims.user["wait"],
+    summary_path=default_summary_path,
     max_regrows: int = 0,
     on_wave=None,
     on_chunk=None,
@@ -467,27 +476,28 @@ def run_experiment_stream(
     ``on_wave(n_waves, lanes_done)`` and ``on_chunk(n)`` are progress
     hooks (bench.py refreshes its watchdog heartbeat there).
 
-    ``program_cache``: pass the SAME dict to repeated calls to reuse
+    ``program_cache``: pass the SAME mapping to repeated calls to reuse
     the compiled init/chunk/fold programs across calls (bench.py's
-    warm-then-time protocol).  Every setting a program bakes in —
-    ``spec`` identity, ``seed``, the dtype profile, the ``obs.metrics``
-    and ``obs.trace`` states, the event-set layout flags, the resolved
-    ``pack`` arm, ``t_end``, ``chunk_steps``, ``mesh``, and
-    ``summary_path`` identity — is part of the cache key, so a
-    mismatched call recompiles rather than replaying stale programs
-    (reuse requires passing the SAME spec object); jitted programs
-    additionally
-    re-specialize per wave shape internally, so full waves always share
-    one compile.
+    warm-then-time protocol; a service shares one cache across every
+    request).  Every setting a program bakes in — ``spec`` identity,
+    ``seed``, the dtype profile, the ``obs.metrics`` and ``obs.trace``
+    states, the event-set layout flags, the resolved ``pack`` arm,
+    ``t_end``, ``chunk_steps``, ``mesh``, and ``summary_path`` identity
+    — is part of the cache key, so a mismatched call recompiles rather
+    than replaying stale programs (reuse requires passing the SAME spec
+    object); jitted programs additionally re-specialize per wave shape
+    internally, so full waves always share one compile.  The default is
+    a fresh :class:`cimba_tpu.serve.cache.ProgramCache` — a bounded LRU
+    with hit/miss/eviction counters (``CIMBA_PROGRAM_CACHE_CAP``);
+    plain dicts keep working for legacy callers but never evict.
     """
     import dataclasses
 
     import numpy as np
 
-    from cimba_tpu import config as _config
     from cimba_tpu.core import loop as _cl
     from cimba_tpu.obs import metrics as _metrics
-    from cimba_tpu.obs import trace as _trace
+    from cimba_tpu.serve import cache as _pcache
 
     R = int(n_replications)
     if R <= 0:
@@ -505,111 +515,32 @@ def run_experiment_stream(
             )
 
     with_metrics = _metrics.enabled()
-    acc = (
-        sm.empty(),
-        jnp.zeros((), jnp.int64),
-        jnp.zeros((), jnp.int64),
+    acc = _pcache.stream_acc(spec, with_metrics)
+
+    # the program cache, its keys, the fold program, and the preflight
+    # all live in serve/cache.py now — the serving layer's request
+    # compatibility key IS the program key, so both stay one definition
+    programs = (
+        program_cache if program_cache is not None else _pcache.ProgramCache()
     )
-    if with_metrics:
-        acc = acc + (
-            _metrics.create(
-                _cl.N_KINDS + len(spec.user_handlers), len(spec.queues)
-            ),
-        )
-
-    def fold(acc, sims):
-        if (sims.metrics is None) == with_metrics:
-            raise RuntimeError(
-                "run_experiment_stream: obs.metrics was "
-                f"{'enabled' if with_metrics else 'disabled'} when the "
-                "stream started but flipped mid-stream — the flag binds "
-                "for the whole stream"
-            )
-        pooled = sm.merge_tree(summary_path(sims))
-        out = (
-            sm.merge(acc[0], pooled),
-            acc[1] + jnp.sum((sims.err != 0).astype(jnp.int64)),
-            acc[2] + jnp.sum(sims.n_events.astype(jnp.int64)),
-        )
-        if with_metrics:
-            out = out + (
-                _metrics.merge(acc[3], _metrics.pool(sims.metrics)),
-            )
-        return out
-
-    # no donation on the accumulator: its leaves are scalars (aliasing
-    # buys nothing) and sm.empty() aliases one zero buffer across
-    # moments, which XLA's donation path rejects as a double-donate
-    programs = program_cache if program_cache is not None else {}
-    # every setting a compiled program bakes in is part of its key, so a
-    # cache reused across mismatched calls recompiles instead of
-    # silently replaying the first call's horizon/arm/statistic.  The
-    # trace-time globals (dtype profile below, flight-recorder flag,
-    # eventset hierarchy, and pack=None's backend/env resolution) are
-    # resolved NOW so a flag flip between calls misses the cache rather
-    # than replaying the stale arm
-    run_key = (
-        t_end,
-        pack if pack is not None else _config.xla_pack_enabled(),
-        chunk_steps,
-        mesh,
-        _trace.enabled(),
-        _config.eventset_hier_enabled(),
-        _config.eventset_block(),
-    )
-    fold_key = ("fold", with_metrics, summary_path)
-    if fold_key not in programs:
-        programs[fold_key] = jax.jit(fold)
-    fold_j = programs[fold_key]
-
-    # one (init, chunk) program pair per spec object; jit re-specializes
-    # per wave shape internally (full waves share one compile)
+    fold_j = _pcache.get_fold(programs, with_metrics, summary_path)
 
     def get_programs(spec):
-        # the spec's blocks/handlers/caps, the seed (init_sim closes
-        # over it), the dtype profile (trace-time global), and the
-        # obs.metrics flag are all baked into the traced programs, so
-        # all join run_key — any one of them silently replaying stale
-        # would return a DIFFERENT model's trajectories with no error.
-        # Spec identity is by object (id stays valid: the cache entry
-        # holds the spec, so the id cannot be recycled while cached);
-        # a semantically-equal rebuilt spec merely recompiles, which is
-        # safe.  Regrow's dataclasses.replace yields a new object, so
-        # grown capacities get their own programs as before.
-        key = (
-            id(spec), seed, _config.active_profile(), with_metrics,
-        ) + run_key
-        if key not in programs:
-            programs[key] = (
-                _init_program(spec, seed, mesh),
-                _chunk_program(spec, t_end, pack, chunk_steps, mesh),
-                spec,
-            )
-        return programs[key][:2]
+        # one (init, chunk) program pair per (spec object, settings)
+        # point; jit re-specializes per wave shape internally (full
+        # waves share one compile).  Regrow's dataclasses.replace
+        # yields a new object, so grown capacities get their own
+        # programs as before.
+        return _pcache.get_programs(
+            programs, spec, seed=seed, mesh=mesh, t_end=t_end,
+            pack=pack, chunk_steps=chunk_steps, with_metrics=with_metrics,
+        )
 
-    # pre-flight: trace summary_path over the first wave's ABSTRACT sims
-    # (eval_shape of init∘path — milliseconds, tracers not structs so
-    # compute-style paths work) so a path that doesn't exist on this
-    # model's Sim fails here with the knob named, not as an opaque
-    # KeyError from inside the fold after a full wave of compute.
-    # Cached so a warmed program_cache skips the re-trace inside
-    # bench's timed region (the entry pins spec, keeping its id valid)
-    pf_key = ("preflight", id(spec), summary_path, with_metrics)
-    if pf_key not in programs:
-        n_first = min(wave_size, R)
-        init_probe, _ = get_programs(spec)
-        try:
-            jax.eval_shape(
-                lambda r, p: summary_path(init_probe(r, p)),
-                jnp.arange(n_first), _slice_params(params, R, 0, n_first),
-            )
-        except Exception as e:
-            raise ValueError(
-                "run_experiment_stream: summary_path failed on this "
-                f"model's Sim structure ({e!r}) — pass summary_path= "
-                "pointing at a statistic this model records"
-            ) from e
-        programs[pf_key] = spec
+    init_probe, _ = get_programs(spec)
+    _pcache.preflight_summary_path(
+        programs, spec, init_probe, summary_path, params,
+        R, min(wave_size, R), with_metrics,
+    )
 
     grow_errs = (_cl.ERR_EVENT_OVERFLOW,)
     n_waves = 0
@@ -665,7 +596,7 @@ def pooled_summary(batched: sm.Summary) -> sm.Summary:
 
 def make_sharded_experiment(
     spec: ModelSpec, n_replications: int, mesh: Mesh, *,
-    summary_path=lambda sims: sims.user["wait"],
+    summary_path=default_summary_path,
     t_end: Optional[float] = None,
     pack: Optional[bool] = None,
 ):

@@ -1,0 +1,41 @@
+"""cimba_tpu.serve — the experiment-serving layer (docs/13_serving.md).
+
+Many concurrent experiment requests multiplexed onto the wave-streamed
+runner's already-warm compiled programs: a single device-owner
+dispatcher thread packs *compatible* requests (same program-cache key)
+into shared waves and slices pooled results back per request, behind
+admission control, deadlines, cancellation, and retry-with-backoff.
+
+    from cimba_tpu import serve
+    with serve.Service(max_wave=1024) as svc:
+        h = svc.submit(serve.Request(spec, params, 64, seed=1))
+        result = h.result()          # a runner.experiment.StreamResult
+
+Submodules: :mod:`~cimba_tpu.serve.cache` (the bounded shared program
+cache), :mod:`~cimba_tpu.serve.sched` (queue/deadline/retry policy),
+:mod:`~cimba_tpu.serve.service` (the dispatcher),
+:mod:`~cimba_tpu.serve.client` (synthetic load drivers).
+"""
+
+from cimba_tpu.serve.cache import ProgramCache, warm
+from cimba_tpu.serve.client import LoadReport, percentile, run_load
+from cimba_tpu.serve.sched import (
+    AdmissionQueue,
+    Backoff,
+    Cancelled,
+    DeadlineExceeded,
+    QueueFull,
+    RetriesExhausted,
+    ServeError,
+    ServiceClosed,
+)
+from cimba_tpu.serve.service import Request, ResultHandle, Service
+
+__all__ = [
+    "ProgramCache", "warm",
+    "LoadReport", "percentile", "run_load",
+    "AdmissionQueue", "Backoff",
+    "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
+    "DeadlineExceeded", "RetriesExhausted",
+    "Request", "ResultHandle", "Service",
+]

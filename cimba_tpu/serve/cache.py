@@ -1,0 +1,364 @@
+"""The shared compiled-program cache behind streaming and serving.
+
+``run_experiment_stream`` historically built its program table as an
+unbounded per-call dict (the nested ``get_programs``): correct, but a
+long-lived process sweeping many specs — exactly the serving shape —
+accumulates one ``(init, chunk)`` program pair per (spec, settings)
+point forever.  This module factors that table out into a **bounded,
+thread-safe LRU** (:class:`ProgramCache`) plus the key builders both
+:func:`cimba_tpu.runner.experiment.run_experiment_stream` and
+:mod:`cimba_tpu.serve.service` share, so
+
+* the stream runner's default cache is bounded (env
+  ``CIMBA_PROGRAM_CACHE_CAP``, default 64 entries — generous: one entry
+  per distinct (spec, seed, profile, horizon, arm, mesh) point, not per
+  wave shape; jit re-specializes per shape internally);
+* the serving layer's *compatibility key* — which requests may share a
+  wave — is definitionally the same key that selects a compiled
+  program, so "compatible" can never drift from "same program";
+* hit/miss/eviction counters make cache health observable
+  (:meth:`ProgramCache.stats`, surfaced by ``Service.stats()`` and the
+  bench serve arm).
+
+Entry-pinning invariant: every key that embeds ``id(spec)`` stores the
+spec object (or a tuple containing it) as part of its value, so a
+cached id can never be recycled by the allocator while its entry lives.
+Eviction drops the entry *and* its pin together — a later call with a
+recycled id cannot hit a stale entry, because the stale entry is gone.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, MutableMapping, Optional
+
+#: environment knob for the default capacity
+CAP_ENV = "CIMBA_PROGRAM_CACHE_CAP"
+
+#: default capacity when the env var is unset — generous (entries are
+#: per (spec, settings) point, not per wave shape)
+DEFAULT_CAP = 64
+
+
+def default_capacity() -> int:
+    cap = int(os.environ.get(CAP_ENV, DEFAULT_CAP))
+    if cap <= 0:
+        raise ValueError(
+            f"{CAP_ENV}={cap}: the program cache capacity must be "
+            "positive (compiled programs are required to run at all)"
+        )
+    return cap
+
+
+class ProgramCache(MutableMapping):
+    """A bounded, thread-safe LRU mapping for compiled programs.
+
+    Drop-in for the plain dict ``run_experiment_stream(program_cache=)``
+    historically took (same mapping protocol), plus:
+
+    * **bounded**: inserting past ``capacity`` evicts the
+      least-recently-used entry (compiled programs are pure caches —
+      an evicted point merely recompiles on next use);
+    * **thread-safe**: every operation holds an internal lock, so a
+      service dispatcher and direct-calling client threads can share
+      one cache (the recommended deployment — shared warm programs);
+    * **observable**: ``hits``/``misses``/``evictions`` counters and
+      :meth:`stats` (misses are counted in :meth:`get_or_create`, the
+      accessor the runner and service use).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._cap = default_capacity() if capacity is None else int(capacity)
+        if self._cap <= 0:
+            raise ValueError(f"capacity must be positive, got {self._cap}")
+        self._od: OrderedDict = OrderedDict()
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- mapping protocol ---------------------------------------------------
+
+    def __getitem__(self, key):
+        with self._lock:
+            val = self._od[key]
+            self._od.move_to_end(key)
+            return val
+
+    def __setitem__(self, key, val):
+        with self._lock:
+            self._od[key] = val
+            self._od.move_to_end(key)
+            while len(self._od) > self._cap:
+                self._od.popitem(last=False)
+                self.evictions += 1
+
+    def __delitem__(self, key):
+        with self._lock:
+            del self._od[key]
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._od
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._od))
+
+    def __len__(self):
+        with self._lock:
+            return len(self._od)
+
+    # -- the accessor the runner/service use --------------------------------
+
+    def get_or_create(self, key, factory: Callable[[], Any]):
+        """Return the cached value for ``key``, building it with
+        ``factory()`` on a miss.  The factory runs OUTSIDE the lock (it
+        may trace/compile for seconds — other threads must not block on
+        it); if another thread won the race, its value wins and the
+        duplicate build is discarded (benign: compiled programs are
+        pure)."""
+        with self._lock:
+            if key in self._od:
+                self.hits += 1
+                self._od.move_to_end(key)
+                return self._od[key]
+        val = factory()
+        with self._lock:
+            if key in self._od:  # lost a benign build race
+                self.hits += 1
+                self._od.move_to_end(key)
+                return self._od[key]
+            self.misses += 1
+            self[key] = val
+            return val
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._cap,
+                "size": len(self._od),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+def _get_or_create(programs: MutableMapping, key, factory):
+    """``get_or_create`` against either a :class:`ProgramCache` or the
+    plain dict legacy callers still pass."""
+    if isinstance(programs, ProgramCache):
+        return programs.get_or_create(key, factory)
+    if key not in programs:
+        programs[key] = factory()
+    return programs[key]
+
+
+# -- key builders (the stream runner's cache contract, factored out) --------
+
+
+def run_settings_key(t_end, pack, chunk_steps, mesh) -> tuple:
+    """Every run-level setting a compiled chunk program bakes in beyond
+    spec identity, with the trace-time globals (pack auto-resolution,
+    flight-recorder flag, eventset hierarchy/layout) resolved NOW so a
+    flag flip between calls misses the cache rather than replaying the
+    stale arm."""
+    from cimba_tpu import config as _config
+    from cimba_tpu.obs import trace as _trace
+
+    return (
+        t_end,
+        pack if pack is not None else _config.xla_pack_enabled(),
+        chunk_steps,
+        mesh,
+        _trace.enabled(),
+        _config.eventset_hier_enabled(),
+        _config.eventset_block(),
+    )
+
+
+def program_key(spec, seed, with_metrics: bool, settings: tuple) -> tuple:
+    """The full key of one compiled ``(init, chunk)`` program pair: the
+    spec's blocks/handlers/caps, the seed (``init_sim`` closes over it),
+    the dtype profile (trace-time global), and the ``obs.metrics`` flag
+    are all baked into the traced programs, so all join the run
+    settings — any one of them silently replaying stale would return a
+    DIFFERENT model's trajectories with no error.  Spec identity is by
+    object (the cache entry pins the spec, so the id cannot be recycled
+    while cached); a semantically-equal rebuilt spec merely recompiles,
+    which is safe."""
+    from cimba_tpu import config as _config
+
+    return (
+        id(spec), seed, _config.active_profile(), with_metrics,
+    ) + settings
+
+
+def get_programs(
+    programs: MutableMapping,
+    spec,
+    *,
+    seed: int,
+    mesh,
+    t_end,
+    pack,
+    chunk_steps: int,
+    with_metrics: bool,
+):
+    """The stream runner's ``get_programs``, shared with the service:
+    one compiled ``(init, chunk)`` pair per :func:`program_key` point
+    (jit re-specializes per wave shape internally, so full waves share
+    one compile).  Returns ``(init_j, chunk_j)``."""
+    key = program_key(
+        spec, seed, with_metrics,
+        run_settings_key(t_end, pack, chunk_steps, mesh),
+    )
+
+    def build():
+        from cimba_tpu.runner import experiment as ex
+
+        return (
+            ex._init_program(spec, seed, mesh),
+            ex._chunk_program(spec, t_end, pack, chunk_steps, mesh),
+            spec,  # pins id(spec) for the entry's lifetime
+        )
+
+    return _get_or_create(programs, key, build)[:2]
+
+
+def get_fold(programs: MutableMapping, with_metrics: bool, summary_path):
+    """The jitted wave-fold program shared by the stream runner and the
+    service's per-request accumulators: merge the wave's pooled Pébay
+    summary, failure count, event total, and (when enabled) pooled
+    metrics registry into the accumulator tuple.  Keyed by the metrics
+    flag and ``summary_path`` identity — a different statistic is a
+    different program."""
+    key = ("fold", with_metrics, summary_path)
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.obs import metrics as _metrics
+        from cimba_tpu.stats import summary as sm
+
+        def fold(acc, sims):
+            if (sims.metrics is None) == with_metrics:
+                raise RuntimeError(
+                    "run_experiment_stream: obs.metrics was "
+                    f"{'enabled' if with_metrics else 'disabled'} when "
+                    "the stream started but flipped mid-stream — the "
+                    "flag binds for the whole stream"
+                )
+            pooled = sm.merge_tree(summary_path(sims))
+            out = (
+                sm.merge(acc[0], pooled),
+                acc[1] + jnp.sum((sims.err != 0).astype(jnp.int64)),
+                acc[2] + jnp.sum(sims.n_events.astype(jnp.int64)),
+            )
+            if with_metrics:
+                out = out + (
+                    _metrics.merge(acc[3], _metrics.pool(sims.metrics)),
+                )
+            return out
+
+        # no donation on the accumulator: its leaves are scalars
+        # (aliasing buys nothing) and sm.empty() aliases one zero buffer
+        # across moments, which XLA's donation path rejects as a
+        # double-donate
+        return jax.jit(fold)
+
+    return _get_or_create(programs, key, build)
+
+
+def stream_acc(spec, with_metrics: bool):
+    """A zeroed accumulator tuple for :func:`get_fold`'s program:
+    ``(Summary, n_failed i64, total_events i64[, Metrics])``."""
+    import jax.numpy as jnp
+
+    from cimba_tpu.core import loop as _cl
+    from cimba_tpu.obs import metrics as _metrics
+    from cimba_tpu.stats import summary as sm
+
+    acc = (
+        sm.empty(),
+        jnp.zeros((), jnp.int64),
+        jnp.zeros((), jnp.int64),
+    )
+    if with_metrics:
+        acc = acc + (
+            _metrics.create(
+                _cl.N_KINDS + len(spec.user_handlers), len(spec.queues)
+            ),
+        )
+    return acc
+
+
+def preflight_summary_path(
+    programs: MutableMapping,
+    spec,
+    init_j,
+    summary_path,
+    params,
+    n_total: int,
+    n_first: int,
+    with_metrics: bool,
+) -> None:
+    """Trace ``summary_path`` over the first wave's ABSTRACT sims
+    (``eval_shape`` of init∘path — milliseconds, tracers not structs so
+    compute-style paths work) so a path that doesn't exist on this
+    model's Sim fails here with the knob named, not as an opaque
+    KeyError from inside the fold after a full wave of compute.  Cached
+    so a warmed cache skips the re-trace inside bench's timed region
+    (the entry pins spec, keeping its id valid)."""
+    key = ("preflight", id(spec), summary_path, with_metrics)
+    if key in programs:
+        return
+
+    def check():
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.runner import experiment as ex
+
+        try:
+            jax.eval_shape(
+                lambda r, p: summary_path(init_j(r, p)),
+                jnp.arange(n_first),
+                ex._slice_params(params, n_total, 0, n_first),
+            )
+        except Exception as e:
+            raise ValueError(
+                "run_experiment_stream: summary_path failed on this "
+                f"model's Sim structure ({e!r}) — pass summary_path= "
+                "pointing at a statistic this model records"
+            ) from e
+        return spec  # pins id(spec) for the entry's lifetime
+
+    _get_or_create(programs, key, check)
+
+
+def warm(
+    cache: MutableMapping,
+    spec,
+    params,
+    wave_size: int,
+    **stream_kwargs,
+):
+    """Optional warm-up precompile: run ONE full wave through the
+    stream runner against ``cache``, so a service built over the same
+    cache (and the same spec object / settings) serves its first real
+    request from already-compiled programs.  Returns the warm-up wave's
+    ``StreamResult`` (callers usually discard it)."""
+    from cimba_tpu.runner import experiment as ex
+
+    return ex.run_experiment_stream(
+        spec, params, wave_size, wave_size=wave_size,
+        program_cache=cache, **stream_kwargs,
+    )

@@ -1,0 +1,160 @@
+"""Synthetic load drivers for the experiment service.
+
+The serving claim worth measuring is not one request's latency but the
+distribution under concurrent load: N client threads submitting
+requests against the bounded queue, open-loop (arrivals on a fixed
+schedule, independent of completions — the shape that exposes queueing
+collapse) or as a burst.  This module is the shared driver behind
+``examples/serve_mm1.py``, the bench serve arm, and the many-client
+soak test — host-side threading only, no jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+
+def percentile(xs: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) — dependency-free and
+    exact on the small sample counts a load run produces."""
+    if not xs:
+        return float("nan")
+    s = sorted(xs)
+    k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+@dataclass
+class LoadReport:
+    """What a load run measured.  ``latencies_s`` is submit→result wall
+    time per COMPLETED request; structured failures are counted by
+    class, never silently dropped."""
+
+    n_requests: int
+    n_completed: int
+    wall_s: float
+    total_replications: int
+    latencies_s: List[float] = field(default_factory=list)
+    errors: dict = field(default_factory=dict)
+    results: list = field(default_factory=list)
+
+    @property
+    def replications_per_sec(self) -> float:
+        return self.total_replications / self.wall_s if self.wall_s else 0.0
+
+    def latency_percentiles(self) -> dict:
+        return {
+            "p50_s": percentile(self.latencies_s, 50),
+            "p95_s": percentile(self.latencies_s, 95),
+            "p99_s": percentile(self.latencies_s, 99),
+            "max_s": max(self.latencies_s) if self.latencies_s else
+            float("nan"),
+        }
+
+    def summary(self) -> dict:
+        out = {
+            "requests": self.n_requests,
+            "completed": self.n_completed,
+            "wall_s": self.wall_s,
+            "replications_per_sec": self.replications_per_sec,
+            "errors": dict(self.errors),
+        }
+        out.update(self.latency_percentiles())
+        return out
+
+
+def run_load(
+    service,
+    requests: Sequence[Any],
+    *,
+    n_clients: int = 1,
+    inter_arrival_s: float = 0.0,
+    submit_block: bool = True,
+    submit_timeout: Optional[float] = None,
+    result_timeout: Optional[float] = None,
+    on_result: Optional[Callable] = None,
+) -> LoadReport:
+    """Drive ``service`` with ``requests`` from ``n_clients`` threads.
+
+    Open-loop: request i's arrival time is ``t0 + i * inter_arrival_s``
+    regardless of completions (``inter_arrival_s=0`` is a burst).
+    Clients pull the next scheduled arrival off a shared cursor, sleep
+    until its time, submit, and immediately move on — a second pass
+    collects every future, so slow results never throttle arrivals.
+    Admission rejects (``QueueFull``) and structured failures are
+    counted per error class in the report.  ``results`` keeps completed
+    ``(index, StreamResult)`` pairs in arrival order for correctness
+    checks (``on_result(i, res)`` streams them instead when holding all
+    results would be too much)."""
+    t0 = time.perf_counter()
+    cursor = [0]
+    lock = threading.Lock()
+    handles: List[Optional[tuple]] = [None] * len(requests)
+    errors: dict = {}
+
+    def client():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(requests):
+                    return
+                cursor[0] += 1
+            due = t0 + i * inter_arrival_s
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            sub_t = time.perf_counter()
+            try:
+                h = service.submit(
+                    requests[i], block=submit_block,
+                    timeout=submit_timeout,
+                )
+            except Exception as e:
+                with lock:
+                    errors[type(e).__name__] = (
+                        errors.get(type(e).__name__, 0) + 1
+                    )
+                continue
+            handles[i] = (sub_t, h)
+
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(max(1, n_clients))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    latencies: List[float] = []
+    results: list = []
+    n_completed = 0
+    total_reps = 0
+    for i, rec in enumerate(handles):
+        if rec is None:
+            continue
+        sub_t, h = rec
+        try:
+            res = h.result(timeout=result_timeout)
+        except Exception as e:
+            errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
+            continue
+        latencies.append(time.perf_counter() - sub_t)
+        n_completed += 1
+        total_reps += int(requests[i].n_replications)
+        if on_result is not None:
+            on_result(i, res)
+        else:
+            results.append((i, res))
+    return LoadReport(
+        n_requests=len(requests),
+        n_completed=n_completed,
+        wall_s=time.perf_counter() - t0,
+        total_replications=total_reps,
+        latencies_s=latencies,
+        errors=errors,
+        results=results,
+    )

@@ -1,0 +1,794 @@
+"""The experiment service: many clients multiplexed onto shared waves.
+
+PR 3 built the primitive a service needs — one compiled chunk program
+that waves of lanes stream through (`runner.run_experiment_stream`) —
+but every entry point was a blocking, single-caller function.  This
+module multiplexes many concurrent experiment requests onto those
+already-warm programs:
+
+* **One device-owner dispatcher thread** (cimba's one-event-loop-per-
+  worker discipline, transposed: the DEVICE is the scarce resource, so
+  exactly one thread builds batches and dispatches programs; client
+  threads only enqueue and wait on futures).
+* **Compatibility packing**: queued requests whose program-cache key
+  matches — spec identity, seed, dtype profile, metrics/trace/eventset
+  flags, resolved pack arm, horizon, chunk size, mesh, `summary_path`
+  identity, and the params tree signature — are packed into ONE wave
+  of the shared compiled chunk program, and the pooled results are
+  sliced back per request.  "Compatible" is definitionally "same
+  compiled program" (`serve.cache.program_key`), so packing can never
+  mix trajectories that belong to different programs.
+* **Bitwise request isolation**: lanes are independent under `vmap`
+  (the masking/donation contract of docs/12), so a request packed with
+  strangers produces results bitwise equal to the direct
+  `run_experiment_stream` call with the same `wave_size` — the slot
+  partition `n = min(wave_size, R - lo)` reproduces the direct call's
+  wave partition, each slot's slice folds through the SAME jitted fold
+  program, and the accumulator starts from the same zeros
+  (`tests/test_serve.py` pins this with concurrent mixed clients).
+
+Around the dispatcher: admission control with a bounded queue and
+blocking backpressure, per-request deadlines and cancellation, and
+retry-with-exponential-backoff on dispatch failure that never stalls
+the queue (failed requests back off in a delay heap while the
+dispatcher keeps serving; see `serve.sched`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from cimba_tpu.serve import cache as _pcache
+from cimba_tpu.serve.sched import (
+    AdmissionQueue,
+    Backoff,
+    Cancelled,
+    DeadlineExceeded,
+    QueueFull,
+    RetriesExhausted,
+    ServeError,
+    ServiceClosed,
+)
+
+__all__ = [
+    "Request", "ResultHandle", "Service",
+    "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
+    "DeadlineExceeded", "RetriesExhausted", "Backoff",
+]
+
+
+def _default_summary_path():
+    from cimba_tpu.runner import experiment as ex
+
+    return ex.default_summary_path
+
+
+@dataclass
+class Request:
+    """One experiment request — the arguments of a direct
+    :func:`cimba_tpu.runner.experiment.run_experiment_stream` call,
+    plus serving policy (priority, deadline, label).
+
+    ``wave_size=None`` uses the service's ``max_wave``; either way the
+    effective wave size defines the request's slot partition, and the
+    result is bitwise the direct call's at that same ``wave_size``.
+    ``deadline`` is seconds from submission, checked at every dispatch
+    boundary: a request whose deadline has expired when the dispatcher
+    reaches it (initially or between its waves) fails with
+    :class:`DeadlineExceeded`; work already running on the device is
+    never interrupted — a deadline expiring mid-wave delivers that
+    wave, then fails before the next."""
+
+    spec: Any
+    params: Any
+    n_replications: int
+    seed: int = 0
+    t_end: Optional[float] = None
+    pack: Optional[bool] = None
+    chunk_steps: int = 1024
+    wave_size: Optional[int] = None
+    summary_path: Optional[Callable] = None
+    priority: int = 0
+    deadline: Optional[float] = None
+    label: Optional[str] = None
+
+    def __post_init__(self):
+        if self.summary_path is None:
+            self.summary_path = _default_summary_path()
+
+
+class _Entry:
+    """Dispatcher-internal per-request state (the queue stores these)."""
+
+    __slots__ = (
+        "request", "seq", "priority", "label", "compat", "eff_wave",
+        "with_metrics", "next_lo", "acc", "n_waves", "retries", "solo",
+        "cancelled", "in_flight", "submit_t", "first_dispatch_t",
+        "deadline_at", "done", "result", "exc",
+    )
+
+    def __init__(self, request, seq, compat, eff_wave, with_metrics):
+        self.request = request
+        self.seq = seq
+        self.priority = request.priority
+        self.label = request.label
+        self.compat = compat
+        self.eff_wave = eff_wave
+        self.with_metrics = with_metrics
+        self.next_lo = 0
+        self.acc = None
+        self.n_waves = 0
+        self.retries = 0
+        self.solo = False          # excluded from packing (retry isolation)
+        self.cancelled = False
+        self.in_flight = False
+        self.submit_t = time.monotonic()
+        self.first_dispatch_t = None
+        self.deadline_at = (
+            None if request.deadline is None
+            else self.submit_t + request.deadline
+        )
+        self.done = threading.Event()
+        self.result = None
+        self.exc = None
+
+
+class ResultHandle:
+    """The future a :meth:`Service.submit` returns."""
+
+    def __init__(self, service: "Service", entry: _Entry):
+        self._service = service
+        self._entry = entry
+
+    @property
+    def label(self) -> Optional[str]:
+        return self._entry.label
+
+    def done(self) -> bool:
+        return self._entry.done.is_set()
+
+    def cancel(self) -> bool:
+        """Cancel if still undispatched; returns False once any slot is
+        in flight or the request already completed."""
+        return self._service._cancel(self._entry)
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._entry.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self._entry.label or self._entry.seq} not "
+                f"done within {timeout}s"
+            )
+        return self._entry.exc
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the request's ``StreamResult`` (raises the
+        structured serving error on failure)."""
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._entry.result
+
+
+#: outcomes recorded in stats and trace spans
+_OUTCOMES = ("completed", "failed", "cancelled", "deadline_exceeded")
+
+
+class Service:
+    """A thread-based experiment service over one device (or mesh).
+
+    ``max_wave`` bounds the lanes of one packed wave (one dispatch);
+    ``max_pending`` bounds the admission queue (backpressure past it);
+    ``cache`` is the shared :class:`~cimba_tpu.serve.cache.ProgramCache`
+    (one is created if not given — pass your own to share warm programs
+    with direct `run_experiment_stream` calls or across services);
+    ``max_retries``/``backoff`` govern dispatch-failure retries;
+    ``on_chunk`` is a per-chunk progress hook (bench.py's watchdog
+    heartbeat).  Use as a context manager for a graceful shutdown."""
+
+    def __init__(
+        self,
+        *,
+        max_wave: int = 4096,
+        max_pending: int = 64,
+        mesh=None,
+        cache=None,
+        max_retries: int = 2,
+        backoff: Backoff = Backoff(),
+        poll_every: int = 4,
+        on_chunk: Optional[Callable] = None,
+        trace_cap: int = 4096,
+        name: str = "cimba-serve",
+    ):
+        if max_wave <= 0:
+            raise ValueError(f"max_wave must be positive: {max_wave}")
+        self.max_wave = int(max_wave)
+        self.mesh = mesh
+        self.poll_every = poll_every
+        self.max_retries = int(max_retries)
+        self.backoff = backoff
+        self.cache = cache if cache is not None else _pcache.ProgramCache()
+        self._on_chunk = on_chunk
+        self._queue = AdmissionQueue(max_pending)
+        self._lock = threading.RLock()
+        self._drained = threading.Condition(self._lock)
+        self._outstanding = 0
+        self._seq = 0
+        self._closed = False
+        self._stop = False
+        self._t0 = time.monotonic()
+        self._spans = deque(maxlen=trace_cap)
+        self._depth_samples = deque(maxlen=trace_cap)
+        self._counters = {
+            "submitted": 0, "admitted": 0, "rejected": 0,
+            "retries": 0, "batches": 0, "waves": 0,
+            "lanes_dispatched": 0,
+        }
+        for o in _OUTCOMES:
+            self._counters[o] = 0
+        self._occupancy: dict = {}       # requests-per-batch -> count
+        self._ttfw_sum = 0.0
+        self._ttfw_max = 0.0
+        self._ttfw_n = 0
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(
+        self, request: Request, *, block: bool = True,
+        timeout: Optional[float] = None,
+    ) -> ResultHandle:
+        """Admit a request; returns its future.  ``block=True`` (the
+        default) waits for queue space — the backpressure arm;
+        ``block=False`` (or a ``timeout`` expiry) raises
+        :class:`QueueFull` instead and counts an admission reject."""
+        R = int(request.n_replications)
+        if R <= 0:
+            raise ValueError(f"n_replications must be positive, got {R}")
+        eff_wave = min(
+            R, self.max_wave if request.wave_size is None
+            else int(request.wave_size),
+        )
+        if eff_wave <= 0:
+            raise ValueError(
+                f"wave_size must be positive, got {request.wave_size}"
+            )
+        if eff_wave > self.max_wave:
+            raise ValueError(
+                f"request wave_size={eff_wave} exceeds the service's "
+                f"max_wave={self.max_wave} — it could never be scheduled"
+            )
+        if self.mesh is not None:
+            n_dev = self.mesh.devices.size
+            if R % n_dev or eff_wave % n_dev:
+                raise ValueError(
+                    f"n_replications={R} and wave_size={eff_wave} must "
+                    f"divide evenly over {n_dev} devices"
+                )
+        from cimba_tpu.obs import metrics as _metrics
+
+        with_metrics = _metrics.enabled()
+        compat = self._compat_key(request, with_metrics)
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed(
+                    "service is draining/shut down — no new requests"
+                )
+            self._counters["submitted"] += 1
+            self._seq += 1
+            entry = _Entry(request, self._seq, compat, eff_wave,
+                           with_metrics)
+            self._outstanding += 1
+        try:
+            self._queue.put(entry, block=block, timeout=timeout)
+        except (QueueFull, ServiceClosed):
+            with self._lock:
+                self._outstanding -= 1
+                self._counters["rejected"] += 1
+                self._drained.notify_all()
+            raise
+        with self._lock:
+            self._counters["admitted"] += 1
+        return ResultHandle(self, entry)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every admitted request has completed (a quiesce
+        point; admission stays open).  Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._outstanding > 0:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._drained.wait(remaining)
+            return True
+
+    def shutdown(
+        self, wait: bool = True, timeout: Optional[float] = None,
+    ) -> None:
+        """Stop admitting.  ``wait=True`` drains queued requests first
+        (graceful); ``wait=False`` cancels everything still queued.
+        Idempotent."""
+        with self._lock:
+            self._closed = True
+        self._queue.close()
+        if wait:
+            self.drain(timeout)
+        else:
+            for entry in self._queue.drain_now():
+                self._finish(entry, exc=Cancelled(entry.label),
+                             outcome="cancelled")
+        self._stop = True
+        self._queue.kick()
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=True)
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Service-level metrics: counters, queue depth (+ high-water),
+        batch-occupancy histogram (requests per packed wave),
+        time-to-first-wave aggregate, and the shared program cache's
+        hit/miss/eviction counters."""
+        with self._lock:
+            out = dict(self._counters)
+            out["queue_depth"] = self._queue.depth()
+            out["queue_depth_hwm"] = self._queue.depth_hwm
+            out["outstanding"] = self._outstanding
+            out["batch_occupancy"] = dict(
+                sorted(self._occupancy.items())
+            )
+            out["time_to_first_wave"] = {
+                "count": self._ttfw_n,
+                "mean_s": (
+                    self._ttfw_sum / self._ttfw_n if self._ttfw_n else 0.0
+                ),
+                "max_s": self._ttfw_max,
+            }
+        if hasattr(self.cache, "stats"):
+            out["program_cache"] = self.cache.stats()
+        return out
+
+    def chrome_trace(self) -> dict:
+        """Request lifecycle spans + queue-depth counter track as a
+        Chrome-trace / Perfetto dict (the same Trace Event Format schema
+        ``obs.export`` emits, and it passes
+        ``obs.export.validate_chrome_trace``): each request is one
+        complete 'X' span on its own pid track, service stats ride in
+        ``otherData.service``."""
+        with self._lock:
+            spans = list(self._spans)
+            depths = list(self._depth_samples)
+        events = []
+        for s in spans:
+            events.append({
+                "name": s["label"] or f"request {s['seq']}",
+                "ph": "X",
+                "ts": (s["submit"] - self._t0) * 1e6,
+                "dur": max((s["end"] - s["submit"]) * 1e6, 0.0),
+                "pid": s["seq"],
+                "tid": 0,
+                "args": {
+                    "outcome": s["outcome"],
+                    "lanes": s["lanes"],
+                    "time_to_first_wave_s": s["ttfw"],
+                    "retries": s["retries"],
+                },
+            })
+            events.append({
+                "name": "process_name", "ph": "M", "pid": s["seq"],
+                "args": {"name": s["label"] or f"request {s['seq']}"},
+            })
+        # a live depth sample closes the counter track — and guarantees
+        # at least one event, so an IDLE service still exports a
+        # validator-clean trace
+        depths.append((time.monotonic(), self._queue.depth()))
+        for t, d in depths:
+            events.append({
+                "name": "queue_depth", "ph": "C",
+                "ts": (t - self._t0) * 1e6, "pid": 0, "tid": 0,
+                "args": {"depth": d},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"service": self.stats()},
+        }
+
+    # -- internals -----------------------------------------------------------
+
+    def _compat_key(self, request: Request, with_metrics: bool) -> tuple:
+        """What may share a wave: the compiled-program key (spec
+        identity, seed, profile, flags, horizon, chunk size, mesh) PLUS
+        `summary_path` identity (the fold program) and the params tree
+        signature (slices of both requests' params must concatenate).
+        Param VALUES are per-lane data and do not join the key — a
+        sweep point and a different sweep point pack together."""
+        import jax
+
+        from cimba_tpu.runner import experiment as ex
+
+        pk = _pcache.program_key(
+            request.spec, request.seed, with_metrics,
+            _pcache.run_settings_key(
+                request.t_end, request.pack, request.chunk_steps,
+                self.mesh,
+            ),
+        )
+        shapes = jax.eval_shape(
+            lambda: ex._slice_params(
+                request.params, request.n_replications, 0, 1
+            )
+        )
+        sig = (
+            jax.tree.structure(shapes),
+            tuple(
+                (tuple(l.shape[1:]), str(l.dtype))
+                for l in jax.tree.leaves(shapes)
+            ),
+        )
+        return (pk, request.summary_path, sig)
+
+    def _cancel(self, entry: _Entry) -> bool:
+        with self._lock:
+            if entry.done.is_set() or entry.in_flight:
+                return False
+            entry.cancelled = True
+        # finish now (snappy futures); the dispatcher drops the
+        # tombstone when it reaches it in the queue
+        self._finish(entry, exc=Cancelled(entry.label),
+                     outcome="cancelled")
+        self._queue.kick()
+        return True
+
+    def _finish(self, entry: _Entry, *, result=None, exc=None,
+                outcome: str) -> None:
+        with self._lock:
+            if entry.done.is_set():
+                return
+            entry.result = result
+            entry.exc = exc
+            now = time.monotonic()
+            self._counters[outcome] += 1
+            self._spans.append({
+                "seq": entry.seq,
+                "label": entry.label,
+                "submit": entry.submit_t,
+                "end": now,
+                "outcome": outcome,
+                "lanes": entry.request.n_replications,
+                "ttfw": (
+                    None if entry.first_dispatch_t is None
+                    else entry.first_dispatch_t - entry.submit_t
+                ),
+                "retries": entry.retries,
+            })
+            if entry.first_dispatch_t is not None:
+                ttfw = entry.first_dispatch_t - entry.submit_t
+                self._ttfw_sum += ttfw
+                self._ttfw_max = max(self._ttfw_max, ttfw)
+                self._ttfw_n += 1
+            self._outstanding -= 1
+            entry.done.set()
+            self._drained.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            entry = self._queue.pop_ready(timeout=0.25)
+            if entry is None:
+                if self._stop or (self._closed and self._outstanding == 0):
+                    # a backoff-delayed retry may still sit in the
+                    # delay heap (it failed after shutdown's
+                    # drain_now): cancel it rather than strand its
+                    # future forever
+                    for e in self._queue.drain_now():
+                        if not e.done.is_set():
+                            self._finish(e, exc=Cancelled(e.label),
+                                         outcome="cancelled")
+                    return
+                continue
+            if self._stop:
+                # non-graceful shutdown: whatever is still being popped
+                # (including a requeued multi-wave remainder) is
+                # cancelled, not run to completion
+                if not entry.done.is_set():
+                    self._finish(entry, exc=Cancelled(entry.label),
+                                 outcome="cancelled")
+                continue
+            with self._lock:
+                if entry.done.is_set():  # cancelled tombstone
+                    continue
+                # CLAIM under the service lock: from here cancel()
+                # returns False — an entry is either cancelled while
+                # truly undispatched, or it runs; never both
+                entry.in_flight = True
+            now = time.monotonic()
+            if entry.deadline_at is not None and now > entry.deadline_at:
+                self._finish(
+                    entry,
+                    exc=DeadlineExceeded(
+                        entry.request.deadline, now - entry.submit_t,
+                        entry.label,
+                    ),
+                    outcome="deadline_exceeded",
+                )
+                continue
+            slots, members = self._pack(entry)
+            try:
+                # the fold is inside the guard too: a summary_path whose
+                # SHAPE preflights fine but whose fold-trace raises (e.g.
+                # a non-Summary statistic fed to the Pébay merge) must
+                # fail the REQUESTS, never kill the dispatcher thread —
+                # a dead dispatcher hangs every outstanding future
+                sims = self._run_batch(slots)
+                self._fold_slots(slots, sims)
+            except Exception as e:
+                self._batch_failed(members, e)
+                continue
+            self._complete_members(members)
+
+    def _pack(self, lead: _Entry):
+        """Build one wave: the lead's slots first (its own wave
+        partition — only whole slots, never clipped, so the fold stays
+        bitwise the direct call's), then fill remaining lanes with
+        compatible queued requests in priority order.  The lead arrives
+        already CLAIMED (in_flight, set by the loop under the service
+        lock); fill candidates are claimed here the same way — one that
+        was cancelled in the gap between leaving the queue and the
+        claim is dropped, never dispatched (cancel() stays truthful)."""
+        budget = self.max_wave
+
+        def plan(entry) -> list:
+            """The entry's whole-slot partition that fits the budget."""
+            nonlocal budget
+            out = []
+            lo = entry.next_lo
+            R = entry.request.n_replications
+            while lo < R:
+                n = min(entry.eff_wave, R - lo)
+                if n > budget:
+                    break
+                out.append((lo, n))
+                budget -= n
+                lo += n
+            return out
+
+        slots = [(lead, lo, n) for lo, n in plan(lead)]
+        members = [lead]
+        planned: list = []
+        if budget > 0 and not lead.solo:
+            now = time.monotonic()
+            dropped: list = []
+
+            def want(e: _Entry) -> bool:
+                if e.done.is_set():
+                    return True      # cancelled tombstone: just remove
+                if e.deadline_at is not None and now > e.deadline_at:
+                    dropped.append(e)
+                    return True
+                if e.solo or e.compat != lead.compat:
+                    return False
+                p = plan(e)
+                if not p:
+                    return False
+                planned.append((e, p))
+                return True
+
+            self._queue.take(want)
+            for e in dropped:
+                self._finish(
+                    e,
+                    exc=DeadlineExceeded(
+                        e.request.deadline, now - e.submit_t, e.label,
+                    ),
+                    outcome="deadline_exceeded",
+                )
+        with self._lock:
+            for e, p in planned:
+                if e.done.is_set():  # cancelled before the claim: drop
+                    continue
+                e.in_flight = True
+                members.append(e)
+                slots.extend((e, lo, n) for lo, n in p)
+            for e in members:
+                if e.first_dispatch_t is None:
+                    e.first_dispatch_t = time.monotonic()
+            self._counters["batches"] += 1
+            self._counters["waves"] += len(slots)
+            self._counters["lanes_dispatched"] += sum(
+                n for _, _, n in slots
+            )
+            k = len(members)
+            self._occupancy[k] = self._occupancy.get(k, 0) + 1
+            self._depth_samples.append(
+                (time.monotonic(), self._queue.depth())
+            )
+        return slots, members
+
+    def _run_batch(self, slots):
+        """Dispatch ONE packed wave: init the concatenated lanes, drive
+        the shared chunk program to completion.  Separated out as the
+        failure-injection seam for the retry tests."""
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.core.loop import drive_chunks
+        from cimba_tpu.runner import experiment as ex
+
+        from cimba_tpu.obs import metrics as _metrics
+
+        lead = slots[0][0]
+        req = lead.request
+        pk_now = _pcache.program_key(
+            req.spec, req.seed, _metrics.enabled(),
+            _pcache.run_settings_key(
+                req.t_end, req.pack, req.chunk_steps, self.mesh,
+            ),
+        )
+        if pk_now != lead.compat[0]:
+            # the FULL program key (dtype profile, obs.metrics/trace
+            # flags, eventset layout, the pack default...) was frozen
+            # into the compatibility key at submit; tracing now under
+            # drifted globals would cache a program whose behavior
+            # contradicts that key (and silently serve it to every
+            # later request at this key).  ValueError = permanent:
+            # fail the request loudly instead.
+            raise ValueError(
+                "serve: a trace-time global (dtype profile, "
+                "obs.metrics/obs.trace state, eventset layout, or the "
+                "pack default) changed between this request's submit "
+                "and its dispatch — the compatibility key binds at "
+                "submit time; resubmit after settling the globals"
+            )
+        init_j, chunk_j = _pcache.get_programs(
+            self.cache, req.spec, seed=req.seed, mesh=self.mesh,
+            t_end=req.t_end, pack=req.pack, chunk_steps=req.chunk_steps,
+            with_metrics=lead.with_metrics,
+        )
+        _pcache.preflight_summary_path(
+            self.cache, req.spec, init_j, req.summary_path, req.params,
+            req.n_replications, slots[0][2], lead.with_metrics,
+        )
+        reps = [jnp.arange(lo, lo + n) for _, lo, n in slots]
+        pws = [
+            ex._slice_params(
+                e.request.params, e.request.n_replications, lo, n
+            )
+            for e, lo, n in slots
+        ]
+        if len(slots) == 1:
+            reps_cat, pw_cat = reps[0], pws[0]
+        else:
+            reps_cat = jnp.concatenate(reps, axis=0)
+            pw_cat = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *pws
+            )
+        sims = init_j(reps_cat, pw_cat)
+        return drive_chunks(
+            chunk_j, sims, poll_every=self.poll_every,
+            on_chunk=self._on_chunk,
+        )
+
+    def _fold_slots(self, slots, sims) -> None:
+        """Slice the finished wave back per slot and fold each into its
+        request's accumulator — in slot order, so a multi-slot request
+        folds exactly as its direct stream call would.  May raise (the
+        fold traces user code); acc and next_lo advance together per
+        slot, so a retry after a mid-batch failure resumes exactly at
+        the first unfolded slot."""
+        import jax
+
+        lead = slots[0][0]
+        fold_j = _pcache.get_fold(
+            self.cache, lead.with_metrics, lead.request.summary_path
+        )
+        off = 0
+        for entry, lo, n in slots:
+            sl = jax.tree.map(
+                lambda x, off=off, n=n: x[off: off + n], sims
+            )
+            if entry.acc is None:
+                entry.acc = _pcache.stream_acc(
+                    entry.request.spec, entry.with_metrics
+                )
+            entry.acc = fold_j(entry.acc, sl)
+            entry.n_waves += 1
+            entry.next_lo = lo + n
+            off += n
+
+    def _complete_members(self, members) -> None:
+        """After a successful fold: finish done requests, requeue the
+        rest.  No user code runs here — it must not raise (a raise
+        after partial requeues could double-queue an entry)."""
+        for entry in members:
+            with self._lock:
+                entry.in_flight = False
+            if entry.next_lo >= entry.request.n_replications:
+                self._finish_completed(entry)
+            else:
+                # a request larger than one packed wave: remaining
+                # slots go back through the queue at its own priority
+                self._queue.requeue(entry)
+
+    def _finish_completed(self, entry: _Entry) -> None:
+        """Deliver a fully-folded request's StreamResult — the same
+        shape the direct ``run_experiment_stream`` call returns."""
+        from cimba_tpu.runner.experiment import StreamResult
+
+        acc = entry.acc
+        self._finish(
+            entry,
+            result=StreamResult(
+                summary=acc[0],
+                n_failed=acc[1],
+                total_events=acc[2],
+                n_waves=entry.n_waves,
+                n_regrows=0,
+                metrics=acc[3] if entry.with_metrics else None,
+            ),
+            outcome="completed",
+        )
+
+    def _batch_failed(self, members, exc: Exception) -> None:
+        """Dispatch (or fold) failed.  Every member retries SOLO after
+        exponential backoff — in the delay heap, so the dispatcher
+        keeps serving other requests meanwhile.  The retry BUDGET is
+        only charged for solo failures: when a PACKED batch fails,
+        blame is unattributable, so members are demoted to solo and
+        re-queued uncharged — an innocent request packed with a poison
+        peer keeps its full budget of attributable solo attempts (and
+        typically just succeeds on the first one).  ValueError/
+        TypeError are treated as permanent (bad request, e.g. a
+        summary_path that doesn't exist on the model) and surface
+        immediately; anything else is presumed transient until the
+        budget runs out.  ``stats()["retries"]`` counts every retry
+        re-queue, charged or not."""
+        permanent = isinstance(exc, (ValueError, TypeError))
+        charged = len(members) == 1  # solo failure: blame attributable
+        for entry in members:
+            with self._lock:
+                entry.in_flight = False
+            if entry.next_lo >= entry.request.n_replications:
+                # every one of ITS slots folded before the batch died
+                # (a later member's fold failed): the result is whole —
+                # deliver it; requeueing a slotless entry would crash
+                # the next dispatch and discard computed work
+                self._finish_completed(entry)
+                continue
+            with self._lock:
+                entry.solo = True
+                if charged:
+                    entry.retries += 1
+            if permanent:
+                self._finish(entry, exc=exc, outcome="failed")
+            elif charged and entry.retries > self.max_retries:
+                err = RetriesExhausted(entry.retries, entry.label)
+                err.__cause__ = exc
+                self._finish(entry, exc=err, outcome="failed")
+            elif self._stop:
+                # non-graceful shutdown already ran: a retry requeued
+                # into the delay heap now could outlive the dispatcher
+                # and strand its future — cancel instead
+                self._finish(entry, exc=Cancelled(entry.label),
+                             outcome="cancelled")
+            else:
+                with self._lock:
+                    self._counters["retries"] += 1
+                self._queue.requeue(
+                    entry,
+                    delay=self.backoff.delay(max(entry.retries, 1)),
+                )

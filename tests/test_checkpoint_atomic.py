@@ -1,0 +1,85 @@
+"""Atomicity of checkpoint saves (docs/12: chunk-boundary checkpoints).
+
+A preempted or crashed ``checkpoint.save``/``save_resumable`` must
+never leave state that ``restore_resumable`` half-reads: the bytes go
+to a uniquely-named temp file in the same directory, are fsync'd, and
+are published with one atomic ``os.replace``.  Pinned here:
+
+* a partial/garbage ``*.tmp`` orphan next to the checkpoint (a killed
+  process mid-write) is invisible to restore;
+* a save that dies mid-serialization leaves the PREVIOUS checkpoint
+  intact, readable, and leaves no temp litter behind;
+* two saves to the same path cannot collide on a shared temp name
+  (unique ``mkstemp`` names, not ``path + ".tmp"``).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cimba_tpu.runner import checkpoint as ck
+
+
+def _tree(x=0.0):
+    return {"a": jnp.arange(4) + int(x), "b": jnp.float32(x)}
+
+
+def test_partial_temp_file_is_ignored(tmp_path):
+    """Orphaned temp files — truncated npz garbage with the checkpoint's
+    own prefix — must not be read by restore; only the published path
+    is."""
+    path = str(tmp_path / "run.npz")
+    ck.save(path, _tree(1.0), tag="t")
+
+    # a killed writer's litter, in every historical/current temp spelling
+    for name in ("run.npz.tmp", "run.npz.abc123.tmp"):
+        with open(str(tmp_path / name), "wb") as fh:
+            fh.write(b"PK\x03\x04 this is not a complete archive")
+
+    out = ck.restore(path, _tree(), tag="t")
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4) + 1)
+    assert float(out["b"]) == 1.0
+
+
+def test_crashed_save_preserves_previous_checkpoint(tmp_path, monkeypatch):
+    """A save that dies mid-serialization (simulated: np.savez raises
+    after writing some bytes) must leave the previous checkpoint
+    byte-identical and must clean up its temp file."""
+    path = str(tmp_path / "run.npz")
+    ck.save(path, _tree(7.0), tag="t")
+    before = open(path, "rb").read()
+
+    real_savez = np.savez
+
+    def dying_savez(fh, **arrays):
+        fh.write(b"partial bytes that must never be published")
+        raise RuntimeError("simulated preemption mid-save")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        ck.save(path, _tree(8.0), tag="t")
+    monkeypatch.setattr(np, "savez", real_savez)
+
+    assert open(path, "rb").read() == before
+    out = ck.restore(path, _tree(), tag="t")
+    assert float(out["b"]) == 7.0
+    # no temp litter: the failed save unlinked its unique temp
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert leftovers == [], leftovers
+
+
+def test_resumable_roundtrip_and_unique_temps(tmp_path):
+    """save_resumable goes through the same atomic path; repeated saves
+    to one path never leave temps behind (each used its own unique
+    name and replaced into place)."""
+    path = str(tmp_path / "resume.npz")
+    for k in range(3):
+        ck.save_resumable(path, _tree(float(k)), tag="r", progress=k)
+    sims, progress = ck.restore_resumable(
+        path, _tree(), tag="r"
+    )
+    assert progress == 2
+    assert float(sims["b"]) == 2.0
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []

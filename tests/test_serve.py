@@ -1,0 +1,856 @@
+"""The experiment-serving layer (docs/13_serving.md).
+
+Contracts pinned here:
+
+* **bitwise request isolation**: a request served from a packed,
+  multiplexed wave returns a ``StreamResult`` bitwise equal to the
+  direct single-caller ``run_experiment_stream`` call with the same
+  arguments — concurrent clients, compatible and incompatible requests
+  interleaved, single- and multi-wave requests;
+* **packing policy**: compatible requests (same program-cache key)
+  share one dispatch; incompatible ones never do; priority orders
+  dispatch;
+* **admission control**: the bounded queue backpressures blocking
+  submitters and rejects non-blocking ones with structured
+  ``QueueFull``;
+* **deadlines / cancellation**: a request expiring mid-queue fails
+  with structured ``DeadlineExceeded`` and later requests still
+  complete (no dispatcher stall); cancellation works while queued,
+  refuses once in flight;
+* **retries**: transient dispatch failures back off and retry solo
+  without stalling the queue; permanent (ValueError) failures surface
+  immediately; the retry budget exhausts into ``RetriesExhausted``;
+* **program cache**: bounded LRU semantics, eviction/hit/miss
+  counters, env cap, correctness under eviction pressure.
+
+Deterministic scheduling in the policy tests comes from a gated
+Service subclass whose ``_run_batch`` blocks until the test releases
+it — queue states are constructed, not raced.  The tier-1 tests ride
+the fast-compiling tiny model; the many-client mm1/mg1 soak (the
+acceptance battery at full size) is marked slow (tools/ci.sh runs it).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.models import mg1, mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.stats import summary as sm
+
+
+def _tiny_spec(t_stop=4.0):
+    """Smallest chunkable model (hold/exit only — compiles in a
+    fraction of mm1's time): one process holding unit steps."""
+    m = Model("tiny", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    """tiny records no user summary; pool each lane's final clock (one
+    MODULE-LEVEL function: request compatibility and the fold program
+    both key on summary_path identity)."""
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+def _assert_results_equal(a, b):
+    """StreamResult == StreamResult, bitwise on every leaf."""
+    assert a.n_waves == b.n_waves
+    assert a.n_regrows == b.n_regrows
+    al = jax.tree.leaves((a.summary, a.n_failed, a.total_events, a.metrics))
+    bl = jax.tree.leaves((b.summary, b.n_failed, b.total_events, b.metrics))
+    assert len(al) == len(bl)
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """ONE tiny spec object for the whole module: program-cache keys
+    are by spec identity, so sharing the object (plus the module
+    ``shared_cache``) pays each (seed, shape) compile once across the
+    battery — the tier-1 budget discipline."""
+    return _tiny_spec(12.0)
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+class _Gated(serve.Service):
+    """Service whose dispatch blocks until the test opens the gate —
+    the queue state under test is CONSTRUCTED, not raced."""
+
+    def __init__(self, **kw):
+        self.gate = threading.Event()
+        super().__init__(**kw)
+
+    def _run_batch(self, slots):
+        assert self.gate.wait(60), "test gate never opened"
+        return super()._run_batch(slots)
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+def _tiny_req(spec, R, *, wave=None, seed=1, **kw):
+    return serve.Request(
+        spec, (), R, seed=seed, chunk_steps=16, wave_size=wave,
+        summary_path=_clock_path, **kw,
+    )
+
+
+# --------------------------------------------------------------------------
+# bitwise identity vs the direct single-caller path
+# --------------------------------------------------------------------------
+
+
+def test_serve_single_and_multiwave_match_direct_bitwise(
+    tiny, shared_cache,
+):
+    """One service, one cache: a single-wave request and a multi-wave
+    request (R spanning several of its own waves, packed alongside)
+    both return results bitwise equal to direct run_experiment_stream
+    calls with the same arguments — sharing the same compiled programs
+    through the same cache."""
+    spec, cache = tiny, shared_cache
+    with serve.Service(max_wave=16, cache=cache) as svc:
+        h1 = svc.submit(_tiny_req(spec, 8, wave=4, label="multiwave"))
+        h2 = svc.submit(_tiny_req(spec, 4, wave=4, label="single"))
+        r1 = h1.result(60)
+        r2 = h2.result(60)
+    d1 = ex.run_experiment_stream(
+        spec, (), 8, wave_size=4, chunk_steps=16, seed=1,
+        summary_path=_clock_path, program_cache=cache,
+    )
+    d2 = ex.run_experiment_stream(
+        spec, (), 4, wave_size=4, chunk_steps=16, seed=1,
+        summary_path=_clock_path, program_cache=cache,
+    )
+    assert r1.n_waves == 2 and r2.n_waves == 1
+    _assert_results_equal(r1, d1)
+    _assert_results_equal(r2, d2)
+
+
+def test_serve_concurrent_clients_match_direct_bitwise(
+    tiny, shared_cache,
+):
+    """The tier-1 acceptance shape: 8 concurrent client threads submit
+    interleaved COMPATIBLE (same seed) and INCOMPATIBLE (different
+    seed) requests, single- and multi-wave; every result is bitwise the
+    direct single-caller run's — no cross-request leakage, no
+    wave-packing contamination.  (The same battery at mm1/mg1 scale is
+    the slow soak below.)"""
+    spec, cache = tiny, shared_cache
+    cases = [  # (R, wave, seed) — seeds 1 and 2 cannot share waves
+        (4, 4, 1), (8, 4, 1), (4, 4, 2), (4, 4, 1),
+        (8, 4, 2), (4, 4, 2), (4, 4, 1), (8, 4, 1),
+    ]
+    results = [None] * len(cases)
+    with serve.Service(max_wave=16, cache=cache) as svc:
+        def client(i):
+            R, w, seed = cases[i]
+            h = svc.submit(
+                _tiny_req(spec, R, wave=w, seed=seed, label=f"c{i}")
+            )
+            results[i] = h.result(120)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(cases))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = svc.stats()
+    assert stats["completed"] == len(cases)
+    for i, (R, w, seed) in enumerate(cases):
+        direct = ex.run_experiment_stream(
+            spec, (), R, wave_size=w, chunk_steps=16, seed=seed,
+            summary_path=_clock_path, program_cache=cache,
+        )
+        _assert_results_equal(results[i], direct)
+
+
+# --------------------------------------------------------------------------
+# packing policy
+# --------------------------------------------------------------------------
+
+
+def test_packing_compatible_shares_wave_incompatible_does_not(
+    tiny, shared_cache,
+):
+    """Constructed queue: while the lead request is gated in dispatch,
+    three compatible requests and one incompatible (different seed)
+    queue up.  The next dispatch packs exactly the compatible three
+    into ONE wave; the incompatible one rides alone."""
+    spec = tiny
+    svc = _Gated(max_wave=32, cache=shared_cache)
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)  # lead packed, gated
+        compat = [
+            svc.submit(_tiny_req(spec, 4, label=f"k{i}")) for i in range(3)
+        ]
+        other = svc.submit(_tiny_req(spec, 4, seed=2, label="odd"))
+        svc.gate.set()
+        for h in [lead] + compat + [other]:
+            h.result(60)
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    # batch 1: lead alone (nothing else queued yet); batch 2: the three
+    # compatible requests; batch 3: the incompatible singleton
+    assert occ == {1: 2, 3: 1}, occ
+
+
+def test_priority_orders_dispatch(tiny, shared_cache):
+    """Higher priority pops first: with the dispatcher gated on a lead
+    batch, a high-priority late arrival is served before an earlier
+    low-priority one (they are incompatible, so order is observable as
+    separate batches in completion-span order)."""
+    spec = tiny
+    svc = _Gated(max_wave=8, cache=shared_cache)
+    try:
+        svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        lo = svc.submit(_tiny_req(spec, 4, seed=2, label="low"))
+        hi = svc.submit(
+            _tiny_req(spec, 4, seed=3, label="high", priority=5)
+        )
+        svc.gate.set()
+        lo.result(60)
+        hi.result(60)
+        spans = [
+            e["name"] for e in svc.chrome_trace()["traceEvents"]
+            if e["ph"] == "X"
+        ]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert spans.index("high") < spans.index("low"), spans
+
+
+# --------------------------------------------------------------------------
+# deadlines, cancellation, admission control
+# --------------------------------------------------------------------------
+
+
+def test_deadline_exceeded_mid_queue_without_stalling_others(
+    tiny, shared_cache,
+):
+    """The acceptance pin: a request whose deadline expires while it
+    waits behind a gated dispatch fails with structured
+    DeadlineExceeded; requests before AND after it complete normally —
+    the dispatcher never stalls."""
+    spec = tiny
+    svc = _Gated(max_wave=8, cache=shared_cache)
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        doomed = svc.submit(
+            _tiny_req(spec, 4, seed=2, label="doomed", deadline=0.03)
+        )
+        later = svc.submit(_tiny_req(spec, 4, seed=3, label="later"))
+        time.sleep(0.08)  # let the deadline lapse while gated
+        svc.gate.set()
+        assert lead.result(60) is not None
+        assert later.result(60) is not None
+        with pytest.raises(serve.DeadlineExceeded) as ei:
+            doomed.result(60)
+        assert ei.value.deadline_s == pytest.approx(0.03)
+        assert ei.value.waited_s >= 0.03
+        assert ei.value.label == "doomed"
+        stats = svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert stats["deadline_exceeded"] == 1
+    assert stats["completed"] == 2
+
+
+def test_cancel_queued_yes_inflight_no(tiny, shared_cache):
+    spec = tiny
+    svc = _Gated(max_wave=8, cache=shared_cache)
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        queued = svc.submit(_tiny_req(spec, 4, seed=2, label="queued"))
+        assert queued.cancel() is True
+        assert queued.done()
+        with pytest.raises(serve.Cancelled):
+            queued.result(1)
+        assert lead.cancel() is False  # already in flight
+        svc.gate.set()
+        assert lead.result(60) is not None
+        stats = svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert stats["cancelled"] == 1 and stats["completed"] == 1
+
+
+def test_admission_backpressure_and_queue_full(tiny, shared_cache):
+    """Bounded queue: non-blocking submits past capacity raise
+    structured QueueFull (counted as rejects); a blocking submit with a
+    timeout gives backpressure then rejects; a blocking submit without
+    timeout is admitted once the queue drains."""
+    spec = tiny
+    svc = _Gated(max_wave=8, max_pending=2, cache=shared_cache)
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)  # lead out of queue
+        q1 = svc.submit(_tiny_req(spec, 4, seed=2, label="q1"))
+        q2 = svc.submit(_tiny_req(spec, 4, seed=3, label="q2"))
+        with pytest.raises(serve.QueueFull) as ei:
+            svc.submit(
+                _tiny_req(spec, 4, seed=4, label="nope"), block=False
+            )
+        assert ei.value.capacity == 2
+        t0 = time.monotonic()
+        with pytest.raises(serve.QueueFull):
+            svc.submit(
+                _tiny_req(spec, 4, seed=4, label="slow-nope"),
+                timeout=0.05,
+            )
+        assert time.monotonic() - t0 >= 0.05  # it really backpressured
+        admitted = []
+
+        def blocked_submit():
+            admitted.append(
+                svc.submit(_tiny_req(spec, 4, seed=5, label="patient"))
+            )
+
+        th = threading.Thread(target=blocked_submit)
+        th.start()
+        time.sleep(0.05)
+        assert not admitted  # still backpressured
+        svc.gate.set()
+        th.join(60)
+        assert admitted
+        for h in [lead, q1, q2] + admitted:
+            assert h.result(60) is not None
+        stats = svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert stats["rejected"] == 2
+    assert stats["completed"] == 4
+
+
+def test_submit_after_shutdown_and_validation_errors(tiny, shared_cache):
+    spec = tiny
+    svc = serve.Service(max_wave=8, cache=shared_cache)
+    svc.shutdown()
+    with pytest.raises(serve.ServiceClosed):
+        svc.submit(_tiny_req(spec, 4))
+    svc2 = serve.Service(max_wave=8, cache=shared_cache)
+    try:
+        with pytest.raises(ValueError, match="max_wave"):
+            svc2.submit(_tiny_req(spec, 64, wave=32))
+        with pytest.raises(ValueError, match="positive"):
+            svc2.submit(_tiny_req(spec, 0))
+    finally:
+        svc2.shutdown()
+
+
+# --------------------------------------------------------------------------
+# retries
+# --------------------------------------------------------------------------
+
+
+class _Flaky(serve.Service):
+    """Fails dispatch for batches containing a 'poison'-labelled
+    request until ``fail_times`` attempts have been burned."""
+
+    def __init__(self, fail_times, **kw):
+        self.fail_times = fail_times
+        self.attempts = 0
+        super().__init__(**kw)
+
+    def _run_batch(self, slots):
+        if any(e.label == "poison" for e, _, _ in slots):
+            self.attempts += 1
+            if self.attempts <= self.fail_times:
+                raise RuntimeError(f"transient #{self.attempts}")
+        return super()._run_batch(slots)
+
+
+def test_retry_backoff_recovers_and_never_stalls_queue(
+    tiny, shared_cache,
+):
+    """A transiently failing request backs off and retries SOLO while
+    an unrelated request submitted later still completes (the queue is
+    never stalled); the recovered result is bitwise the direct run's."""
+    spec, cache = tiny, shared_cache
+    svc = _Flaky(
+        2, max_wave=8, cache=cache, max_retries=2,
+        backoff=serve.Backoff(base=0.02),
+    )
+    try:
+        poison = svc.submit(_tiny_req(spec, 4, label="poison"))
+        healthy = svc.submit(_tiny_req(spec, 4, seed=2, label="healthy"))
+        assert healthy.result(60) is not None
+        res = poison.result(60)
+        stats = svc.stats()
+    finally:
+        svc.shutdown()
+    assert svc.attempts == 3  # 2 failures + 1 success
+    assert stats["retries"] == 2
+    direct = ex.run_experiment_stream(
+        spec, (), 4, wave_size=4, chunk_steps=16, seed=1,
+        summary_path=_clock_path, program_cache=cache,
+    )
+    _assert_results_equal(res, direct)
+
+
+def test_retry_budget_exhausts_into_structured_error(tiny, shared_cache):
+    spec = tiny
+    svc = _Flaky(
+        99, max_wave=8, cache=shared_cache, max_retries=1,
+        backoff=serve.Backoff(base=0.01),
+    )
+    try:
+        h = svc.submit(_tiny_req(spec, 4, label="poison"))
+        with pytest.raises(serve.RetriesExhausted) as ei:
+            h.result(60)
+        assert ei.value.attempts == 2  # initial + 1 retry
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        stats = svc.stats()
+    finally:
+        svc.shutdown()
+    assert stats["failed"] == 1
+
+
+def test_permanent_error_surfaces_immediately_without_retries(
+    tiny, shared_cache,
+):
+    """A summary_path that doesn't exist on the model is a BAD REQUEST
+    (ValueError from the preflight), not a transient fault: it must
+    surface as-is on the first attempt, with zero retries burned."""
+    spec = tiny
+    svc = serve.Service(max_wave=8, cache=shared_cache)
+    try:
+        bad = serve.Request(
+            spec, (), 4, seed=1, chunk_steps=16, wave_size=4,
+            summary_path=lambda sims: sims.user["nonexistent"],
+            label="bad-path",
+        )
+        h = svc.submit(bad)
+        with pytest.raises(ValueError, match="summary_path"):
+            h.result(60)
+        stats = svc.stats()
+    finally:
+        svc.shutdown()
+    assert stats["retries"] == 0 and stats["failed"] == 1
+
+
+def test_fold_failure_fails_request_not_dispatcher(tiny, shared_cache):
+    """A summary_path whose SHAPE preflights fine but whose fold-trace
+    raises (a plain array fed to the Pébay merge) must fail the
+    REQUEST with a structured error — and the dispatcher must survive
+    to serve the next request (a dead dispatcher would hang every
+    outstanding future forever)."""
+    spec = tiny
+    svc = serve.Service(
+        max_wave=8, cache=shared_cache, max_retries=0,
+        backoff=serve.Backoff(base=0.01),
+    )
+    try:
+        bad = serve.Request(
+            spec, (), 4, seed=1, chunk_steps=16, wave_size=4,
+            summary_path=lambda sims: sims.clock,  # not a Summary
+            label="bad-fold",
+        )
+        h = svc.submit(bad)
+        with pytest.raises(serve.RetriesExhausted):
+            h.result(60)
+        # the dispatcher is still alive and serving
+        assert svc.submit(_tiny_req(spec, 4)).result(60) is not None
+    finally:
+        svc.shutdown()
+
+
+def test_metrics_flip_between_submit_and_dispatch_fails_loudly(
+    tiny, shared_cache,
+):
+    """obs.metrics joins the compatibility key at submit; flipping it
+    before dispatch must fail the request with a loud ValueError — not
+    cache a program whose behavior contradicts its key."""
+    from cimba_tpu.obs import metrics as om
+
+    spec = tiny
+    svc = _Gated(max_wave=8, cache=shared_cache)
+    try:
+        om.enable()
+        try:
+            h = svc.submit(_tiny_req(spec, 4, label="flipped"))
+        finally:
+            om.disable()
+        svc.gate.set()
+        with pytest.raises(ValueError, match="binds at submit"):
+            h.result(60)
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+
+
+class _PackFlaky(_Gated):
+    """Fails any PACKED dispatch (more than one distinct request in the
+    batch); solo dispatches succeed."""
+
+    def _run_batch(self, slots):
+        if len({id(e) for e, _, _ in slots}) > 1:
+            raise RuntimeError("packed batch transient failure")
+        return super()._run_batch(slots)
+
+
+def test_packed_failure_does_not_charge_innocents(tiny, shared_cache):
+    """A failed PACKED batch must not burn the members' retry budgets:
+    blame is unattributable, so everyone is demoted to a solo retry
+    uncharged — with max_retries=0, both members of a poisoned packing
+    still complete on their solo attempts."""
+    spec = tiny
+    svc = _PackFlaky(
+        max_wave=16, cache=shared_cache, max_retries=0,
+        backoff=serve.Backoff(base=0.01),
+    )
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)  # lead gated solo
+        a = svc.submit(_tiny_req(spec, 4, label="a"))
+        b = svc.submit(_tiny_req(spec, 4, label="b"))
+        svc.gate.set()
+        # a+b pack, the packed dispatch fails, both retry solo and
+        # complete despite a zero retry budget
+        assert lead.result(60) is not None
+        assert a.result(60) is not None
+        assert b.result(60) is not None
+        stats = svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert stats["completed"] == 3
+    assert stats["failed"] == 0
+    assert stats["retries"] == 2  # the two uncharged solo re-queues
+    occ = stats["batch_occupancy"]
+    assert occ.get(2) == 1, occ  # the packed attempt happened
+
+
+def test_post_fold_failure_delivers_completed_members(tiny, shared_cache):
+    """A member whose own slots all folded before the batch died must
+    be COMPLETED with its (whole) result, not requeued slotless or
+    charged a retry — computed work is never discarded."""
+    spec = tiny
+
+    class _DiesAfterFolding(_Gated):
+        def _fold_slots(self, slots, sims):
+            super()._fold_slots(slots, sims)
+            if len({id(e) for e, _, _ in slots}) > 1:
+                raise RuntimeError("died after folding everything")
+
+    svc = _DiesAfterFolding(
+        max_wave=16, cache=shared_cache, max_retries=0,
+        backoff=serve.Backoff(base=0.01),
+    )
+    try:
+        lead = svc.submit(_tiny_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        a = svc.submit(_tiny_req(spec, 4, label="a"))
+        b = svc.submit(_tiny_req(spec, 4, label="b"))
+        svc.gate.set()
+        ra, rb = a.result(60), b.result(60)
+        assert lead.result(60) is not None
+        stats = svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert stats["failed"] == 0 and stats["completed"] == 3
+    direct = ex.run_experiment_stream(
+        spec, (), 4, wave_size=4, chunk_steps=16, seed=1,
+        summary_path=_clock_path, program_cache=shared_cache,
+    )
+    _assert_results_equal(ra, direct)
+    _assert_results_equal(rb, direct)
+
+
+def test_shutdown_nowait_cancels_inflight_multiwave(tiny, shared_cache):
+    """shutdown(wait=False) must not run a multi-wave request to
+    completion: the wave in flight finishes, the remainder is
+    cancelled, and the dispatcher thread exits promptly."""
+    spec = tiny
+    svc = _Gated(max_wave=4, cache=shared_cache)
+    try:
+        h = svc.submit(_tiny_req(spec, 16, wave=4, label="big"))
+        _wait(lambda: svc.stats()["batches"] == 1)  # wave 1 gated
+        done = threading.Event()
+
+        def stopper():
+            svc.shutdown(wait=False)
+            done.set()
+
+        th = threading.Thread(target=stopper)
+        th.start()
+        time.sleep(0.05)
+        svc.gate.set()  # wave 1 completes; remainder must be cancelled
+        th.join(30)
+        assert done.is_set(), "shutdown(wait=False) hung"
+        with pytest.raises(serve.Cancelled):
+            h.result(5)
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+
+
+def test_shutdown_nowait_cancels_backoff_retry_no_strand(
+    tiny, shared_cache,
+):
+    """A request whose in-flight dispatch fails AFTER shutdown
+    (wait=False) already drained the queue must be cancelled, not
+    requeued into a delay heap the dispatcher will never drain — its
+    future must resolve and shutdown must return."""
+    spec = tiny
+
+    class _GatedPoison(_Gated):
+        def _run_batch(self, slots):
+            assert self.gate.wait(60)
+            raise RuntimeError("transient, post-shutdown")
+
+    svc = _GatedPoison(
+        max_wave=8, cache=shared_cache, max_retries=5,
+        backoff=serve.Backoff(base=5.0),  # would strand without the fix
+    )
+    h = svc.submit(_tiny_req(spec, 4, label="doomed"))
+    _wait(lambda: svc.stats()["batches"] == 1)  # in flight, gated
+    done = threading.Event()
+
+    def stopper():
+        svc.shutdown(wait=False)
+        done.set()
+
+    th = threading.Thread(target=stopper)
+    th.start()
+    time.sleep(0.05)
+    svc.gate.set()  # dispatch now fails, with _stop already set
+    th.join(30)
+    assert done.is_set(), "shutdown(wait=False) hung on a delayed retry"
+    with pytest.raises(serve.Cancelled):
+        h.result(5)
+
+
+def test_idle_service_trace_exports_clean(tiny, shared_cache, tmp_path):
+    """An idle service (no batches yet) still exports a validator-clean
+    trace — monitoring hooks that poll periodically must not crash."""
+    from cimba_tpu.obs import export as oe
+
+    with serve.Service(max_wave=8, cache=shared_cache) as svc:
+        doc = oe.dump_service_trace(str(tmp_path / "idle.json"), svc)
+    assert any(e.get("ph") != "M" for e in doc["traceEvents"])
+
+
+def test_profile_flip_between_submit_and_dispatch_fails_loudly(
+    tiny, shared_cache,
+):
+    """The WHOLE frozen program key is honored at dispatch, not just
+    the metrics flag: a dtype-profile flip while the request is queued
+    fails it loudly instead of silently serving the other profile's
+    program under the frozen key."""
+    from cimba_tpu import config
+
+    spec = tiny
+    svc = _Gated(max_wave=8, cache=shared_cache)
+    try:
+        with config.profile("f32"):
+            h = svc.submit(_tiny_req(spec, 4, label="f32-req"))
+        # profile reverted to f64 before dispatch
+        svc.gate.set()
+        with pytest.raises(ValueError, match="binds at submit"):
+            h.result(60)
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+
+
+# --------------------------------------------------------------------------
+# the bounded program cache
+# --------------------------------------------------------------------------
+
+
+def test_program_cache_lru_bounds_and_counters():
+    c = pc.ProgramCache(capacity=2)
+    c["a"] = 1
+    c["b"] = 2
+    assert c.get_or_create("a", lambda: -1) == 1     # hit; a is now MRU
+    c["c"] = 3                                       # evicts b (LRU)
+    assert "b" not in c and "a" in c and "c" in c
+    assert c.get_or_create("b", lambda: 9) == 9      # miss rebuilds
+    s = c.stats()
+    assert s["capacity"] == 2 and s["size"] == 2
+    assert s["hits"] == 1 and s["misses"] == 1 and s["evictions"] == 2
+    with pytest.raises(ValueError):
+        pc.ProgramCache(capacity=0)
+
+
+def test_program_cache_env_cap(monkeypatch):
+    monkeypatch.setenv(pc.CAP_ENV, "3")
+    c = pc.ProgramCache()
+    assert c.capacity == 3
+    monkeypatch.setenv(pc.CAP_ENV, "0")
+    with pytest.raises(ValueError, match="positive"):
+        pc.ProgramCache()
+
+
+def test_stream_correct_under_cache_eviction_pressure():
+    """A capacity-starved cache only costs recompiles, never
+    correctness: alternating two specs through one 2-entry cache (each
+    call needs ~3 entries) evicts constantly yet every call's totals
+    are reproducible."""
+    s1, s2 = _tiny_spec(6.0), _tiny_spec(9.0)
+    cache = pc.ProgramCache(capacity=2)
+    ref = {}
+    for _ in range(2):
+        for name, spec in (("s1", s1), ("s2", s2)):
+            st = ex.run_experiment_stream(
+                spec, (), 4, wave_size=2, chunk_steps=8, seed=5,
+                summary_path=_clock_path, program_cache=cache,
+            )
+            key = (name, int(st.total_events), float(sm.mean(st.summary)))
+            ref.setdefault(name, key)
+            assert ref[name] == key
+    assert cache.stats()["evictions"] > 0
+
+
+def test_cache_warm_up_precompiles_for_service(tiny):
+    """serve.warm against a shared cache: the service's first request
+    then runs entirely on cache hits (no new program entries)."""
+    spec = tiny
+    cache = pc.ProgramCache()
+    serve.warm(
+        cache, spec, (), 4, chunk_steps=16, seed=1,
+        summary_path=_clock_path,
+    )
+    size_before = cache.stats()["size"]
+    misses_before = cache.stats()["misses"]
+    with serve.Service(max_wave=8, cache=cache) as svc:
+        assert svc.submit(_tiny_req(spec, 4)).result(60) is not None
+    s = cache.stats()
+    assert s["size"] == size_before
+    assert s["misses"] == misses_before
+
+
+# --------------------------------------------------------------------------
+# observability
+# --------------------------------------------------------------------------
+
+
+def test_service_chrome_trace_validates_and_carries_stats(
+    tiny, shared_cache, tmp_path,
+):
+    import json
+
+    from cimba_tpu.obs import export as oe
+
+    spec = tiny
+    with serve.Service(max_wave=8, cache=shared_cache) as svc:
+        svc.submit(_tiny_req(spec, 4, label="traced")).result(60)
+        doc = svc.chrome_trace()
+        # the obs exporter writes the same doc, validated, to disk
+        on_disk = oe.dump_service_trace(
+            str(tmp_path / "serve_trace.json"), svc
+        )
+    oe.validate_chrome_trace(doc)
+    assert json.load(open(tmp_path / "serve_trace.json"))[
+        "otherData"
+    ]["service"]["completed"] == 1
+    assert on_disk["displayTimeUnit"] == "ms"
+    svc_stats = doc["otherData"]["service"]
+    assert svc_stats["completed"] == 1
+    assert svc_stats["time_to_first_wave"]["count"] == 1
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans and spans[0]["name"] == "traced"
+    assert spans[0]["args"]["outcome"] == "completed"
+
+
+# --------------------------------------------------------------------------
+# the many-client soak (the acceptance battery at full size)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_serve_many_client_soak_mixed_mm1_mg1_bitwise():
+    """≥8 threaded clients hammer one service with interleaved mm1 and
+    mg1 requests — compatible groups (shared seed/spec), incompatible
+    strangers (different seeds, different MODELS), multi-wave requests,
+    a mid-queue deadline casualty, and metrics enabled — and every
+    completed result is bitwise the direct single-caller run's."""
+    from cimba_tpu.obs import metrics as om
+
+    mm1_spec, _ = mm1.build(record=False)
+    mg1_spec, _ = mg1.build()
+    mg1_params, cells = mg1.sweep_params(30, reps_per_cell=1)
+    R_mg1 = len(cells)
+    om.enable()
+    try:
+        cache = pc.ProgramCache()
+        cases = []
+        for i in range(6):
+            cases.append(serve.Request(
+                mm1_spec, mm1.params(20 + 5 * (i % 3)), 8, seed=3,
+                wave_size=4, chunk_steps=41, label=f"mm1-a{i}",
+            ))
+            cases.append(serve.Request(
+                mm1_spec, mm1.params(25), 4, seed=9, wave_size=4,
+                chunk_steps=41, label=f"mm1-b{i}",
+            ))
+        cases.append(serve.Request(
+            mg1_spec, mg1_params, R_mg1, seed=9, wave_size=8,
+            chunk_steps=41, label="mg1-sweep",
+        ))
+        doomed = serve.Request(
+            mm1_spec, mm1.params(25), 4, seed=3, wave_size=4,
+            chunk_steps=41, deadline=1e-6, label="doomed",
+        )
+        with serve.Service(max_wave=32, cache=cache) as svc:
+            report = serve.run_load(
+                svc, cases + [doomed], n_clients=8,
+                result_timeout=600,
+            )
+            stats = svc.stats()
+        assert report.n_completed == len(cases)
+        assert report.errors == {"DeadlineExceeded": 1}
+        assert stats["deadline_exceeded"] == 1
+        by_index = dict(report.results)
+        for i, req in enumerate(cases):
+            direct = ex.run_experiment_stream(
+                req.spec, req.params, req.n_replications,
+                wave_size=req.wave_size, chunk_steps=req.chunk_steps,
+                seed=req.seed, program_cache=cache,
+            )
+            _assert_results_equal(by_index[i], direct)
+        assert by_index[len(cases) - 1].metrics is not None
+    finally:
+        om.disable()

@@ -135,6 +135,48 @@ print("stream smoke OK:", int(st.total_events), "events,",
       st.n_waves, "waves")
 EOF
 
+# serve smoke: a tiny service with 3 threaded clients (two compatible,
+# one not) must return per-request pooled results IDENTICAL to direct
+# run_experiment_stream calls, through one shared bounded program cache
+# (docs/13_serving.md)
+run_cell "serve smoke" python - <<'EOF'
+import threading
+import numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+cases = [("a", 60, 8, 1), ("b", 90, 8, 1), ("c", 60, 8, 5)]
+out = {}
+with serve.Service(max_wave=16, cache=cache) as svc:
+    def client(label, n, R, seed):
+        out[label] = svc.submit(serve.Request(
+            spec, mm1.params(n), R, seed=seed, wave_size=8,
+            chunk_steps=64, label=label,
+        )).result(600)
+    ts = [threading.Thread(target=client, args=c) for c in cases]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    stats = svc.stats()
+for label, n, R, seed in cases:
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(n), R, wave_size=8, chunk_steps=64,
+        seed=seed, program_cache=cache,
+    )
+    res = out[label]
+    assert int(res.n_failed) == 0
+    assert int(res.total_events) == int(direct.total_events), label
+    assert float(sm.mean(res.summary)) == float(sm.mean(direct.summary)), label
+    assert float(res.summary.n) == float(direct.summary.n), label
+assert stats["completed"] == 3, stats
+print("serve smoke OK:", {l: round(float(sm.mean(out[l].summary)), 4)
+                          for l, *_ in cases},
+      "cache", cache.stats())
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
