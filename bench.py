@@ -6,9 +6,11 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,mmc,mg1,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_mixed,mmc,mg1,jobshop,awacs}``
 runs one named config (``serve`` is the open-loop serving-layer load,
-docs/13_serving.md);
+docs/13_serving.md; ``serve_mixed`` is the heterogeneous-traffic mix
+measuring wave-packing occupancy and padding waste,
+docs/14_wave_packing.md);
 ``--config all`` runs the whole battery, one JSON line each (BASELINE.json
 configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
 reports a non-null vs_baseline; the others carry the published reference
@@ -1037,6 +1039,146 @@ def bench_serve():
     )
 
 
+def bench_serve_mixed():
+    """Heterogeneous wave packing under a mixed open-loop load
+    (docs/14_wave_packing.md): a weighted mix of ≥3 mm1 request
+    templates differing only in (params, R, seed) plus two more in
+    different finite horizon buckets, driven by
+    ``serve.run_mixed_load``.  The acceptance metric is the
+    batch-occupancy histogram — before compatibility classes this mix
+    degraded to all-solo waves (mean occupancy 1.0); the arm reports
+    ``mean_batch_occupancy`` (target > 1.5), the padding-waste
+    fraction of the pad-and-mask lanes, per-template latency
+    percentiles, and per-template correctness anchors (every completed
+    request's events + pooled mean equal one direct
+    ``run_experiment_stream`` call of its template)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.stats import summary as sm
+
+    accel = _accel()
+    wave = int(
+        os.environ.get(
+            "CIMBA_BENCH_STREAM_WAVE", str(65536 if accel else 1024)
+        )
+    )
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = _stream_chunk_default()
+    req_r = max(
+        int(os.environ.get("CIMBA_BENCH_SERVE_REQ_R", str(wave // 4))),
+        2,
+    )
+    n_requests = int(os.environ.get("CIMBA_BENCH_SERVE_MIXED_REQS", "24"))
+    clients = int(os.environ.get("CIMBA_BENCH_SERVE_CLIENTS", "4"))
+    iat = float(os.environ.get("CIMBA_BENCH_SERVE_IAT", "0"))
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = serve.ProgramCache()
+
+        def templates(n_objects, R):
+            # three templates differing only in (params, R, seed) — one
+            # compatibility class — plus two finite horizons landing in
+            # DIFFERENT buckets (16x apart at the default ratio), so
+            # the load exercises both the pack-anything tier and the
+            # bucket boundary
+            def req(seed, t_end=None, n=n_objects, r=R):
+                return serve.Request(
+                    spec, mm1.params(n), r, seed=seed, t_end=t_end,
+                    wave_size=r, chunk_steps=chunk,
+                )
+
+            return [
+                serve.RequestTemplate("params-a", req(11), 2.0),
+                serve.RequestTemplate(
+                    "params-b", req(22, n=n_objects + 10), 2.0
+                ),
+                serve.RequestTemplate(
+                    "half-r", req(33, r=max(R // 2, 1)), 2.0
+                ),
+                serve.RequestTemplate("short-h", req(44, t_end=30.0)),
+                serve.RequestTemplate("long-h", req(55, t_end=500.0)),
+            ]
+
+        # warm OUTSIDE the timed service: the class's common shapes
+        serve.warm(
+            cache, spec, mm1.params(1), req_r, chunk_steps=chunk,
+            seed=11, on_wave=_heartbeat, on_chunk=_heartbeat,
+        )
+        with serve.Service(
+            max_wave=wave, cache=cache, on_chunk=_heartbeat,
+        ) as warm_svc:
+            serve.run_mixed_load(
+                warm_svc, templates(1, req_r), min(10, n_requests),
+                n_clients=clients,
+            )
+        _heartbeat()
+        svc = serve.Service(
+            max_wave=wave, cache=cache, on_chunk=_heartbeat,
+        )
+        report = serve.run_mixed_load(
+            svc, templates(N, req_r), n_requests, n_clients=clients,
+            inter_arrival_s=iat,
+        )
+        stats = svc.stats()
+        svc.shutdown()
+        # per-template correctness anchors: every completed request of
+        # a template equals ONE direct call of that template
+        tmpl_by_name = {
+            t.name: t.request for t in templates(N, req_r)
+        }
+        direct = {}
+        for name, req in tmpl_by_name.items():
+            direct[name] = ex.run_experiment_stream(
+                req.spec, req.params, req.n_replications,
+                wave_size=req.wave_size, chunk_steps=req.chunk_steps,
+                seed=req.seed, t_end=req.t_end, program_cache=cache,
+                on_wave=_heartbeat, on_chunk=_heartbeat,
+            )
+    assert report.n_completed == n_requests, report.errors
+    total_ev = 0
+    for i, res in report.results:
+        d = direct[report.template_names[i]]
+        assert int(res.total_events) == int(d.total_events)
+        assert float(sm.mean(res.summary)) == float(sm.mean(d.summary))
+        total_ev += int(res.total_events)
+    occ = stats["batch_occupancy"]
+    n_batches = sum(occ.values())
+    mean_occ = (
+        sum(k * v for k, v in occ.items()) / n_batches if n_batches
+        else 0.0
+    )
+    rate = total_ev / report.wall_s
+    _line(
+        "serve_mixed_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "path": "serve_heterogeneous_waves",
+            "profile": prof,
+            "requests": n_requests,
+            "clients": clients,
+            "inter_arrival_s": iat,
+            "objects_per_replication": N,
+            "replications_per_request": req_r,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "wall_s": report.wall_s,
+            "total_events": total_ev,
+            "latency": report.latency_percentiles(),
+            "latency_per_template": report.per_template(),
+            "batch_occupancy": occ,
+            "mean_batch_occupancy": mean_occ,
+            "lane_occupancy": stats["lane_occupancy"],
+            "classes_seen": stats["classes_seen"],
+            "queue_depth_hwm": stats["queue_depth_hwm"],
+            "program_cache": stats.get("program_cache"),
+        },
+    )
+
+
 def bench_mm1_single():
     """BASELINE configs[0] twin: ``benchmark/MM1_single.c`` — ONE
     replication, the single-stream latency number (reference: ~32M
@@ -1379,6 +1521,7 @@ CONFIGS = {
     "mm1_stream": bench_mm1_stream,
     "mm1_single": bench_mm1_single,
     "serve": bench_serve,
+    "serve_mixed": bench_serve_mixed,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
     "jobshop": bench_jobshop,

@@ -140,6 +140,16 @@ class Sim(NamedTuple):
     trace: Any = None
     #: metrics registry (obs.metrics.Metrics) or None, same contract
     metrics: Any = None
+    #: per-lane horizon (TIME scalar) or None — None prunes the leaf so
+    #: the historical pytree (and static-``t_end`` programs) are
+    #: untouched.  When carried, :func:`make_cond` reads it INSTEAD of
+    #: its static ``t_end``: the lane stops dispatching once its next
+    #: event would pass ``t_stop``, exactly as a program compiled with
+    #: that static horizon would.  This is what lets heterogeneous
+    #: horizons share ONE compiled chunk program (a short lane goes
+    #: dead early; ``-inf`` makes a lane dead-on-arrival — the wave
+    #: padding mask, docs/14_wave_packing.md)
+    t_stop: Any = None
 
 
 def _tree_select(pred, a, b):
@@ -159,9 +169,17 @@ def _batched(tree, n):
     )
 
 
-def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
+def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0,
+             t_stop=None) -> Sim:
     """Build one replication's initial state and schedule process starts
-    (parity: the trial-init sequence `benchmark/MM1_multi.c:91-124`)."""
+    (parity: the trial-init sequence `benchmark/MM1_multi.c:91-124`).
+
+    ``seed`` may be a python int OR a traced u64 scalar: the stream key
+    is ``fmix64(seed + c*replication)`` — pure integer arithmetic, so a
+    per-lane seed column produces bit-identical streams to the
+    historical static-seed trace (the Tier-A packing contract,
+    docs/14_wave_packing.md).  ``t_stop`` (optional, TIME scalar) gives
+    the lane a per-lane horizon — see :class:`Sim`."""
     nq = max(len(spec.queues), 1)
     nr = max(len(spec.resources), 1)
     np_ = max(len(spec.pools), 1)
@@ -278,6 +296,7 @@ def init_sim(spec: ModelSpec, seed, replication, params=None, t0=0.0) -> Sim:
         )
         if obs_metrics.enabled()
         else None,
+        t_stop=None if t_stop is None else jnp.asarray(t_stop, _T),
     )
 
 
@@ -2225,11 +2244,18 @@ def make_cond(spec: ModelSpec, t_end: Optional[float] = None):
             # the chunk; the chunk driver steps it host-side (the XLA
             # path traces with KERNEL_MODE off and never sees this)
             live = live & ~sim.boundary_pending
-        if t_end is not None:
+        # horizon: a Sim carrying a per-lane ``t_stop`` leaf (the
+        # heterogeneous-wave path) reads it INSTEAD of the static
+        # ``t_end`` — ``t_stop = t_end`` reproduces the static check's
+        # decisions bit-for-bit (same compare on the same values), and
+        # ``t_stop = +inf`` reproduces ``t_end=None`` (the conjunct is
+        # identically true); ``-inf`` is the dead-on-arrival pad lane
+        lim = sim.t_stop if sim.t_stop is not None else t_end
+        if lim is not None:
             nxt = jnp.minimum(
                 ev.min_time(sim.events), jnp.min(sim.wakes.time)
             )
-            live = live & ((nxt <= t_end) | (empty & ~out_of_work))
+            live = live & ((nxt <= lim) | (empty & ~out_of_work))
         return live
 
     return cond

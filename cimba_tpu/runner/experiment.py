@@ -319,17 +319,52 @@ def _chunk_program(
     return jax.jit(chunk, donate_argnums=(0,) if donate else ())
 
 
-def _init_program(spec: ModelSpec, seed, mesh: Optional[Mesh]):
-    """``init(reps, params) -> batched Sim`` (sharded over the mesh when
-    one is given, so the chunk program never reshards)."""
-    def init(reps, p):
-        return jax.vmap(lambda r, q: init_sim(spec, seed, r, q))(reps, p)
+def _seed_column(seed, n: int):
+    """A per-lane ``[n]`` u64 seed column (one request seed broadcast).
+
+    Seed is per-lane DATA on the chunked/streamed/served paths, not a
+    constant baked into the compiled init program: ``init_sim`` derives
+    each lane's stream as ``fmix64(seed + c*rep)`` — pure integer
+    arithmetic, bit-identical whether ``seed`` arrives traced or
+    static — so requests differing only in seed share one compiled
+    program (docs/14_wave_packing.md)."""
+    return jnp.full((n,), jnp.asarray(seed, jnp.uint64))
+
+
+def _horizon_column(t_end, n: int):
+    """A per-lane ``[n]`` horizon column: ``t_end`` broadcast, with
+    ``None`` (run to completion) encoded as ``+inf`` — the lane-data
+    image of the static ``t_end`` knob (see ``Sim.t_stop``)."""
+    from cimba_tpu import config as _config
+
+    return jnp.full(
+        (n,), jnp.inf if t_end is None else t_end, _config.TIME
+    )
+
+
+def _init_program(spec: ModelSpec, mesh: Optional[Mesh]):
+    """``init(reps, seeds, t_stops, params) -> batched Sim`` (sharded
+    over the mesh when one is given, so the chunk program never
+    reshards).
+
+    ``seeds`` is the per-lane u64 seed column (:func:`_seed_column`)
+    and ``t_stops`` the per-lane horizon column
+    (:func:`_horizon_column`) — both lane DATA, so one compiled init
+    program serves every (seed, horizon) mix; ``t_stops=None`` omits
+    the horizon leaf entirely (the Sim then matches the historical
+    pytree — static-``t_end`` programs and old checkpoints)."""
+    def init(reps, seeds, t_stops, p):
+        return jax.vmap(
+            lambda r, s, t, q: init_sim(spec, s, r, q, t_stop=t)
+        )(reps, seeds, t_stops, p)
 
     if mesh is not None:
         init = partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(REP_AXIS), P(REP_AXIS)),
+            in_specs=(
+                P(REP_AXIS), P(REP_AXIS), P(REP_AXIS), P(REP_AXIS),
+            ),
             out_specs=P(REP_AXIS),
             check_vma=False,
         )(init)
@@ -383,7 +418,11 @@ def run_experiment_chunked(
             f"n_replications={n_replications} must divide evenly over "
             f"{mesh.devices.size} devices"
         )
-    init_j = _init_program(spec, seed, mesh)
+    init_j = _init_program(spec, mesh)
+    # static horizon, no per-lane t_stop leaf (t_stops=None): the
+    # checkpointed pytree stays the historical one, and the chunk
+    # program below keeps its static t_end cond
+    seeds = _seed_column(seed, n_replications)
 
     n0 = 0
     sims = None
@@ -404,11 +443,12 @@ def run_experiment_chunked(
             # transiently hold TWO full batched Sims on exactly the
             # memory-bound runs checkpointing targets
             sims, n0 = _ckpt.restore_resumable(
-                checkpoint_path, jax.eval_shape(init_j, reps, pb),
+                checkpoint_path,
+                jax.eval_shape(init_j, reps, seeds, None, pb),
                 tag=ckpt_tag,
             )
     if sims is None:
-        sims = init_j(reps, pb)
+        sims = init_j(reps, seeds, None, pb)
 
     on_state = None
     if checkpoint_path and checkpoint_every:
@@ -479,14 +519,19 @@ def run_experiment_stream(
     ``program_cache``: pass the SAME mapping to repeated calls to reuse
     the compiled init/chunk/fold programs across calls (bench.py's
     warm-then-time protocol; a service shares one cache across every
-    request).  Every setting a program bakes in — ``spec`` identity,
-    ``seed``, the dtype profile, the ``obs.metrics`` and ``obs.trace``
-    states, the event-set layout flags, the resolved ``pack`` arm,
-    ``t_end``, ``chunk_steps``, ``mesh``, and ``summary_path`` identity
-    — is part of the cache key, so a mismatched call recompiles rather
-    than replaying stale programs (reuse requires passing the SAME spec
-    object); jitted programs additionally re-specialize per wave shape
-    internally, so full waves always share one compile.  The default is
+    request).  Every setting a program bakes in — the spec's structural
+    fingerprint, the dtype profile, the ``obs.metrics`` and
+    ``obs.trace`` states, the event-set layout flags, the resolved
+    ``pack`` arm, ``chunk_steps``, ``mesh``, and ``summary_path``
+    identity — is part of the cache key, so a mismatched call
+    recompiles rather than replaying stale programs.  ``seed`` and
+    ``t_end`` are NOT program constants on this path: they ride as
+    per-lane data columns (bit-identical trajectories), so calls
+    differing only in them — and structurally identical spec twins from
+    ``dataclasses.replace`` — share compiled programs
+    (docs/14_wave_packing.md); jitted programs additionally
+    re-specialize per wave shape internally, so full waves always
+    share one compile.  The default is
     a fresh :class:`cimba_tpu.serve.cache.ProgramCache` — a bounded LRU
     with hit/miss/eviction counters (``CIMBA_PROGRAM_CACHE_CAP``);
     plain dicts keep working for legacy callers but never evict.
@@ -526,14 +571,17 @@ def run_experiment_stream(
     fold_j = _pcache.get_fold(programs, with_metrics, summary_path)
 
     def get_programs(spec):
-        # one (init, chunk) program pair per (spec object, settings)
-        # point; jit re-specializes per wave shape internally (full
-        # waves share one compile).  Regrow's dataclasses.replace
-        # yields a new object, so grown capacities get their own
-        # programs as before.
+        # one (init, chunk) program pair per (spec STRUCTURE, settings)
+        # point — seed and t_end are per-lane columns now, NOT program
+        # constants, so calls differing only in them share compiled
+        # programs (the Tier-A packing contract, docs/14_wave_packing);
+        # jit re-specializes per wave shape internally (full waves
+        # share one compile).  Regrow's dataclasses.replace doubles
+        # event_cap, which changes the structural fingerprint, so
+        # grown capacities get their own programs as before.
         return _pcache.get_programs(
-            programs, spec, seed=seed, mesh=mesh, t_end=t_end,
-            pack=pack, chunk_steps=chunk_steps, with_metrics=with_metrics,
+            programs, spec, mesh=mesh, pack=pack,
+            chunk_steps=chunk_steps, with_metrics=with_metrics,
         )
 
     init_probe, _ = get_programs(spec)
@@ -550,9 +598,16 @@ def run_experiment_stream(
         n = min(wave_size, R - lo)
         reps = jnp.arange(lo, lo + n)
         pw = _slice_params(params, R, lo, n)
+        seeds = _seed_column(seed, n)
+        # no horizon -> NO t_stop leaf: the chunk cond then skips the
+        # per-event next-event-min + compare entirely (the historical
+        # t_end=None jaxpr — per-event cost matters on the headline
+        # path).  jit re-specializes per pytree structure under the
+        # same program key, so both variants share the cache entry.
+        t_stops = None if t_end is None else _horizon_column(t_end, n)
         while True:
             init_j, chunk_j = get_programs(spec)
-            sims = init_j(reps, pw)
+            sims = init_j(reps, seeds, t_stops, pw)
             sims = drive_chunks(
                 chunk_j, sims, poll_every=poll_every, on_chunk=on_chunk
             )

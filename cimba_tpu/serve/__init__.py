@@ -2,9 +2,13 @@
 
 Many concurrent experiment requests multiplexed onto the wave-streamed
 runner's already-warm compiled programs: a single device-owner
-dispatcher thread packs *compatible* requests (same program-cache key)
-into shared waves and slices pooled results back per request, behind
-admission control, deadlines, cancellation, and retry-with-backoff.
+dispatcher thread packs requests of the same *compatibility class*
+(docs/14_wave_packing.md — requests differing only in params, R, seed,
+priority, horizon-within-bucket, chunk budget, or summary path still
+pack, each lane carrying its own seed/horizon column) into shared
+pad-and-masked waves and slices pooled results back per request,
+behind admission control, deadlines, cancellation, and
+retry-with-backoff.
 
     from cimba_tpu import serve
     with serve.Service(max_wave=1024) as svc:
@@ -18,7 +22,14 @@ cache), :mod:`~cimba_tpu.serve.sched` (queue/deadline/retry policy),
 """
 
 from cimba_tpu.serve.cache import ProgramCache, warm
-from cimba_tpu.serve.client import LoadReport, percentile, run_load
+from cimba_tpu.serve.client import (
+    LoadReport,
+    RequestTemplate,
+    mixed_requests,
+    percentile,
+    run_load,
+    run_mixed_load,
+)
 from cimba_tpu.serve.sched import (
     AdmissionQueue,
     Backoff,
@@ -33,7 +44,8 @@ from cimba_tpu.serve.service import Request, ResultHandle, Service
 
 __all__ = [
     "ProgramCache", "warm",
-    "LoadReport", "percentile", "run_load",
+    "LoadReport", "RequestTemplate", "percentile",
+    "run_load", "run_mixed_load", "mixed_requests",
     "AdmissionQueue", "Backoff",
     "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
     "DeadlineExceeded", "RetriesExhausted",

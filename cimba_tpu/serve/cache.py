@@ -11,17 +11,22 @@ thread-safe LRU** (:class:`ProgramCache`) plus the key builders both
 
 * the stream runner's default cache is bounded (env
   ``CIMBA_PROGRAM_CACHE_CAP``, default 64 entries — generous: one entry
-  per distinct (spec, seed, profile, horizon, arm, mesh) point, not per
-  wave shape; jit re-specializes per shape internally);
-* the serving layer's *compatibility key* — which requests may share a
-  wave — is definitionally the same key that selects a compiled
-  program, so "compatible" can never drift from "same program";
+  per distinct (spec structure, profile, arm, mesh, chunk) point, not
+  per wave shape; jit re-specializes per shape internally);
+* the serving layer's *compatibility class* — which requests may share
+  a wave — is definitionally a prefix of the key that selects a
+  compiled program (:func:`program_class_key` vs :func:`program_key`),
+  so "compatible" can never drift from "same program".  Seed, horizon,
+  params values, and R are per-lane DATA columns, not program
+  constants, so they appear in NEITHER key — the heterogeneous-wave
+  contract of docs/14_wave_packing.md;
 * hit/miss/eviction counters make cache health observable
   (:meth:`ProgramCache.stats`, surfaced by ``Service.stats()`` and the
   bench serve arm).
 
-Entry-pinning invariant: every key that embeds ``id(spec)`` stores the
-spec object (or a tuple containing it) as part of its value, so a
+Entry-pinning invariant: every key that embeds object identities (the
+structural fingerprint's block/handler/predicate function ids) stores
+the spec object (or a tuple containing it) as part of its value, so a
 cached id can never be recycled by the allocator while its entry lives.
 Eviction drops the entry *and* its pin together — a later call with a
 recycled id cannot hit a stale entry, because the stale entry is gone.
@@ -163,50 +168,122 @@ def _get_or_create(programs: MutableMapping, key, factory):
 # -- key builders (the stream runner's cache contract, factored out) --------
 
 
-def run_settings_key(t_end, pack, chunk_steps, mesh) -> tuple:
-    """Every run-level setting a compiled chunk program bakes in beyond
-    spec identity, with the trace-time globals (pack auto-resolution,
-    flight-recorder flag, eventset hierarchy/layout) resolved NOW so a
-    flag flip between calls misses the cache rather than replaying the
-    stale arm."""
+def spec_fingerprint(spec) -> tuple:
+    """STRUCTURAL identity of a ModelSpec for program keys.
+
+    Function-valued structure — blocks, user handlers, ``user_init``,
+    condition predicates — keys by object identity (``id``): what the
+    tracer closes over IS the function object, so two specs sharing the
+    same function objects and the same static data trace the same
+    program.  That is exactly the ``dataclasses.replace`` twin shape
+    (sweep drivers rebuilding a spec with an unchanged field set —
+    ``replace`` copies the function references), which under the old
+    ``id(spec)`` key could never share a cache slot.  A model re-built
+    from source gets fresh function objects and merely recompiles —
+    safe.  The id-recycling hazard is unchanged: every cache entry
+    keyed by a fingerprint still pins a spec carrying those function
+    objects, so the ids cannot be recycled while the entry lives.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    cached = getattr(spec, "_cimba_fingerprint", None)
+    if cached is not None:
+        return cached
+
+    def ref_key(r):
+        # component refs are flat dataclasses of scalars/strings plus
+        # the occasional callable (condition predicates) or tuple
+        out = []
+        for f in dataclasses.fields(r):
+            v = getattr(r, f.name)
+            if callable(v):
+                out.append(("fn", id(v)))
+            elif isinstance(v, (list, tuple)):
+                out.append(tuple(v))
+            else:
+                out.append(v)
+        return tuple(out)
+
+    fp = (
+        spec.name,
+        tuple(id(b) for b in spec.blocks),
+        np.asarray(spec.proc_entry).tobytes(),
+        np.asarray(spec.proc_prio).tobytes(),
+        np.asarray(spec.proc_start).tobytes(),
+        tuple(spec.proc_names),
+        tuple(ref_key(q) for q in spec.queues),
+        tuple(ref_key(r) for r in spec.resources),
+        tuple(ref_key(p) for p in spec.pools),
+        tuple(ref_key(b) for b in spec.buffers),
+        tuple(ref_key(q) for q in spec.pqueues),
+        tuple(ref_key(c) for c in spec.conditions),
+        spec.n_guards, spec.guard_cap, spec.event_cap,
+        spec.queue_cap_max, spec.pqueue_cap_max,
+        spec.n_flocals, spec.n_ilocals, spec.max_chain,
+        None if spec.user_init is None else id(spec.user_init),
+        tuple(id(h) for h in spec.user_handlers),
+        tuple(spec.boundary_pcs),
+    )
+    try:
+        object.__setattr__(spec, "_cimba_fingerprint", fp)
+    except (AttributeError, TypeError):
+        pass  # slotted/frozen spec: recompute per call (cheap)
+    return fp
+
+
+def program_class_key(spec, with_metrics: bool, *, mesh, pack) -> tuple:
+    """The Tier-A **compatibility class**: everything a compiled chunk
+    program bakes in EXCEPT ``chunk_steps`` — the spec's structural
+    fingerprint, the dtype profile, the ``obs.metrics``/``obs.trace``
+    flags, the event-set layout, the resolved ``pack`` arm, and the
+    mesh — with the trace-time globals resolved NOW so a flag flip
+    between calls misses the cache rather than replaying the stale arm.
+
+    Seed, horizon (``t_end``), params values, R, and priority are all
+    per-lane DATA on this path (``runner.experiment._init_program``'s
+    seed/horizon columns), so they join neither this class nor the
+    program key: requests differing only in them share one wave of one
+    compiled program.  ``chunk_steps`` is excluded because chunking is
+    trajectory-invariant (chunked == monolithic bitwise, docs/12): two
+    requests with different chunk budgets may share a wave — the wave
+    simply runs at its lead's chunk size — but each distinct
+    ``chunk_steps`` actually dispatched still compiles its own program
+    (:func:`program_key` appends it)."""
     from cimba_tpu import config as _config
     from cimba_tpu.obs import trace as _trace
 
     return (
-        t_end,
+        spec_fingerprint(spec),
+        _config.active_profile(),
+        bool(with_metrics),
         pack if pack is not None else _config.xla_pack_enabled(),
-        chunk_steps,
-        mesh,
         _trace.enabled(),
         _config.eventset_hier_enabled(),
         _config.eventset_block(),
+        mesh,
     )
 
 
-def program_key(spec, seed, with_metrics: bool, settings: tuple) -> tuple:
-    """The full key of one compiled ``(init, chunk)`` program pair: the
-    spec's blocks/handlers/caps, the seed (``init_sim`` closes over it),
-    the dtype profile (trace-time global), and the ``obs.metrics`` flag
-    are all baked into the traced programs, so all join the run
-    settings — any one of them silently replaying stale would return a
-    DIFFERENT model's trajectories with no error.  Spec identity is by
-    object (the cache entry pins the spec, so the id cannot be recycled
-    while cached); a semantically-equal rebuilt spec merely recompiles,
-    which is safe."""
-    from cimba_tpu import config as _config
-
-    return (
-        id(spec), seed, _config.active_profile(), with_metrics,
-    ) + settings
+def program_key(
+    spec, with_metrics: bool, *, mesh, pack, chunk_steps: int,
+) -> tuple:
+    """The full key of one compiled ``(init, chunk)`` program pair:
+    the compatibility class plus the chunk budget the program bakes in.
+    Any component silently replaying stale would return a DIFFERENT
+    model's trajectories with no error — which is why the trace-time
+    globals resolve into the class at key-build time."""
+    return program_class_key(
+        spec, with_metrics, mesh=mesh, pack=pack,
+    ) + (chunk_steps,)
 
 
 def get_programs(
     programs: MutableMapping,
     spec,
     *,
-    seed: int,
     mesh,
-    t_end,
     pack,
     chunk_steps: int,
     with_metrics: bool,
@@ -214,19 +291,20 @@ def get_programs(
     """The stream runner's ``get_programs``, shared with the service:
     one compiled ``(init, chunk)`` pair per :func:`program_key` point
     (jit re-specializes per wave shape internally, so full waves share
-    one compile).  Returns ``(init_j, chunk_j)``."""
+    one compile).  The chunk program is built with ``t_end=None``: the
+    horizon is the per-lane ``t_stop`` column the init program plants
+    (see ``Sim.t_stop``).  Returns ``(init_j, chunk_j)``."""
     key = program_key(
-        spec, seed, with_metrics,
-        run_settings_key(t_end, pack, chunk_steps, mesh),
+        spec, with_metrics, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
     )
 
     def build():
         from cimba_tpu.runner import experiment as ex
 
         return (
-            ex._init_program(spec, seed, mesh),
-            ex._chunk_program(spec, t_end, pack, chunk_steps, mesh),
-            spec,  # pins id(spec) for the entry's lifetime
+            ex._init_program(spec, mesh),
+            ex._chunk_program(spec, None, pack, chunk_steps, mesh),
+            spec,  # pins the fingerprint's function ids while cached
         )
 
     return _get_or_create(programs, key, build)[:2]
@@ -315,9 +393,12 @@ def preflight_summary_path(
     compute-style paths work) so a path that doesn't exist on this
     model's Sim fails here with the knob named, not as an opaque
     KeyError from inside the fold after a full wave of compute.  Cached
+    by the spec's structural fingerprint (twin specs share the check)
     so a warmed cache skips the re-trace inside bench's timed region
-    (the entry pins spec, keeping its id valid)."""
-    key = ("preflight", id(spec), summary_path, with_metrics)
+    (the entry pins spec, keeping the fingerprint's ids valid)."""
+    key = (
+        "preflight", spec_fingerprint(spec), summary_path, with_metrics,
+    )
     if key in programs:
         return
 
@@ -329,8 +410,10 @@ def preflight_summary_path(
 
         try:
             jax.eval_shape(
-                lambda r, p: summary_path(init_j(r, p)),
+                lambda r, s, t, p: summary_path(init_j(r, s, t, p)),
                 jnp.arange(n_first),
+                ex._seed_column(0, n_first),
+                ex._horizon_column(None, n_first),
                 ex._slice_params(params, n_total, 0, n_first),
             )
         except Exception as e:
@@ -339,7 +422,7 @@ def preflight_summary_path(
                 f"model's Sim structure ({e!r}) — pass summary_path= "
                 "pointing at a statistic this model records"
             ) from e
-        return spec  # pins id(spec) for the entry's lifetime
+        return spec  # pins the fingerprint's function ids while cached
 
     _get_or_create(programs, key, check)
 
@@ -353,9 +436,10 @@ def warm(
 ):
     """Optional warm-up precompile: run ONE full wave through the
     stream runner against ``cache``, so a service built over the same
-    cache (and the same spec object / settings) serves its first real
-    request from already-compiled programs.  Returns the warm-up wave's
-    ``StreamResult`` (callers usually discard it)."""
+    cache (and a structurally-identical spec / settings — seed and
+    horizon don't matter, they are per-lane data) serves its first
+    real request from already-compiled programs.  Returns the warm-up
+    wave's ``StreamResult`` (callers usually discard it)."""
     from cimba_tpu.runner import experiment as ex
 
     return ex.run_experiment_stream(

@@ -40,6 +40,11 @@ class LoadReport:
     latencies_s: List[float] = field(default_factory=list)
     errors: dict = field(default_factory=dict)
     results: list = field(default_factory=list)
+    #: submit→result latency keyed by request index (completed only) —
+    #: what lets a mixed-template run attribute latency per template
+    latency_by_index: dict = field(default_factory=dict)
+    #: request index -> template name, set by :func:`run_mixed_load`
+    template_names: Optional[List[str]] = None
 
     @property
     def replications_per_sec(self) -> float:
@@ -63,6 +68,40 @@ class LoadReport:
             "errors": dict(self.errors),
         }
         out.update(self.latency_percentiles())
+        return out
+
+    def per_template(self) -> dict:
+        """Latency percentiles grouped by template name (requires the
+        run to have come through :func:`run_mixed_load`, which records
+        ``template_names``): ``{name: {count, completed, p50_s, p95_s,
+        p99_s, max_s}}`` — the per-template tail is where a packing
+        policy's fairness shows (a starved template's p99 diverges
+        while the aggregate looks fine)."""
+        if self.template_names is None:
+            raise ValueError(
+                "per_template() needs template_names — drive the load "
+                "with run_mixed_load(), not run_load()"
+            )
+        groups: dict = {}
+        for i, name in enumerate(self.template_names):
+            g = groups.setdefault(
+                name, {"count": 0, "completed": 0, "lat": []}
+            )
+            g["count"] += 1
+            if i in self.latency_by_index:
+                g["completed"] += 1
+                g["lat"].append(self.latency_by_index[i])
+        out = {}
+        for name, g in groups.items():
+            lat = g["lat"]
+            out[name] = {
+                "count": g["count"],
+                "completed": g["completed"],
+                "p50_s": percentile(lat, 50),
+                "p95_s": percentile(lat, 95),
+                "p99_s": percentile(lat, 99),
+                "max_s": max(lat) if lat else float("nan"),
+            }
         return out
 
 
@@ -130,6 +169,7 @@ def run_load(
         t.join()
 
     latencies: List[float] = []
+    latency_by_index: dict = {}
     results: list = []
     n_completed = 0
     total_reps = 0
@@ -142,7 +182,9 @@ def run_load(
         except Exception as e:
             errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
             continue
-        latencies.append(time.perf_counter() - sub_t)
+        lat = time.perf_counter() - sub_t
+        latencies.append(lat)
+        latency_by_index[i] = lat
         n_completed += 1
         total_reps += int(requests[i].n_replications)
         if on_result is not None:
@@ -157,4 +199,77 @@ def run_load(
         latencies_s=latencies,
         errors=errors,
         results=results,
+        latency_by_index=latency_by_index,
     )
+
+
+# -- mixed-template traffic (the heterogeneous-packing load shape) -----------
+
+
+@dataclass(frozen=True)
+class RequestTemplate:
+    """One request archetype in a traffic mix: a prototype ``Request``
+    (spec variant x params x R x seed x horizon — whatever the
+    workload's shape is) plus its relative ``weight`` in the arrival
+    stream.  :func:`mixed_requests` interleaves templates
+    proportionally; each instance is a ``dataclasses.replace`` clone
+    labelled ``{name}#{i}``."""
+
+    name: str
+    request: Any
+    weight: float = 1.0
+
+
+def mixed_requests(
+    templates: Sequence[RequestTemplate], n_requests: int,
+) -> tuple:
+    """A deterministic weighted interleaving of ``n_requests`` request
+    instances over ``templates`` (smooth weighted round-robin: each
+    step picks the template with the largest accumulated credit, so a
+    1:1:2 mix arrives interleaved — the shape that exercises wave
+    packing — rather than in runs).  Returns ``(requests, names)``
+    aligned by index."""
+    import dataclasses
+
+    if not templates:
+        raise ValueError("mixed_requests needs at least one template")
+    for t in templates:
+        if not t.weight > 0:
+            raise ValueError(
+                f"template {t.name!r} weight must be positive, got "
+                f"{t.weight}"
+            )
+    credit = [0.0] * len(templates)
+    counts = [0] * len(templates)
+    requests, names = [], []
+    for _ in range(int(n_requests)):
+        for j, t in enumerate(templates):
+            credit[j] += t.weight
+        j = max(range(len(templates)), key=lambda k: credit[k])
+        credit[j] -= sum(t.weight for t in templates)
+        t = templates[j]
+        requests.append(dataclasses.replace(
+            t.request, label=f"{t.name}#{counts[j]}"
+        ))
+        names.append(t.name)
+        counts[j] += 1
+    return requests, names
+
+
+def run_mixed_load(
+    service,
+    templates: Sequence[RequestTemplate],
+    n_requests: int,
+    **run_load_kwargs,
+) -> LoadReport:
+    """Drive ``service`` with a weighted MIX of request templates (the
+    heterogeneous-traffic load shape of docs/14_wave_packing.md) and
+    report per-template latency percentiles on top of the aggregate:
+    the returned report's :meth:`LoadReport.per_template` groups
+    completions by template name.  Occupancy/padding live in
+    ``service.stats()`` (``batch_occupancy``, ``lane_occupancy``) —
+    the bench ``serve_mixed`` arm reads both."""
+    requests, names = mixed_requests(templates, n_requests)
+    report = run_load(service, requests, **run_load_kwargs)
+    report.template_names = names
+    return report

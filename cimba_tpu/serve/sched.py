@@ -122,10 +122,12 @@ class _Delayed:
 class AdmissionQueue:
     """Bounded priority queue + delayed-retry heap under one lock.
 
-    Entries are opaque to the queue except for three attributes the
+    Entries are opaque to the queue except for the attributes the
     service sets: ``priority`` (higher pops first), ``seq`` (FIFO
-    tiebreak), and the queue never inspects anything else — the pack
-    policy lives in the service's :meth:`take` predicate.
+    tiebreak), and ``cls`` (the compatibility class, read only by the
+    :meth:`class_depths` introspection) — the queue never inspects
+    anything else; the pack policy lives in the service's :meth:`take`
+    predicate.
     """
 
     def __init__(self, capacity: int):
@@ -148,6 +150,24 @@ class AdmissionQueue:
 
     def depth(self) -> int:
         return len(self)
+
+    def class_depths(self) -> dict:
+        """Queued entries (ready + backoff-delayed) per compatibility
+        class — the ``cls`` attribute the service stamps on entries.
+        Feeds ``Service.stats()['queue_depth_by_class']`` and the
+        per-class Chrome-trace counter tracks, so a class starving
+        behind another's traffic is visible.  Entries without a ``cls``
+        (the queue stays generic) group under ``None``.  O(depth) scan
+        under the lock: the queue is bounded by ``capacity``."""
+        with self._lock:
+            out: dict = {}
+            for _, e in self._heap:
+                c = getattr(e, "cls", None)
+                out[c] = out.get(c, 0) + 1
+            for d in self._delayed:
+                c = getattr(d.entry, "cls", None)
+                out[c] = out.get(c, 0) + 1
+            return out
 
     # -- admission -----------------------------------------------------------
 

@@ -10,14 +10,22 @@ already-warm programs:
   worker discipline, transposed: the DEVICE is the scarce resource, so
   exactly one thread builds batches and dispatches programs; client
   threads only enqueue and wait on futures).
-* **Compatibility packing**: queued requests whose program-cache key
-  matches — spec identity, seed, dtype profile, metrics/trace/eventset
-  flags, resolved pack arm, horizon, chunk size, mesh, `summary_path`
-  identity, and the params tree signature — are packed into ONE wave
-  of the shared compiled chunk program, and the pooled results are
-  sliced back per request.  "Compatible" is definitionally "same
-  compiled program" (`serve.cache.program_key`), so packing can never
-  mix trajectories that belong to different programs.
+* **Compatibility-class packing** (docs/14_wave_packing.md): queued
+  requests of the same *compatibility class* — the spec's structural
+  fingerprint, dtype profile, metrics/trace/eventset flags, resolved
+  pack arm, mesh (`serve.cache.program_class_key`), the params tree
+  signature, and the horizon bucket — are packed into ONE wave of the
+  shared compiled chunk program, and the pooled results are sliced
+  back per request.  Seed, parameter VALUES, R, priority, horizon
+  value, and chunk budget are per-lane data (or trajectory-invariant),
+  so requests differing only in them pack unconditionally: each lane
+  carries its own seed and `t_stop` column, a short-horizon lane goes
+  dead early inside a longer wave (exact truncation via the chunked
+  driver's `any_live` early-exit), and partially-filled waves are
+  padded to a quantized shape with dead masked lanes (`t_stop=-inf`)
+  that are bitwise-inert for the live lanes.  The class is
+  definitionally a prefix of the compiled-program key, so packing can
+  never mix trajectories that belong to different programs.
 * **Bitwise request isolation**: lanes are independent under `vmap`
   (the masking/donation contract of docs/12), so a request packed with
   strangers produces results bitwise equal to the direct
@@ -105,18 +113,18 @@ class _Entry:
     """Dispatcher-internal per-request state (the queue stores these)."""
 
     __slots__ = (
-        "request", "seq", "priority", "label", "compat", "eff_wave",
+        "request", "seq", "priority", "label", "cls", "eff_wave",
         "with_metrics", "next_lo", "acc", "n_waves", "retries", "solo",
         "cancelled", "in_flight", "submit_t", "first_dispatch_t",
         "deadline_at", "done", "result", "exc",
     )
 
-    def __init__(self, request, seq, compat, eff_wave, with_metrics):
+    def __init__(self, request, seq, cls, eff_wave, with_metrics):
         self.request = request
         self.seq = seq
         self.priority = request.priority
         self.label = request.label
-        self.compat = compat
+        self.cls = cls
         self.eff_wave = eff_wave
         self.with_metrics = with_metrics
         self.next_lo = 0
@@ -187,7 +195,25 @@ class Service:
     with direct `run_experiment_stream` calls or across services);
     ``max_retries``/``backoff`` govern dispatch-failure retries;
     ``on_chunk`` is a per-chunk progress hook (bench.py's watchdog
-    heartbeat).  Use as a context manager for a graceful shutdown."""
+    heartbeat).  Use as a context manager for a graceful shutdown.
+
+    Packing policy knobs (docs/14_wave_packing.md):
+
+    * ``pad_waves`` (default True): pad each packed wave's lane count
+      up to a quantized shape — the next power-of-two multiple of the
+      mesh device count, capped at ``max_wave`` — with dead masked
+      lanes (``t_stop=-inf``; bitwise-inert for live lanes), so mixed
+      traffic cycles a handful of compiled wave shapes instead of one
+      compile per distinct fill level.  Padding waste is observable in
+      ``stats()["lane_occupancy"]``.
+    * ``horizon_bucket`` (default 16.0): requests pack only within a
+      horizon bucket — finite ``t_end`` values bucket by
+      ``floor(log(t_end)/log(horizon_bucket))`` and ``t_end=None``
+      (run-to-completion) is its own bucket — bounding how long a
+      short request can be held hostage by a long wave-mate to one
+      bucket ratio.  ``None`` packs ALL finite horizons together
+      (truncation stays exact either way; this is purely a latency
+      policy)."""
 
     def __init__(
         self,
@@ -201,6 +227,8 @@ class Service:
         poll_every: int = 4,
         on_chunk: Optional[Callable] = None,
         trace_cap: int = 4096,
+        pad_waves: bool = True,
+        horizon_bucket: Optional[float] = 16.0,
         name: str = "cimba-serve",
     ):
         if max_wave <= 0:
@@ -211,6 +239,13 @@ class Service:
         self.max_retries = int(max_retries)
         self.backoff = backoff
         self.cache = cache if cache is not None else _pcache.ProgramCache()
+        self.pad_waves = bool(pad_waves)
+        if horizon_bucket is not None and not horizon_bucket > 1.0:
+            raise ValueError(
+                f"horizon_bucket must be > 1 (a ratio), got "
+                f"{horizon_bucket}"
+            )
+        self.horizon_bucket = horizon_bucket
         self._on_chunk = on_chunk
         self._queue = AdmissionQueue(max_pending)
         self._lock = threading.RLock()
@@ -225,11 +260,12 @@ class Service:
         self._counters = {
             "submitted": 0, "admitted": 0, "rejected": 0,
             "retries": 0, "batches": 0, "waves": 0,
-            "lanes_dispatched": 0,
+            "lanes_dispatched": 0, "lanes_padded": 0,
         }
         for o in _OUTCOMES:
             self._counters[o] = 0
         self._occupancy: dict = {}       # requests-per-batch -> count
+        self._class_ids: dict = {}       # class key -> short label
         self._ttfw_sum = 0.0
         self._ttfw_max = 0.0
         self._ttfw_n = 0
@@ -274,7 +310,7 @@ class Service:
         from cimba_tpu.obs import metrics as _metrics
 
         with_metrics = _metrics.enabled()
-        compat = self._compat_key(request, with_metrics)
+        cls = self._class_key(request, with_metrics)
         with self._lock:
             if self._closed:
                 raise ServiceClosed(
@@ -282,7 +318,10 @@ class Service:
                 )
             self._counters["submitted"] += 1
             self._seq += 1
-            entry = _Entry(request, self._seq, compat, eff_wave,
+            self._class_ids.setdefault(
+                cls, f"class{len(self._class_ids)}"
+            )
+            entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
             self._outstanding += 1
         try:
@@ -340,18 +379,37 @@ class Service:
     # -- observability -------------------------------------------------------
 
     def stats(self) -> dict:
-        """Service-level metrics: counters, queue depth (+ high-water),
-        batch-occupancy histogram (requests per packed wave),
-        time-to-first-wave aggregate, and the shared program cache's
-        hit/miss/eviction counters."""
+        """Service-level metrics: counters, queue depth (+ high-water,
+        and per compatibility class), batch-occupancy histogram
+        (requests per packed wave), lane-level occupancy (live vs
+        padded lanes — padding waste is observable, not just
+        request-count occupancy), time-to-first-wave aggregate, and
+        the shared program cache's hit/miss/eviction counters."""
         with self._lock:
             out = dict(self._counters)
             out["queue_depth"] = self._queue.depth()
             out["queue_depth_hwm"] = self._queue.depth_hwm
+            out["queue_depth_by_class"] = {
+                self._class_ids.get(c, "class?"): d
+                for c, d in sorted(
+                    self._queue.class_depths().items(),
+                    key=lambda cd: self._class_ids.get(cd[0], ""),
+                )
+            }
+            out["classes_seen"] = len(self._class_ids)
             out["outstanding"] = self._outstanding
             out["batch_occupancy"] = dict(
                 sorted(self._occupancy.items())
             )
+            live = self._counters["lanes_dispatched"]
+            padded = self._counters["lanes_padded"]
+            out["lane_occupancy"] = {
+                "lanes_live": live,
+                "lanes_padded": padded,
+                "padding_waste_frac": (
+                    padded / (live + padded) if live + padded else 0.0
+                ),
+            }
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
                 "mean_s": (
@@ -364,7 +422,9 @@ class Service:
         return out
 
     def chrome_trace(self) -> dict:
-        """Request lifecycle spans + queue-depth counter track as a
+        """Request lifecycle spans + queue-depth counter tracks (total
+        and per compatibility class) + per-wave live/padded lane
+        counters as a
         Chrome-trace / Perfetto dict (the same Trace Event Format schema
         ``obs.export`` emits, and it passes
         ``obs.export.validate_chrome_trace``): each request is one
@@ -393,16 +453,38 @@ class Service:
                 "name": "process_name", "ph": "M", "pid": s["seq"],
                 "args": {"name": s["label"] or f"request {s['seq']}"},
             })
-        # a live depth sample closes the counter track — and guarantees
-        # at least one event, so an IDLE service still exports a
-        # validator-clean trace
-        depths.append((time.monotonic(), self._queue.depth()))
-        for t, d in depths:
+        # a live depth sample closes the counter tracks — and
+        # guarantees at least one event, so an IDLE service still
+        # exports a validator-clean trace; every seen class emits its
+        # current (usually 0) depth so no track sticks at a stale value
+        with self._lock:
+            closing = self._class_sample()
+        depths.append(
+            (time.monotonic(), self._queue.depth(), closing, 0, 0)
+        )
+        for t, d, by_class, live, padded in depths:
+            ts = (t - self._t0) * 1e6
             events.append({
                 "name": "queue_depth", "ph": "C",
-                "ts": (t - self._t0) * 1e6, "pid": 0, "tid": 0,
+                "ts": ts, "pid": 0, "tid": 0,
                 "args": {"depth": d},
             })
+            # per-class queue-depth counter tracks (one track per
+            # compatibility class) + the live/padded lane split of the
+            # wave dispatched at this sample — padding waste as a
+            # timeline, not just an aggregate
+            for label, depth in by_class:
+                events.append({
+                    "name": f"queue_depth/{label}", "ph": "C",
+                    "ts": ts, "pid": 0, "tid": 0,
+                    "args": {"depth": depth},
+                })
+            if live or padded:
+                events.append({
+                    "name": "wave_lanes", "ph": "C",
+                    "ts": ts, "pid": 0, "tid": 0,
+                    "args": {"live": live, "padded": padded},
+                })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -411,23 +493,78 @@ class Service:
 
     # -- internals -----------------------------------------------------------
 
-    def _compat_key(self, request: Request, with_metrics: bool) -> tuple:
-        """What may share a wave: the compiled-program key (spec
-        identity, seed, profile, flags, horizon, chunk size, mesh) PLUS
-        `summary_path` identity (the fold program) and the params tree
-        signature (slices of both requests' params must concatenate).
-        Param VALUES are per-lane data and do not join the key — a
-        sweep point and a different sweep point pack together."""
+    def _horizon_bucket(self, t_end):
+        """Which horizon bucket a request's ``t_end`` falls into — the
+        Tier-B packing ladder (docs/14_wave_packing.md).  Truncation is
+        per-lane-exact regardless of who shares the wave; bucketing is
+        purely the LATENCY policy bounding how much longer than its own
+        horizon a request's wave may run."""
+        if t_end is None:
+            return "inf"
+        t = float(t_end)
+        if not t > 0.0:
+            return "nonpos"
+        if self.horizon_bucket is None:
+            return "finite"
+        import math
+
+        return math.floor(math.log(t) / math.log(self.horizon_bucket))
+
+    def _wave_shape(self, total: int) -> int:
+        """The quantized lane count one wave of ``total`` live lanes
+        dispatches at: the next power-of-two multiple of the mesh
+        device count, capped at ``max_wave`` (pad-and-mask — the
+        excess lanes are dead on arrival and bitwise-inert).  Disabled
+        padding, or a cap that would under-shoot, returns ``total``
+        unchanged."""
+        if not self.pad_waves or total <= 0:
+            return total
+        unit = 1 if self.mesh is None else int(self.mesh.devices.size)
+        q = unit
+        while q < total:
+            q *= 2
+        q = min(q, self.max_wave)
+        if q < total or (self.mesh is not None and q % unit):
+            return total
+        return q
+
+    def _plan_pad(self, slots) -> tuple:
+        """``(total live lanes, pad lanes)`` of one packed wave — the
+        ONE definition both the stats recording (:meth:`_pack`) and the
+        actual dispatch (:meth:`_run_batch`) use, so the counters can
+        never describe a wave shape that wasn't dispatched."""
+        total = sum(n for _, _, n in slots)
+        return total, self._wave_shape(total) - total
+
+    def _class_sample(self) -> tuple:
+        """Per-class queue depths over EVERY class ever seen (zeros
+        included — a Chrome counter track holds its last value, so a
+        drained class must emit 0 or it renders as stuck at its last
+        nonzero depth forever).  Caller holds the service lock."""
+        depths = self._queue.class_depths()
+        return tuple(
+            (label, depths.get(c, 0))
+            for c, label in self._class_ids.items()
+        )
+
+    def _class_key(self, request: Request, with_metrics: bool) -> tuple:
+        """What may share a wave — the compatibility CLASS: the
+        compiled-program class (spec structural fingerprint, profile,
+        flags, pack arm, mesh — `serve.cache.program_class_key`), the
+        params tree signature (slices of both requests' params must
+        concatenate), and the horizon bucket.  Seed, param VALUES, R,
+        priority, the exact ``t_end``, and ``chunk_steps`` are per-lane
+        data (or trajectory-invariant) and do not join the key — two
+        sweep points with different params/seeds/horizons pack
+        together; ``summary_path`` no longer joins either, because each
+        request folds its own slice through its own fold program."""
         import jax
 
         from cimba_tpu.runner import experiment as ex
 
-        pk = _pcache.program_key(
-            request.spec, request.seed, with_metrics,
-            _pcache.run_settings_key(
-                request.t_end, request.pack, request.chunk_steps,
-                self.mesh,
-            ),
+        pck = _pcache.program_class_key(
+            request.spec, with_metrics, mesh=self.mesh,
+            pack=request.pack,
         )
         shapes = jax.eval_shape(
             lambda: ex._slice_params(
@@ -441,7 +578,7 @@ class Service:
                 for l in jax.tree.leaves(shapes)
             ),
         )
-        return (pk, request.summary_path, sig)
+        return (pck, sig, self._horizon_bucket(request.t_end))
 
     def _cancel(self, entry: _Entry) -> bool:
         with self._lock:
@@ -544,8 +681,10 @@ class Service:
     def _pack(self, lead: _Entry):
         """Build one wave: the lead's slots first (its own wave
         partition — only whole slots, never clipped, so the fold stays
-        bitwise the direct call's), then fill remaining lanes with
-        compatible queued requests in priority order.  The lead arrives
+        bitwise the direct call's), then greedily fill remaining lanes
+        with queued requests of the SAME compatibility class in
+        priority order (the bucket-fill policy: seed/params/R/horizon
+        mixes pack, docs/14_wave_packing.md).  The lead arrives
         already CLAIMED (in_flight, set by the loop under the service
         lock); fill candidates are claimed here the same way — one that
         was cancelled in the gap between leaving the queue and the
@@ -580,7 +719,7 @@ class Service:
                 if e.deadline_at is not None and now > e.deadline_at:
                     dropped.append(e)
                     return True
-                if e.solo or e.compat != lead.compat:
+                if e.solo or e.cls != lead.cls:
                     return False
                 p = plan(e)
                 if not p:
@@ -607,22 +746,28 @@ class Service:
             for e in members:
                 if e.first_dispatch_t is None:
                     e.first_dispatch_t = time.monotonic()
+            total, padded = self._plan_pad(slots)
             self._counters["batches"] += 1
             self._counters["waves"] += len(slots)
-            self._counters["lanes_dispatched"] += sum(
-                n for _, _, n in slots
-            )
+            self._counters["lanes_dispatched"] += total
+            self._counters["lanes_padded"] += padded
             k = len(members)
             self._occupancy[k] = self._occupancy.get(k, 0) + 1
-            self._depth_samples.append(
-                (time.monotonic(), self._queue.depth())
-            )
+            self._depth_samples.append((
+                time.monotonic(), self._queue.depth(),
+                self._class_sample(), total, padded,
+            ))
         return slots, members
 
     def _run_batch(self, slots):
-        """Dispatch ONE packed wave: init the concatenated lanes, drive
-        the shared chunk program to completion.  Separated out as the
-        failure-injection seam for the retry tests."""
+        """Dispatch ONE packed wave: init the concatenated lanes —
+        per-slot replication indices, seed columns, horizon columns,
+        and parameter rows, plus the dead pad lanes that quantize the
+        wave shape — and drive the shared chunk program to completion.
+        The wave runs at the LEAD's ``chunk_steps`` (chunking is
+        trajectory-invariant, so mates with other budgets still get
+        bitwise-exact results).  Separated out as the failure-injection
+        seam for the retry tests."""
         import jax
         import jax.numpy as jnp
 
@@ -633,14 +778,11 @@ class Service:
 
         lead = slots[0][0]
         req = lead.request
-        pk_now = _pcache.program_key(
-            req.spec, req.seed, _metrics.enabled(),
-            _pcache.run_settings_key(
-                req.t_end, req.pack, req.chunk_steps, self.mesh,
-            ),
+        cls_now = _pcache.program_class_key(
+            req.spec, _metrics.enabled(), mesh=self.mesh, pack=req.pack,
         )
-        if pk_now != lead.compat[0]:
-            # the FULL program key (dtype profile, obs.metrics/trace
+        if cls_now != lead.cls[0]:
+            # the program CLASS (dtype profile, obs.metrics/trace
             # flags, eventset layout, the pack default...) was frozen
             # into the compatibility key at submit; tracing now under
             # drifted globals would cache a program whose behavior
@@ -655,29 +797,77 @@ class Service:
                 "submit time; resubmit after settling the globals"
             )
         init_j, chunk_j = _pcache.get_programs(
-            self.cache, req.spec, seed=req.seed, mesh=self.mesh,
-            t_end=req.t_end, pack=req.pack, chunk_steps=req.chunk_steps,
-            with_metrics=lead.with_metrics,
+            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+            chunk_steps=req.chunk_steps, with_metrics=lead.with_metrics,
         )
-        _pcache.preflight_summary_path(
-            self.cache, req.spec, init_j, req.summary_path, req.params,
-            req.n_replications, slots[0][2], lead.with_metrics,
-        )
+        # each member's summary_path preflights against ITS params
+        # shapes (paths may differ — every request folds its own slice
+        # through its own fold program); fingerprint-cached, so a warm
+        # cache skips the re-trace
+        seen: set = set()
+        for e, _, n in slots:
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            _pcache.preflight_summary_path(
+                self.cache, e.request.spec, init_j,
+                e.request.summary_path, e.request.params,
+                e.request.n_replications, n, e.with_metrics,
+            )
+        total, pad = self._plan_pad(slots)
         reps = [jnp.arange(lo, lo + n) for _, lo, n in slots]
+        seeds = [
+            ex._seed_column(e.request.seed, n) for e, _, n in slots
+        ]
+        if pad == 0 and all(
+            e.request.t_end is None for e, _, n in slots
+        ):
+            # unpadded all-run-to-completion wave: omit the t_stop leaf
+            # entirely, like the direct stream path — the chunk cond
+            # then skips the per-event horizon check (same program key;
+            # jit re-specializes per pytree structure)
+            t_stops = None
+        else:
+            t_stops = [
+                ex._horizon_column(e.request.t_end, n)
+                for e, _, n in slots
+            ]
         pws = [
             ex._slice_params(
                 e.request.params, e.request.n_replications, lo, n
             )
             for e, lo, n in slots
         ]
-        if len(slots) == 1:
-            reps_cat, pw_cat = reps[0], pws[0]
+        if pad:
+            # dead masked lanes: t_stop=-inf means the liveness cond is
+            # false at entry — the lane never dispatches an event, and
+            # its (sliced-off) state never joins any fold.  Its params
+            # are the lead's row 0 (real, valid values, so user_init
+            # cannot trip on them); rep/seed values are irrelevant.
+            reps.append(jnp.zeros((pad,), reps[0].dtype))
+            seeds.append(ex._seed_column(0, pad))
+            t_stops.append(jnp.full((pad,), -jnp.inf, t_stops[0].dtype))
+            row0 = ex._slice_params(
+                req.params, req.n_replications, 0, 1
+            )
+            pws.append(jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (pad,) + x.shape[1:]),
+                row0,
+            ))
+        if len(reps) == 1:
+            reps_cat, seed_cat, pw_cat = reps[0], seeds[0], pws[0]
+            ts_cat = None if t_stops is None else t_stops[0]
         else:
             reps_cat = jnp.concatenate(reps, axis=0)
+            seed_cat = jnp.concatenate(seeds, axis=0)
+            ts_cat = (
+                None if t_stops is None
+                else jnp.concatenate(t_stops, axis=0)
+            )
             pw_cat = jax.tree.map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *pws
             )
-        sims = init_j(reps_cat, pw_cat)
+        sims = init_j(reps_cat, seed_cat, ts_cat, pw_cat)
         return drive_chunks(
             chunk_j, sims, poll_every=self.poll_every,
             on_chunk=self._on_chunk,
@@ -685,19 +875,22 @@ class Service:
 
     def _fold_slots(self, slots, sims) -> None:
         """Slice the finished wave back per slot and fold each into its
-        request's accumulator — in slot order, so a multi-slot request
-        folds exactly as its direct stream call would.  May raise (the
-        fold traces user code); acc and next_lo advance together per
-        slot, so a retry after a mid-batch failure resumes exactly at
-        the first unfolded slot."""
+        request's accumulator — in slot order, through the REQUEST's
+        own fold program (``summary_path`` is per-request, not part of
+        the compatibility class), so a multi-slot request folds exactly
+        as its direct stream call would.  Pad lanes sit past the last
+        slot's offset and are never sliced into any fold.  May raise
+        (the fold traces user code); acc and next_lo advance together
+        per slot, so a retry after a mid-batch failure resumes exactly
+        at the first unfolded slot."""
         import jax
 
-        lead = slots[0][0]
-        fold_j = _pcache.get_fold(
-            self.cache, lead.with_metrics, lead.request.summary_path
-        )
         off = 0
         for entry, lo, n in slots:
+            fold_j = _pcache.get_fold(
+                self.cache, entry.with_metrics,
+                entry.request.summary_path,
+            )
             sl = jax.tree.map(
                 lambda x, off=off, n=n: x[off: off + n], sims
             )

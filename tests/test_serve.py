@@ -164,7 +164,9 @@ def test_serve_concurrent_clients_match_direct_bitwise(
     wave-packing contamination.  (The same battery at mm1/mg1 scale is
     the slow soak below.)"""
     spec, cache = tiny, shared_cache
-    cases = [  # (R, wave, seed) — seeds 1 and 2 cannot share waves
+    cases = [  # (R, wave, seed) — mixed seeds PACK since the
+        # heterogeneous-wave refactor (seed is a per-lane column);
+        # bitwise request isolation is exactly what this pins
         (4, 4, 1), (8, 4, 1), (4, 4, 2), (4, 4, 1),
         (8, 4, 2), (4, 4, 2), (4, 4, 1), (8, 4, 1),
     ]
@@ -204,18 +206,23 @@ def test_packing_compatible_shares_wave_incompatible_does_not(
     tiny, shared_cache,
 ):
     """Constructed queue: while the lead request is gated in dispatch,
-    three compatible requests and one incompatible (different seed)
-    queue up.  The next dispatch packs exactly the compatible three
-    into ONE wave; the incompatible one rides alone."""
+    three compatible requests — deliberately differing in SEED (per-lane
+    data since the heterogeneous-packing refactor, so no longer a
+    compatibility barrier) — and one incompatible request (a finite
+    ``t_end``, which lands in a different horizon bucket than the
+    run-to-completion three) queue up.  The next dispatch packs exactly
+    the compatible three into ONE wave; the incompatible one rides
+    alone."""
     spec = tiny
     svc = _Gated(max_wave=32, cache=shared_cache)
     try:
         lead = svc.submit(_tiny_req(spec, 4, label="lead"))
         _wait(lambda: svc.stats()["batches"] == 1)  # lead packed, gated
         compat = [
-            svc.submit(_tiny_req(spec, 4, label=f"k{i}")) for i in range(3)
+            svc.submit(_tiny_req(spec, 4, seed=i + 1, label=f"k{i}"))
+            for i in range(3)
         ]
-        other = svc.submit(_tiny_req(spec, 4, seed=2, label="odd"))
+        other = svc.submit(_tiny_req(spec, 4, t_end=5.0, label="odd"))
         svc.gate.set()
         for h in [lead] + compat + [other]:
             h.result(60)
@@ -224,23 +231,25 @@ def test_packing_compatible_shares_wave_incompatible_does_not(
         svc.gate.set()
         svc.shutdown()
     # batch 1: lead alone (nothing else queued yet); batch 2: the three
-    # compatible requests; batch 3: the incompatible singleton
+    # compatible requests (mixed seeds); batch 3: the other-bucket
+    # singleton
     assert occ == {1: 2, 3: 1}, occ
 
 
 def test_priority_orders_dispatch(tiny, shared_cache):
     """Higher priority pops first: with the dispatcher gated on a lead
     batch, a high-priority late arrival is served before an earlier
-    low-priority one (they are incompatible, so order is observable as
+    low-priority one (different horizon BUCKETS keep them incompatible
+    — a different seed no longer would — so order is observable as
     separate batches in completion-span order)."""
     spec = tiny
     svc = _Gated(max_wave=8, cache=shared_cache)
     try:
         svc.submit(_tiny_req(spec, 4, label="lead"))
         _wait(lambda: svc.stats()["batches"] == 1)
-        lo = svc.submit(_tiny_req(spec, 4, seed=2, label="low"))
+        lo = svc.submit(_tiny_req(spec, 4, t_end=5.0, label="low"))
         hi = svc.submit(
-            _tiny_req(spec, 4, seed=3, label="high", priority=5)
+            _tiny_req(spec, 4, t_end=500.0, label="high", priority=5)
         )
         svc.gate.set()
         lo.result(60)
@@ -405,7 +414,9 @@ def test_retry_backoff_recovers_and_never_stalls_queue(
 ):
     """A transiently failing request backs off and retries SOLO while
     an unrelated request submitted later still completes (the queue is
-    never stalled); the recovered result is bitwise the direct run's."""
+    never stalled); the recovered result is bitwise the direct run's.
+    The healthy request rides a different horizon bucket so it can
+    never be packed into (and blamed with) the poison batch."""
     spec, cache = tiny, shared_cache
     svc = _Flaky(
         2, max_wave=8, cache=cache, max_retries=2,
@@ -413,7 +424,9 @@ def test_retry_backoff_recovers_and_never_stalls_queue(
     )
     try:
         poison = svc.submit(_tiny_req(spec, 4, label="poison"))
-        healthy = svc.submit(_tiny_req(spec, 4, seed=2, label="healthy"))
+        healthy = svc.submit(
+            _tiny_req(spec, 4, seed=2, t_end=5.0, label="healthy")
+        )
         assert healthy.result(60) is not None
         res = poison.result(60)
         stats = svc.stats()

@@ -1,0 +1,423 @@
+"""Heterogeneous wave packing (docs/14_wave_packing.md).
+
+Contracts pinned here:
+
+* **per-lane seed column** (Tier A): requests differing only in seed
+  share one compiled program AND one wave, and each result is bitwise
+  the direct solo ``run_experiment_stream`` call's — the seed column is
+  data, not a program constant;
+* **pad-and-mask**: a wave padded with dead masked lanes
+  (``t_stop=-inf``) returns results bitwise equal to the unpadded
+  dispatch for every live lane, on BOTH dtype profiles;
+* **mixed-horizon packing** (Tier B): requests with different finite
+  ``t_end`` in one horizon bucket share a wave; the short request's
+  lanes go dead early and its pooled stats equal its direct call
+  exactly — truncation via the per-lane horizon is exact, not
+  approximate;
+* **bucketing policy**: different horizon buckets (and ``t_end=None``
+  vs finite) never share a wave — the latency fence;
+* **structural spec fingerprint**: ``dataclasses.replace`` twins share
+  program-cache entries (the old ``id(spec)`` keys never could) and
+  still produce bitwise-identical results whichever spec object traced
+  first (the PR 3 ``_infer_used_tags`` eval_shape-memo lesson);
+* **observability**: padding waste and per-class queue depth are
+  visible in ``Service.stats()`` and the Chrome trace.
+
+Deterministic packing comes from the same gated-dispatch Service
+subclass ``tests/test_serve.py`` uses.  Tier-1 tests ride the tiny
+spec; the mixed-traffic mm1 soak (the acceptance load) is marked slow
+(tools/ci.sh runs a smaller deterministic cell).
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import config, serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.stats import summary as sm
+
+
+def _tiny_spec(t_stop=12.0):
+    """The fast-compiling one-process hold/exit model (see
+    tests/test_serve.py)."""
+    m = Model("tiny", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    """Module-level summary path (fold programs key on identity)."""
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+def _assert_results_equal(a, b):
+    assert a.n_waves == b.n_waves
+    al = jax.tree.leaves((a.summary, a.n_failed, a.total_events, a.metrics))
+    bl = jax.tree.leaves((b.summary, b.n_failed, b.total_events, b.metrics))
+    assert len(al) == len(bl)
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_spec(12.0)
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+class _Gated(serve.Service):
+    """Dispatch blocks until the test opens the gate — queue states are
+    constructed, not raced."""
+
+    def __init__(self, **kw):
+        self.gate = threading.Event()
+        super().__init__(**kw)
+
+    def _run_batch(self, slots):
+        assert self.gate.wait(60), "test gate never opened"
+        return super()._run_batch(slots)
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+def _req(spec, R, *, seed=1, t_end=None, wave=None, label=None):
+    return serve.Request(
+        spec, (), R, seed=seed, t_end=t_end, chunk_steps=16,
+        wave_size=wave, summary_path=_clock_path, label=label,
+    )
+
+
+def _direct(spec, R, cache, *, seed=1, t_end=None, wave=None):
+    return ex.run_experiment_stream(
+        spec, (), R, wave_size=wave or R, chunk_steps=16, seed=seed,
+        t_end=t_end, summary_path=_clock_path, program_cache=cache,
+    )
+
+
+# --------------------------------------------------------------------------
+# Tier A: per-lane seed column
+# --------------------------------------------------------------------------
+
+
+def test_per_lane_seed_packs_and_is_bitwise_vs_solo(tiny, shared_cache):
+    """Two requests differing ONLY in seed pack into one wave, through
+    one shared compiled program (zero extra cache misses), and each
+    result is bitwise the direct solo stream call's — the per-lane seed
+    pin of docs/14_wave_packing.md."""
+    spec, cache = tiny, shared_cache
+    # prime the direct calls first (they warm the same keys the packed
+    # wave uses — seed is NOT part of the program key)
+    d1 = _direct(spec, 4, cache, seed=1)
+    d2 = _direct(spec, 4, cache, seed=2)
+    misses_before = cache.stats()["misses"]
+    svc = _Gated(max_wave=16, cache=cache)
+    try:
+        lead = svc.submit(_req(spec, 4, seed=3, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        h1 = svc.submit(_req(spec, 4, seed=1, label="s1"))
+        h2 = svc.submit(_req(spec, 4, seed=2, label="s2"))
+        svc.gate.set()
+        assert lead.result(60) is not None
+        r1, r2 = h1.result(60), h2.result(60)
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert occ.get(2) == 1, occ  # the two seeds shared one wave
+    _assert_results_equal(r1, d1)
+    _assert_results_equal(r2, d2)
+    # same class -> same programs: the packed wave added no programs
+    # beyond shape re-specialization of already-cached jits
+    assert cache.stats()["misses"] == misses_before
+
+
+# --------------------------------------------------------------------------
+# pad-and-mask
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_pad_and_mask_parity_bitwise(profile, tiny):
+    """A padded wave (live lanes + dead ``t_stop=-inf`` lanes) returns
+    results bitwise equal to the unpadded dispatch of the same live
+    lanes, on both dtype profiles — padding is inert, never blended."""
+    with config.profile(profile):
+        spec = tiny
+        cache = pc.ProgramCache(capacity=64)
+        direct = _direct(spec, 5, cache, seed=4)  # no padding ever
+        for pad_waves in (False, True):
+            with serve.Service(
+                max_wave=8, cache=cache, pad_waves=pad_waves,
+            ) as svc:
+                res = svc.submit(_req(spec, 5, seed=4)).result(60)
+                stats = svc.stats()
+            _assert_results_equal(res, direct)
+            padded = stats["lane_occupancy"]["lanes_padded"]
+            assert (padded == 3) if pad_waves else (padded == 0), stats
+            assert stats["lane_occupancy"]["lanes_live"] == 5
+
+
+# --------------------------------------------------------------------------
+# Tier B: mixed horizons
+# --------------------------------------------------------------------------
+
+
+def test_mixed_horizon_pack_short_request_exact(tiny, shared_cache):
+    """Two finite horizons in ONE bucket (4.0 and 14.0 both land in
+    (1, 16] at the default ratio 16) pack into one wave; the short
+    request's lanes go dead early and its pooled stats are bitwise its
+    direct call's — exact truncation inside a longer wave."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=16, cache=cache)
+    try:
+        lead = svc.submit(_req(spec, 4, t_end=8.0, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        short = svc.submit(_req(spec, 4, seed=7, t_end=4.0))
+        long_ = svc.submit(_req(spec, 4, seed=8, t_end=14.0))
+        svc.gate.set()
+        assert lead.result(60) is not None
+        rs, rl = short.result(60), long_.result(60)
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert occ.get(2) == 1, occ
+    ds = _direct(spec, 4, cache, seed=7, t_end=4.0)
+    dl = _direct(spec, 4, cache, seed=8, t_end=14.0)
+    _assert_results_equal(rs, ds)
+    _assert_results_equal(rl, dl)
+    # the short horizon really truncated (fewer events than the long)
+    assert int(rs.total_events) < int(rl.total_events)
+
+
+def test_horizon_buckets_never_share_a_wave(tiny, shared_cache):
+    """The latency fence: ``t_end=None`` vs finite, and two finite
+    horizons a bucket apart, each ride alone."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=32, cache=cache)
+    try:
+        lead = svc.submit(_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        hs = [
+            svc.submit(_req(spec, 4, label="nohorizon")),
+            svc.submit(_req(spec, 4, t_end=4.0, label="lowbucket")),
+            svc.submit(_req(spec, 4, t_end=500.0, label="highbucket")),
+        ]
+        svc.gate.set()
+        for h in [lead] + hs:
+            assert h.result(60) is not None
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    # the lead's pack ran before anything else queued (solo); the three
+    # queued requests are pairwise in DIFFERENT buckets, so all solo
+    assert occ == {1: 4}, occ
+
+
+def test_horizon_bucket_none_packs_all_finite(tiny):
+    """``horizon_bucket=None`` collapses every finite horizon into one
+    bucket — the pack-anything policy knob."""
+    spec = tiny
+    cache = pc.ProgramCache(capacity=64)
+    svc = _Gated(max_wave=32, cache=cache, horizon_bucket=None)
+    try:
+        lead = svc.submit(_req(spec, 4, t_end=2.0, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        a = svc.submit(_req(spec, 4, seed=2, t_end=4.0))
+        b = svc.submit(_req(spec, 4, seed=3, t_end=500.0))
+        svc.gate.set()
+        for h in (lead, a, b):
+            assert h.result(60) is not None
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert occ.get(2) == 1, occ
+
+
+# --------------------------------------------------------------------------
+# structural spec fingerprint (the id(spec) cache fix)
+# --------------------------------------------------------------------------
+
+
+def test_twin_specs_share_cache_and_match_bitwise(tiny):
+    """``dataclasses.replace`` twins (sweep-driver shape) hit the SAME
+    program-cache entries — under the old ``id(spec)`` keys they never
+    could — and the twin's results are bitwise the original's whichever
+    object traced first (the ``_infer_used_tags`` eval_shape-memo
+    lesson: a twin must trace/serve correctly, not silently infer an
+    empty tag set)."""
+    spec = tiny
+    twin = dataclasses.replace(spec)
+    assert twin is not spec
+    assert pc.spec_fingerprint(twin) == pc.spec_fingerprint(spec)
+    # twin-first on a FRESH cache: the twin traces, the original hits
+    cache = pc.ProgramCache(capacity=64)
+    r_twin = _direct(twin, 4, cache, seed=5)
+    misses = cache.stats()["misses"]
+    r_orig = _direct(spec, 4, cache, seed=5)
+    assert cache.stats()["misses"] == misses  # original fully shared
+    _assert_results_equal(r_twin, r_orig)
+    # a STRUCTURAL change (event_cap regrow shape) must NOT share
+    grown = dataclasses.replace(spec, event_cap=2 * spec.event_cap)
+    assert pc.spec_fingerprint(grown) != pc.spec_fingerprint(spec)
+    # and twins pack into one wave at the serving layer
+    svc = _Gated(max_wave=16, cache=cache)
+    try:
+        lead = svc.submit(_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        h1 = svc.submit(_req(spec, 4, seed=6, label="orig"))
+        h2 = svc.submit(_req(twin, 4, seed=6, label="twin"))
+        svc.gate.set()
+        assert lead.result(60) is not None
+        r1, r2 = h1.result(60), h2.result(60)
+        occ = svc.stats()["batch_occupancy"]
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    assert occ.get(2) == 1, occ
+    _assert_results_equal(r1, r2)
+
+
+# --------------------------------------------------------------------------
+# observability: padding waste + per-class depth
+# --------------------------------------------------------------------------
+
+
+def test_lane_and_class_observability(tiny, shared_cache):
+    """Padding waste and per-class queue depth are first-class stats,
+    and the Chrome trace carries the per-class and wave-lane counter
+    tracks (validator-clean)."""
+    from cimba_tpu.obs import export as oe
+
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=16, cache=cache)
+    try:
+        lead = svc.submit(_req(spec, 4, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        svc.submit(_req(spec, 5, seed=2, label="odd-five"))
+        svc.submit(_req(spec, 4, t_end=4.0, label="other-class"))
+        mid = svc.stats()
+        # two distinct classes queued behind the gated lead
+        assert sum(mid["queue_depth_by_class"].values()) == 2
+        assert len(mid["queue_depth_by_class"]) == 2
+        assert mid["classes_seen"] >= 2
+        svc.gate.set()
+        svc.drain(60)
+        stats = svc.stats()
+        doc = svc.chrome_trace()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+    oe.validate_chrome_trace(doc)
+    lane = stats["lane_occupancy"]
+    # the 5-lane request padded to 8: waste is visible
+    assert lane["lanes_padded"] >= 3
+    assert 0.0 < lane["padding_waste_frac"] < 1.0
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "wave_lanes" in names
+    assert any(n.startswith("queue_depth/class") for n in names)
+
+
+def test_mixed_requests_weighted_interleave():
+    """The mixed-load driver's schedule is deterministic, proportional,
+    and interleaved (smooth weighted round-robin)."""
+    spec = _tiny_spec(3.0)
+    ts = [
+        serve.RequestTemplate("a", _req(spec, 4), 2.0),
+        serve.RequestTemplate("b", _req(spec, 4, seed=2), 1.0),
+        serve.RequestTemplate("c", _req(spec, 4, seed=3), 1.0),
+    ]
+    reqs, names = serve.mixed_requests(ts, 8)
+    assert len(reqs) == 8
+    assert names.count("a") == 4 and names.count("b") == 2
+    assert names[:4] == ["a", "b", "c", "a"]  # interleaved, not runs
+    assert reqs[0].label == "a#0" and reqs[3].label == "a#1"
+    reqs2, names2 = serve.mixed_requests(ts, 8)
+    assert names2 == names  # deterministic
+    with pytest.raises(ValueError, match="weight"):
+        serve.mixed_requests(
+            [serve.RequestTemplate("z", _req(spec, 4), 0.0)], 2
+        )
+
+
+# --------------------------------------------------------------------------
+# the mixed-traffic soak (acceptance load at mm1 scale)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # heavyweight: runs in tools/ci.sh, not the timed tier-1
+def test_mixed_traffic_soak_occupancy_and_bitwise():
+    """The acceptance criterion end-to-end: a burst mix of ≥3 mm1
+    templates differing only in (params, R, seed) plus two horizon
+    buckets yields mean batch occupancy > 1.5 (all-solo baseline: 1.0)
+    and every completed result bitwise equal to its direct
+    ``run_experiment_stream`` call."""
+    from cimba_tpu.models import mm1
+
+    spec, _ = mm1.build(record=False)
+    cache = pc.ProgramCache()
+
+    def req(seed, *, n=40, R=8, t_end=None):
+        return serve.Request(
+            spec, mm1.params(n), R, seed=seed, t_end=t_end,
+            wave_size=R, chunk_steps=41,
+        )
+
+    templates = [
+        serve.RequestTemplate("params-a", req(11), 2.0),
+        serve.RequestTemplate("params-b", req(22, n=50), 2.0),
+        serve.RequestTemplate("half-r", req(33, R=4), 2.0),
+        serve.RequestTemplate("short-h", req(44, t_end=30.0)),
+        serve.RequestTemplate("long-h", req(55, t_end=500.0)),
+    ]
+    with serve.Service(max_wave=64, cache=cache) as svc:
+        report = serve.run_mixed_load(
+            svc, templates, 24, n_clients=8, result_timeout=600,
+        )
+        stats = svc.stats()
+    assert report.n_completed == 24, report.errors
+    occ = stats["batch_occupancy"]
+    mean_occ = sum(k * v for k, v in occ.items()) / sum(occ.values())
+    assert mean_occ > 1.5, occ
+    per_t = report.per_template()
+    assert set(per_t) == {t.name for t in templates}
+    direct = {
+        t.name: ex.run_experiment_stream(
+            t.request.spec, t.request.params, t.request.n_replications,
+            wave_size=t.request.wave_size,
+            chunk_steps=t.request.chunk_steps, seed=t.request.seed,
+            t_end=t.request.t_end, program_cache=cache,
+        )
+        for t in templates
+    }
+    for i, res in report.results:
+        d = direct[report.template_names[i]]
+        _assert_results_equal(res, d)

@@ -177,6 +177,84 @@ print("serve smoke OK:", {l: round(float(sm.mean(out[l].summary)), 4)
       "cache", cache.stats())
 EOF
 
+# mixed-traffic smoke: 3 clients with different (params, seed, horizon)
+# on ONE spec must pack into shared heterogeneous waves (occupancy > 1 —
+# per-lane seed/t_stop columns, docs/14_wave_packing.md) and still match
+# their direct run_experiment_stream calls exactly
+run_cell "mixed-traffic smoke" python - <<'EOF'
+import threading
+import numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+# (label, n_objects, R, seed, t_end): params, seed, AND horizon all
+# differ — one compatibility class (both horizons sit in the 16..256
+# bucket at the default ratio)
+cases = [("a", 60, 8, 1, 30.0), ("b", 90, 8, 5, 60.0),
+         ("c", 75, 8, 9, 45.0)]
+out = {}
+
+
+class _Gated(serve.Service):
+    """Hold the first dispatch until all three requests are queued, so
+    the pack is deterministic, not a race against the dispatcher."""
+
+    def __init__(self, **kw):
+        self.gate = threading.Event()
+        super().__init__(**kw)
+
+    def _run_batch(self, slots):
+        assert self.gate.wait(600)
+        return super()._run_batch(slots)
+
+
+svc = _Gated(max_wave=32, cache=cache)
+try:
+    # a sacrificial lead is claimed (and gated) first, so the three
+    # mixed requests are all queued when the next pack runs
+    import time as _time
+    lead = svc.submit(serve.Request(
+        spec, mm1.params(60), 8, seed=1, t_end=30.0, wave_size=8,
+        chunk_steps=64, label="lead",
+    ))
+    while svc.stats()["batches"] != 1:
+        _time.sleep(0.005)
+    handles = {}
+    for label, n, R, seed, t_end in cases:
+        handles[label] = svc.submit(serve.Request(
+            spec, mm1.params(n), R, seed=seed, t_end=t_end,
+            wave_size=8, chunk_steps=64, label=label,
+        ))
+    svc.gate.set()
+    assert lead.result(600) is not None
+    for label in handles:
+        out[label] = handles[label].result(600)
+    stats = svc.stats()
+finally:
+    svc.gate.set()
+    svc.shutdown()
+for label, n, R, seed, t_end in cases:
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(n), R, wave_size=8, chunk_steps=64,
+        seed=seed, t_end=t_end, program_cache=cache,
+    )
+    res = out[label]
+    assert int(res.total_events) == int(direct.total_events), label
+    assert float(sm.mean(res.summary)) == float(
+        sm.mean(direct.summary)), label
+    assert float(res.summary.n) == float(direct.summary.n), label
+occ = stats["batch_occupancy"]
+# the three heterogeneous (params, seed, horizon) requests shared ONE wave
+assert occ.get(3) == 1, occ
+assert stats["completed"] == 4, stats
+print("mixed-traffic smoke OK: occupancy", occ,
+      "lanes", stats["lane_occupancy"])
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
