@@ -6,10 +6,12 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_mixed,mmc,mg1,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_mixed,mmc,mg1,jobshop,awacs}``
 runs one named config (``serve`` is the open-loop serving-layer load,
-docs/13_serving.md; ``serve_mixed`` is the heterogeneous-traffic mix
-measuring wave-packing occupancy and padding waste,
+docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
+result with and without a hydrated AOT program store,
+docs/15_program_store.md; ``serve_mixed`` is the heterogeneous-traffic
+mix measuring wave-packing occupancy and padding waste,
 docs/14_wave_packing.md);
 ``--config all`` runs the whole battery, one JSON line each (BASELINE.json
 configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
@@ -1179,6 +1181,229 @@ def bench_serve_mixed():
     )
 
 
+#: the serve_cold child: one fresh process per trial per arm, timing
+#: import / programs-ready / first-result legs of a single serve-shaped
+#: request.  The hydrated arm warms from the AOT store manifest (NO
+#: execution, main thread — the docs/15 deploy recipe); the fresh arm
+#: warms by compiling (the bench_serve protocol).  Digest of the result
+#: leaves proves hydrated == freshly-compiled bitwise.
+_COLD_CHILD = r"""
+import hashlib, json, os, time
+t_start = time.monotonic()
+import jax, numpy as np
+from cimba_tpu import config as _cfg, serve
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as _pc
+t_import = time.monotonic() - t_start
+
+prof = os.environ["COLD_PROFILE"]
+R = int(os.environ["COLD_R"])
+N = int(os.environ["COLD_N"])
+chunk = int(os.environ["COLD_CHUNK"])
+seed = int(os.environ["COLD_SEED"])
+store = os.environ.get("CIMBA_PROGRAM_STORE")
+with _cfg.profile(prof):
+    spec, _ = mm1.build(record=False)
+    params = mm1.params(N)
+    cache = _pc.ProgramCache()
+    t0 = time.monotonic()
+    if store:
+        serve.warm(cache, spec, params, R, manifest=store,
+                   chunk_steps=chunk)
+    else:
+        serve.warm(cache, spec, params, R, chunk_steps=chunk, seed=seed)
+    t_ready = time.monotonic() - t0
+    t0 = time.monotonic()
+    with serve.Service(max_wave=R, cache=cache) as svc:
+        res = svc.submit(serve.Request(
+            spec, params, R, seed=seed, wave_size=R, chunk_steps=chunk,
+        )).result(1800)
+        stats = svc.stats()
+    t_first = time.monotonic() - t0
+    dig = hashlib.sha256(b"".join(
+        np.asarray(x).tobytes()
+        for x in jax.tree.leaves(
+            (res.summary, res.n_failed, res.total_events))
+    )).hexdigest()
+    split = None
+    if os.environ.get("COLD_REPORT"):
+        # monolithic-path trace/compile/execute split at the same
+        # shape (with_report goes through the AOT legs cleanly)
+        _, report = ex.run_experiment(
+            spec, params, R, seed=seed, with_report=True,
+        )
+        split = {
+            "trace_lower_s": report.trace_lower_s,
+            "compile_s": report.compile_s,
+            "execute_s": report.execute_s,
+        }
+st = stats.get("program_store")
+if store:
+    assert st and st["hits"] >= 1 and st["misses"] == 0, st
+    assert st["fallback_shapes"] == 0, st
+print(json.dumps({
+    "t_import_s": t_import, "t_ready_s": t_ready,
+    "t_first_result_s": t_first, "t_total_s": t_ready + t_first,
+    "digest": dig, "store": st, "compile_split": split,
+}))
+"""
+
+
+def bench_serve_cold():
+    """Cold-start time-to-first-result with and without a hydrated AOT
+    program store (docs/15_program_store.md), at the ``serve`` arm's
+    per-request shape.  Each trial is a CLEAN subprocess: the fresh arm
+    pays trace+XLA compile via ``serve.warm`` (the bench_serve
+    protocol); the hydrated arm warms from the store manifest —
+    deserialized executables, zero compiles for store-covered programs
+    (asserted via the store hit/fallback counters inside the child).
+    Emits ``detail.cold_start``: p50/p99 of the ready/first-result/
+    total legs per arm, the speedup, per-profile bitwise digests
+    (hydrated == freshly compiled, f64 AND f32), the store's per-entry
+    compile seconds + artifact bytes, and a monolithic-path
+    trace/compile/execute split probe (``with_report=True``)."""
+    import tempfile
+
+    from cimba_tpu import serve
+
+    accel = _accel()
+    wave = int(
+        os.environ.get(
+            "CIMBA_BENCH_STREAM_WAVE", str(65536 if accel else 1024)
+        )
+    )
+    req_r = int(
+        os.environ.get("CIMBA_BENCH_SERVE_REQ_R", max(wave // 4, 1))
+    )
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = _stream_chunk_default()
+    trials = int(os.environ.get("CIMBA_BENCH_COLD_TRIALS", "3"))
+    prof = _bench_profile()
+    profiles = [prof] + [p for p in ("f64", "f32") if p != prof]
+    store_dir = os.environ.get("CIMBA_PROGRAM_STORE") or tempfile.mkdtemp(
+        prefix="cimba-store-"
+    )
+
+    # build the warm-store artifact per dtype profile (subprocesses, so
+    # the battery's own jax config is never rewired mid-run)
+    store_info = {}
+    for p in profiles:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "warm_store.py"),
+                "--store", store_dir, "--configs", "mm1",
+                "--wave", str(req_r), "--objects", str(N),
+                "--chunk-steps", str(chunk), "--horizons", "none",
+                "--profile", p,
+            ],
+            capture_output=True, text=True, timeout=3600,
+        )
+        _heartbeat()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"warm_store failed for profile {p}: {proc.stderr[-2000:]}"
+            )
+        store_info[p] = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    def child(arm, p, report=False):
+        env = dict(os.environ)
+        env.pop("CIMBA_PROGRAM_STORE", None)
+        if arm == "hydrated":
+            env["CIMBA_PROGRAM_STORE"] = store_dir
+        env.update(
+            COLD_PROFILE=p, COLD_R=str(req_r), COLD_N=str(N),
+            COLD_CHUNK=str(chunk), COLD_SEED="2026",
+        )
+        if report:
+            env["COLD_REPORT"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-c", _COLD_CHILD], env=env,
+            capture_output=True, text=True, timeout=3600,
+        )
+        _heartbeat()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"serve_cold {arm}/{p} child failed: "
+                f"{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    runs = {"fresh": [], "hydrated": []}
+    split = None
+    for i in range(trials):
+        fresh = child("fresh", prof, report=(i == 0))
+        split = split or fresh["compile_split"]
+        runs["fresh"].append(fresh)
+        runs["hydrated"].append(child("hydrated", prof))
+
+    # bitwise anchors: hydrated == freshly compiled, BOTH dtype
+    # profiles (the bench-profile pair reuses the timed trials)
+    bitwise = {}
+    for p in profiles:
+        if p == prof:
+            f, h = runs["fresh"][0], runs["hydrated"][0]
+        else:
+            f, h = child("fresh", p), child("hydrated", p)
+        assert f["digest"] == h["digest"], (
+            f"serve_cold: hydrated result diverged from the freshly "
+            f"compiled one under {p}"
+        )
+        bitwise[p] = True
+
+    def leg(arm, key):
+        xs = [r[key] for r in runs[arm]]
+        return {
+            "p50_s": serve.percentile(xs, 50),
+            "p99_s": serve.percentile(xs, 99),
+        }
+
+    arms = {
+        arm: {
+            "trials": trials,
+            "import": leg(arm, "t_import_s"),
+            "ready": leg(arm, "t_ready_s"),
+            "first_result": leg(arm, "t_first_result_s"),
+            "total": leg(arm, "t_total_s"),
+        }
+        for arm in ("fresh", "hydrated")
+    }
+    speedup_ready = (
+        arms["fresh"]["ready"]["p50_s"]
+        / max(arms["hydrated"]["ready"]["p50_s"], 1e-9)
+    )
+    detail = {
+        "profile": prof,
+        "replications_per_request": req_r,
+        "objects_per_replication": N,
+        "chunk_steps": chunk,
+        "store_dir": store_dir,
+        "cold_start": {
+            "arms": arms,
+            # ready = programs-ready (the time-to-first-COMPILE leg the
+            # store removes); total = post-import time-to-first-result
+            "speedup_ready_p50": speedup_ready,
+            "speedup_ttfr_p50": (
+                arms["fresh"]["total"]["p50_s"]
+                / max(arms["hydrated"]["total"]["p50_s"], 1e-9)
+            ),
+            "bitwise_vs_fresh": bitwise,
+            "compile_split_probe": split,
+            "store": {
+                p: {
+                    "compile_s_total": info["compile_s_total"],
+                    "artifact_bytes_total": info["artifact_bytes_total"],
+                }
+                for p, info in store_info.items()
+            },
+            "hydrated_store_stats": runs["hydrated"][-1]["store"],
+        },
+    }
+    _line("serve_cold_ttfc_speedup", speedup_ready, None, detail)
+
+
 def bench_mm1_single():
     """BASELINE configs[0] twin: ``benchmark/MM1_single.c`` — ONE
     replication, the single-stream latency number (reference: ~32M
@@ -1521,6 +1746,7 @@ CONFIGS = {
     "mm1_stream": bench_mm1_stream,
     "mm1_single": bench_mm1_single,
     "serve": bench_serve,
+    "serve_cold": bench_serve_cold,
     "serve_mixed": bench_serve_mixed,
     "mmc": bench_mmc,
     "mg1": bench_mg1,

@@ -175,3 +175,13 @@ def build(
 
 def params(n_jobs: int, arr_mean: float = 1.0, work_mean: float = 0.4):
     return (arr_mean, work_mean, n_jobs)
+
+
+def summary_path(sims):
+    """The model's canonical pooled statistic — the per-replication
+    completion-time summary (jobshop records no ``wait``, so the
+    runner's ``default_summary_path`` does not apply).  A NAMED
+    module-level function: the stream fold program, the serving
+    compatibility key, and the program store's fold artifacts all key
+    on its identity/content."""
+    return sims.user["done"]

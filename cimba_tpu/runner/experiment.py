@@ -411,6 +411,11 @@ def run_experiment_chunked(
     """
     import os as _os
 
+    from cimba_tpu.serve import store as _pstore
+
+    # CIMBA_PROGRAM_STORE: recompiles on this path become disk hits
+    # (docs/15_program_store.md mechanism (a); no-op when unset)
+    _pstore.maybe_enable_persistent_cache()
     pb = _broadcast_params(params, n_replications)
     reps = jnp.arange(n_replications)
     if mesh is not None and n_replications % mesh.devices.size:
@@ -535,6 +540,13 @@ def run_experiment_stream(
     a fresh :class:`cimba_tpu.serve.cache.ProgramCache` — a bounded LRU
     with hit/miss/eviction counters (``CIMBA_PROGRAM_CACHE_CAP``);
     plain dicts keep working for legacy callers but never evict.
+
+    Cold starts: with ``CIMBA_PROGRAM_STORE`` set (or a cache whose
+    ``store=`` names a :class:`~cimba_tpu.serve.store.ProgramStore`),
+    a cache miss hydrates serialized executables from disk before
+    compiling, and every jit on this path additionally rides jax's
+    persistent compilation cache — a fresh process reaches its first
+    result without re-paying XLA compile (docs/15_program_store.md).
     """
     import dataclasses
 

@@ -16,12 +16,22 @@ retry-with-backoff.
         result = h.result()          # a runner.experiment.StreamResult
 
 Submodules: :mod:`~cimba_tpu.serve.cache` (the bounded shared program
-cache), :mod:`~cimba_tpu.serve.sched` (queue/deadline/retry policy),
+cache), :mod:`~cimba_tpu.serve.store` (the persistent AOT program
+store — ``CIMBA_PROGRAM_STORE`` hydrates a fresh process to
+warm-serving without recompiling, docs/15_program_store.md),
+:mod:`~cimba_tpu.serve.sched` (queue/deadline/retry policy),
 :mod:`~cimba_tpu.serve.service` (the dispatcher),
 :mod:`~cimba_tpu.serve.client` (synthetic load drivers).
 """
 
 from cimba_tpu.serve.cache import ProgramCache, warm
+from cimba_tpu.serve.store import (
+    ProgramStore,
+    StoreInvalidationWarning,
+    UnstableStoreKey,
+    default_store,
+    maybe_enable_persistent_cache,
+)
 from cimba_tpu.serve.client import (
     LoadReport,
     RequestTemplate,
@@ -44,6 +54,8 @@ from cimba_tpu.serve.service import Request, ResultHandle, Service
 
 __all__ = [
     "ProgramCache", "warm",
+    "ProgramStore", "StoreInvalidationWarning", "UnstableStoreKey",
+    "default_store", "maybe_enable_persistent_cache",
     "LoadReport", "RequestTemplate", "percentile",
     "run_load", "run_mixed_load", "mixed_requests",
     "AdmissionQueue", "Backoff",

@@ -74,15 +74,33 @@ class ProgramCache(MutableMapping):
       accessor the runner and service use).
     """
 
-    def __init__(self, capacity: Optional[int] = None):
+    def __init__(self, capacity: Optional[int] = None, *, store=None):
         self._cap = default_capacity() if capacity is None else int(capacity)
         if self._cap <= 0:
             raise ValueError(f"capacity must be positive, got {self._cap}")
         self._od: OrderedDict = OrderedDict()
         self._lock = threading.RLock()
+        self._store = store
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    @property
+    def store(self):
+        """The persistent AOT program store behind this cache
+        (docs/15_program_store.md): an explicit
+        :class:`~cimba_tpu.serve.store.ProgramStore`, or — when the
+        constructor got ``store=None`` — whatever
+        ``CIMBA_PROGRAM_STORE`` names *right now* (resolved per lookup,
+        so a cache built before the env var is irrelevant; pass
+        ``store=False`` to opt a cache out entirely)."""
+        if self._store is False:
+            return None
+        if self._store is not None:
+            return self._store
+        from cimba_tpu.serve import store as _pstore
+
+        return _pstore.default_store()
 
     # -- mapping protocol ---------------------------------------------------
 
@@ -146,13 +164,17 @@ class ProgramCache(MutableMapping):
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "capacity": self._cap,
                 "size": len(self._od),
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
             }
+        st = self.store
+        if st is not None:
+            out["store"] = st.stats()
+        return out
 
 
 def _get_or_create(programs: MutableMapping, key, factory):
@@ -293,14 +315,46 @@ def get_programs(
     (jit re-specializes per wave shape internally, so full waves share
     one compile).  The chunk program is built with ``t_end=None``: the
     horizon is the per-lane ``t_stop`` column the init program plants
-    (see ``Sim.t_stop``).  Returns ``(init_j, chunk_j)``."""
+    (see ``Sim.t_stop``).  Returns ``(init_j, chunk_j)``.
+
+    A memory miss gets a SECOND-CHANCE lookup in the persistent AOT
+    program store (docs/15_program_store.md) before compiling: when the
+    cache (or ``CIMBA_PROGRAM_STORE``) names a store holding a valid
+    artifact for this program key, the entry hydrates deserialized
+    executables instead of tracing and invoking XLA — the
+    zero-cold-start path.  Every store failure mode (corrupt artifact,
+    version/backend drift, unstable fingerprint, plain bug) degrades to
+    the compile below, never to a wrong program."""
+    from cimba_tpu.serve import store as _pstore
+
+    _pstore.maybe_enable_persistent_cache()
     key = program_key(
         spec, with_metrics, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
     )
 
     def build():
+        import warnings as _warnings
+
         from cimba_tpu.runner import experiment as ex
 
+        st = getattr(programs, "store", None)
+        if st is None and not isinstance(programs, ProgramCache):
+            st = _pstore.default_store()
+        if st is not None:
+            try:
+                hyd = st.hydrate(
+                    spec, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
+                    with_metrics=with_metrics,
+                )
+            except Exception as e:  # a store bug must never block serving
+                _warnings.warn(
+                    f"program store lookup failed ({type(e).__name__}: "
+                    f"{e}); compiling instead",
+                    _pstore.StoreInvalidationWarning,
+                )
+                hyd = None
+            if hyd is not None:
+                return (hyd[0], hyd[1], spec)
         return (
             ex._init_program(spec, mesh),
             ex._chunk_program(spec, None, pack, chunk_steps, mesh),
@@ -316,43 +370,57 @@ def get_fold(programs: MutableMapping, with_metrics: bool, summary_path):
     summary, failure count, event total, and (when enabled) pooled
     metrics registry into the accumulator tuple.  Keyed by the metrics
     flag and ``summary_path`` identity — a different statistic is a
-    different program."""
+    different program.  Folds have no explicit store artifact, but
+    ``CIMBA_PROGRAM_STORE`` still softens their recompile to a disk
+    hit via jax's persistent compilation cache (mechanism (a),
+    docs/15_program_store.md)."""
+    from cimba_tpu.serve import store as _pstore
+
+    _pstore.maybe_enable_persistent_cache()
     key = ("fold", with_metrics, summary_path)
 
     def build():
-        import jax
-        import jax.numpy as jnp
-
-        from cimba_tpu.obs import metrics as _metrics
-        from cimba_tpu.stats import summary as sm
-
-        def fold(acc, sims):
-            if (sims.metrics is None) == with_metrics:
-                raise RuntimeError(
-                    "run_experiment_stream: obs.metrics was "
-                    f"{'enabled' if with_metrics else 'disabled'} when "
-                    "the stream started but flipped mid-stream — the "
-                    "flag binds for the whole stream"
-                )
-            pooled = sm.merge_tree(summary_path(sims))
-            out = (
-                sm.merge(acc[0], pooled),
-                acc[1] + jnp.sum((sims.err != 0).astype(jnp.int64)),
-                acc[2] + jnp.sum(sims.n_events.astype(jnp.int64)),
-            )
-            if with_metrics:
-                out = out + (
-                    _metrics.merge(acc[3], _metrics.pool(sims.metrics)),
-                )
-            return out
-
-        # no donation on the accumulator: its leaves are scalars
-        # (aliasing buys nothing) and sm.empty() aliases one zero buffer
-        # across moments, which XLA's donation path rejects as a
-        # double-donate
-        return jax.jit(fold)
+        return _fold_program(with_metrics, summary_path)
 
     return _get_or_create(programs, key, build)
+
+
+def _fold_program(with_metrics: bool, summary_path):
+    """Build the jitted wave-fold program (the body of
+    :func:`get_fold`, factored out so the store can AOT-compile it for
+    fold artifacts and ``warm(manifest=...)`` can wrap it in a
+    hydration shim)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cimba_tpu.obs import metrics as _metrics
+    from cimba_tpu.stats import summary as sm
+
+    def fold(acc, sims):
+        if (sims.metrics is None) == with_metrics:
+            raise RuntimeError(
+                "run_experiment_stream: obs.metrics was "
+                f"{'enabled' if with_metrics else 'disabled'} when "
+                "the stream started but flipped mid-stream — the "
+                "flag binds for the whole stream"
+            )
+        pooled = sm.merge_tree(summary_path(sims))
+        out = (
+            sm.merge(acc[0], pooled),
+            acc[1] + jnp.sum((sims.err != 0).astype(jnp.int64)),
+            acc[2] + jnp.sum(sims.n_events.astype(jnp.int64)),
+        )
+        if with_metrics:
+            out = out + (
+                _metrics.merge(acc[3], _metrics.pool(sims.metrics)),
+            )
+        return out
+
+    # no donation on the accumulator: its leaves are scalars
+    # (aliasing buys nothing) and sm.empty() aliases one zero buffer
+    # across moments, which XLA's donation path rejects as a
+    # double-donate
+    return jax.jit(fold)
 
 
 def stream_acc(spec, with_metrics: bool):
@@ -432,17 +500,116 @@ def warm(
     spec,
     params,
     wave_size: int,
+    *,
+    manifest=None,
     **stream_kwargs,
 ):
-    """Optional warm-up precompile: run ONE full wave through the
-    stream runner against ``cache``, so a service built over the same
-    cache (and a structurally-identical spec / settings — seed and
-    horizon don't matter, they are per-lane data) serves its first
-    real request from already-compiled programs.  Returns the warm-up
-    wave's ``StreamResult`` (callers usually discard it)."""
+    """Warm-up precompile, two modes.
+
+    Default (``manifest=None``): run ONE full wave through the stream
+    runner against ``cache``, so a service built over the same cache
+    (and a structurally-identical spec / settings — seed and horizon
+    don't matter, they are per-lane data) serves its first real
+    request from already-compiled programs.  Returns the warm-up
+    wave's ``StreamResult`` (callers usually discard it).
+
+    AOT mode (``manifest=`` a store root path or
+    :class:`~cimba_tpu.serve.store.ProgramStore`): no dummy wave — the
+    (spec, settings) program key hydrates from the store's serialized
+    executables straight into ``cache`` (docs/15_program_store.md).  A
+    missing or invalidated entry raises ``LookupError`` LOUDLY — a
+    fleet rollout must find out at warm time, not discover a
+    minutes-long compile on its first request — and the store's
+    counters say why (corrupt / version drift / plain miss).  Returns
+    the :class:`~cimba_tpu.serve.store.ProgramStore`.
+
+    The wave-FOLD program hydrates too when the store carries a fold
+    artifact for ``summary_path`` (saved by default —
+    ``ProgramStore.save_programs(summary_paths=...)``); with no fold
+    artifact and ``params`` given, the fold is instead built on THIS
+    thread with one fold application over an init'd (never
+    chunk-driven) wave of ``wave_size`` lanes — deferring it to the
+    service's dispatcher thread costs several times the main-thread
+    build (measured ~4.6x on the CPU window, BENCH_NOTES round 8).
+    Pass ``params=None`` to hydrate strictly from artifacts."""
     from cimba_tpu.runner import experiment as ex
 
-    return ex.run_experiment_stream(
-        spec, params, wave_size, wave_size=wave_size,
-        program_cache=cache, **stream_kwargs,
+    if manifest is None:
+        return ex.run_experiment_stream(
+            spec, params, wave_size, wave_size=wave_size,
+            program_cache=cache, **stream_kwargs,
+        )
+
+    from cimba_tpu.obs import metrics as _metrics
+    from cimba_tpu.serve import store as _pstore
+
+    st = (
+        manifest if isinstance(manifest, _pstore.ProgramStore)
+        else _pstore.get_store(str(manifest))
     )
+    if isinstance(cache, ProgramCache) and cache._store is None:
+        # bind the cache to THIS store so later lookups (and the
+        # service's stats) hit the same instance/counters the warm did
+        cache._store = st
+    mesh = stream_kwargs.pop("mesh", None)
+    pack = stream_kwargs.pop("pack", None)
+    chunk_steps = stream_kwargs.pop("chunk_steps", 1024)
+    summary_path = stream_kwargs.pop("summary_path", None)
+    if stream_kwargs:
+        raise TypeError(
+            "serve.warm(manifest=...): unsupported kwargs in AOT mode: "
+            f"{sorted(stream_kwargs)} (only mesh/pack/chunk_steps/"
+            "summary_path select a program)"
+        )
+    if summary_path is None:
+        summary_path = ex.default_summary_path
+    with_metrics = _metrics.enabled()
+    key = program_key(
+        spec, with_metrics, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
+    )
+    folds: dict = {}
+    if key not in cache:
+        hyd = st.hydrate(
+            spec, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
+            with_metrics=with_metrics,
+        )
+        if hyd is None:
+            raise LookupError(
+                f"serve.warm(manifest=...): the store at {st.root} has "
+                "no loadable artifact for this (spec, settings) "
+                "program key — build one with tools/warm_store.py "
+                f"(store stats: {st.stats()})"
+            )
+        # deserialize NOW, on the calling thread: lazy resolution would
+        # land on the service's dispatcher thread, which pays ~4.6x
+        hyd.init.resolve_all()
+        hyd.chunk.resolve_all()
+        cache[key] = (hyd.init, hyd.chunk, spec)
+        folds = hyd.folds
+
+    fold_key = ("fold", with_metrics, summary_path)
+    if fold_key not in cache:
+        try:
+            pdig = _pstore.callable_digest(summary_path)
+        except _pstore.UnstableStoreKey:
+            pdig = None
+        table = {
+            shape: fn for (d, shape), fn in folds.items() if d == pdig
+        }
+        fold_j = _fold_program(with_metrics, summary_path)
+        if table:
+            for art in table.values():
+                art.resolve()
+            cache[fold_key] = _pstore.hydrated_fold(fold_j, table, st)
+        elif params is not None and wave_size:
+            # no fold artifact: build it HERE (main thread) with one
+            # fold application over an init'd, never-driven wave
+            cache[fold_key] = fold_j
+            n = int(wave_size)
+            init_fn = cache[key][0]
+            sims0 = init_fn(
+                ex.jnp.arange(n), ex._seed_column(0, n), None,
+                ex._slice_params(params, n, 0, n),
+            )
+            fold_j(stream_acc(spec, with_metrics), sims0)
+    return st

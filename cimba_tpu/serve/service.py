@@ -419,6 +419,13 @@ class Service:
             }
         if hasattr(self.cache, "stats"):
             out["program_cache"] = self.cache.stats()
+            # the persistent AOT store's hit/miss/downgrade counters,
+            # surfaced top-level too (docs/15_program_store.md): a
+            # fleet health check reads ONE dict to see whether rollouts
+            # are serving from artifacts or silently recompiling
+            store_stats = out["program_cache"].get("store")
+            if store_stats is not None:
+                out["program_store"] = store_stats
         return out
 
     def chrome_trace(self) -> dict:

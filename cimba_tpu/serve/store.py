@@ -1,0 +1,914 @@
+"""The persistent AOT program store — zero-cold-start serving.
+
+The bounded in-memory :class:`~cimba_tpu.serve.cache.ProgramCache` dies
+with the process: at production scale every rollout re-pays minutes of
+XLA compile per (spec, settings) program point before the first request
+is served.  This module makes the **compiler artifact** the unit of
+caching (the "Compiler-First … Portable O(1) Caching for Inference"
+frame, PAPERS.md): compiled executables are serialized against a frozen,
+*value-based* program key and a fresh process hydrates to warm-serving
+without ever invoking XLA.  Two mechanisms, layered
+(docs/15_program_store.md):
+
+(a) **JAX's persistent compilation cache** — :func:`maybe_enable_
+    persistent_cache` wires ``jax_compilation_cache_dir`` to
+    ``<store>/xla`` whenever ``CIMBA_PROGRAM_STORE`` is set, so *every*
+    jit on the streaming/serving path (init/chunk/fold and anything
+    else) transparently becomes a disk hit on recompile.  This
+    mechanism keys on jax's own HLO fingerprint and needs no help from
+    us; it saves the XLA compile but still re-pays tracing and jax's
+    dispatch-path setup per program.
+
+(b) **The explicit artifact layer** — :class:`ProgramStore` AOT-
+    compiles the ``(init, chunk)`` program pair per wave shape
+    (``jit.lower(...).compile()``), serializes the loaded executables
+    (``jax.experimental.serialize_executable``), and records them in a
+    manifest under :func:`store_key` — a sha256 over the spec's
+    **stable fingerprint** (functions hashed by code + closure
+    *values*, never ``id()`` — entries must survive a process
+    boundary, unlike the in-memory key) plus every trace-time setting
+    the program bakes in.  Hydration returns shim callables that
+    dispatch stored shapes straight to the deserialized executable and
+    fall back to an ordinary ``jax.jit`` (mechanism (a) softening the
+    recompile) for shapes the store has never seen.
+
+Invalidation is strict and LOUD — the same contract as the
+dispatch-time key verification in ``serve/service.py``: a jax/jaxlib
+version bump, backend/platform drift, manifest-format bump, checksum
+mismatch, truncated pickle, or fingerprint drift each produce a counted
+miss (and a :class:`StoreInvalidationWarning` where there is a body to
+point at), **never a wrong program and never a crash** — every failure
+path degrades to recompiling exactly what the cache would have compiled
+anyway.  When an executable cannot be serialized at save time (e.g. a
+backend whose PjRt client does not implement executable serialization),
+the entry records a **downgrade**: mechanism (a) still covers that
+program, and ``stats()["downgrades"]`` says so instead of crashing the
+save.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import types
+import warnings
+from typing import Any, Optional
+
+#: environment knob: the store root directory.  Setting it makes
+#: :func:`default_store` attach a :class:`ProgramStore` to every
+#: :class:`~cimba_tpu.serve.cache.ProgramCache` lookup AND wires jax's
+#: persistent compilation cache under ``<root>/xla``.
+STORE_ENV = "CIMBA_PROGRAM_STORE"
+
+#: minimum compile seconds for mechanism (a)'s disk entries (0 = cache
+#: everything, the zero-cold-start deploy default).
+XLA_MIN_S_ENV = "CIMBA_PROGRAM_STORE_XLA_MIN_S"
+
+#: manifest format version: bump on any layout/semantic change — old
+#: stores then invalidate loudly instead of deserializing garbage.
+FORMAT = 1
+
+MANIFEST = "manifest.json"
+ARTIFACT_DIR = "artifacts"
+
+
+class StoreInvalidationWarning(UserWarning):
+    """A store entry was rejected (corrupt, truncated, or from a
+    different jax/backend/format) and the program will be recompiled."""
+
+
+class UnstableStoreKey(Exception):
+    """The spec's structure cannot be fingerprinted by value (e.g. a
+    block closes over an object with no deterministic content digest),
+    so it has no process-independent store identity.  The in-memory
+    cache still works; the store records a downgrade."""
+
+
+# -- the value-based fingerprint ---------------------------------------------
+#
+# The in-memory ``cache.spec_fingerprint`` keys function-valued
+# structure by ``id()`` — correct within one process (entries pin their
+# spec against id recycling) but meaningless across a process boundary.
+# The store's fingerprint digests functions by VALUE: module, qualname,
+# bytecode, recursively-resolved constants, defaults, and closure cell
+# *contents*.  A spec rebuilt from the same source in a fresh process
+# (or a ``dataclasses.replace`` twin) digests identically; a model
+# whose code or closed-over values changed digests differently and
+# misses — never a wrong program.
+
+
+def _stable_code(code: types.CodeType, seen: dict) -> tuple:
+    consts = tuple(
+        _stable_code(c, seen) if isinstance(c, types.CodeType)
+        else _stable_obj(c, seen)
+        for c in code.co_consts
+    )
+    return (
+        "code", code.co_code, consts, code.co_names, code.co_varnames,
+        code.co_freevars, code.co_argcount, code.co_kwonlyargcount,
+        code.co_flags,
+    )
+
+
+def _stable_callable(fn, seen: dict) -> tuple:
+    import functools
+
+    if isinstance(fn, functools.partial):
+        kw = tuple(sorted((fn.keywords or {}).items()))
+        return (
+            "partial", _stable_callable(fn.func, seen),
+            _stable_obj(tuple(fn.args), seen), _stable_obj(kw, seen),
+        )
+    if isinstance(fn, types.MethodType):
+        # a bound method's behavior depends on its instance too
+        return (
+            "method", _stable_callable(fn.__func__, seen),
+            _stable_obj(fn.__self__, seen),
+        )
+    if id(fn) in seen:
+        # revisited callable (a closure cycle, or one function shared
+        # by several slots): a back-reference to its first-visit
+        # ordinal, NOT a bare marker — (f, g, f) and (f, g, g) must
+        # digest differently or two different models could share a
+        # store key and hydrate each other's programs
+        return ("ref", seen[id(fn)])
+    seen[id(fn)] = len(seen)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        mod = getattr(fn, "__module__", None)
+        qn = getattr(fn, "__qualname__", None) or getattr(
+            fn, "__name__", None
+        )
+        if qn is None:
+            raise UnstableStoreKey(
+                f"callable {fn!r} has no code object and no qualified "
+                "name — it cannot be fingerprinted by value"
+            )
+        return ("c", mod, qn)
+    cells: tuple = ()
+    if fn.__closure__:
+        cells = tuple(
+            _stable_obj(c.cell_contents, seen) for c in fn.__closure__
+        )
+    defaults = (
+        None if fn.__defaults__ is None
+        else _stable_obj(tuple(fn.__defaults__), seen)
+    )
+    return (
+        "fn", fn.__module__, fn.__qualname__, _stable_code(code, seen),
+        cells, defaults,
+    )
+
+
+def _stable_obj(v, seen: dict) -> tuple:
+    """A deterministic, process-independent digestable view of ``v``.
+    Raises :class:`UnstableStoreKey` for anything whose repr would
+    embed a memory address — a weak component would let two different
+    models share a store slot, which is the one failure mode the store
+    must never have."""
+    import numpy as np
+
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return ("p", repr(v))
+    if isinstance(v, np.ndarray):
+        return ("nd", str(v.dtype), v.shape, v.tobytes())
+    if isinstance(v, np.generic):
+        return ("ns", str(v.dtype), v.tobytes())
+    if isinstance(v, np.dtype):
+        return ("dt", str(v))
+    if isinstance(v, (list, tuple)):
+        return (
+            "seq", type(v).__name__,
+            tuple(_stable_obj(x, seen) for x in v),
+        )
+    if isinstance(v, (set, frozenset)):
+        return (
+            "set", tuple(sorted(_stable_obj(x, seen) for x in v)),
+        )
+    if isinstance(v, dict):
+        items = sorted(
+            ((_stable_obj(k, seen), _stable_obj(x, seen))
+             for k, x in v.items())
+        )
+        return ("map", tuple(items))
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (
+            "dc", type(v).__module__, type(v).__qualname__,
+            tuple(
+                (f.name, _stable_obj(getattr(v, f.name), seen))
+                for f in dataclasses.fields(v)
+            ),
+        )
+    try:
+        import jax
+
+        if isinstance(v, jax.Array):
+            a = np.asarray(v)
+            return ("jx", str(a.dtype), a.shape, a.tobytes())
+    except Exception:
+        pass
+    if callable(v):
+        return _stable_callable(v, seen)
+    raise UnstableStoreKey(
+        f"{type(v).__module__}.{type(v).__qualname__} has no "
+        "deterministic value digest — the spec closing over it cannot "
+        "be stored persistently"
+    )
+
+
+def stable_spec_fingerprint(spec) -> tuple:
+    """The VALUE-based structural identity of a ModelSpec — the
+    persistent twin of ``cache.spec_fingerprint`` with every ``id()``
+    replaced by a content digest, so a spec reconstructed in a fresh
+    process (or a ``dataclasses.replace`` twin) maps to the same store
+    entry.  Raises :class:`UnstableStoreKey` when any function-valued
+    structure resists value fingerprinting."""
+    import numpy as np
+
+    cached = getattr(spec, "_cimba_stable_fingerprint", None)
+    if cached is not None:
+        return cached
+
+    seen: dict = {}  # id -> first-visit ordinal (back-references)
+    fp = (
+        spec.name,
+        tuple(_stable_callable(b, seen) for b in spec.blocks),
+        np.asarray(spec.proc_entry).tobytes(),
+        np.asarray(spec.proc_prio).tobytes(),
+        np.asarray(spec.proc_start).tobytes(),
+        tuple(spec.proc_names),
+        tuple(_stable_obj(q, seen) for q in spec.queues),
+        tuple(_stable_obj(r, seen) for r in spec.resources),
+        tuple(_stable_obj(p, seen) for p in spec.pools),
+        tuple(_stable_obj(b, seen) for b in spec.buffers),
+        tuple(_stable_obj(q, seen) for q in spec.pqueues),
+        tuple(_stable_obj(c, seen) for c in spec.conditions),
+        spec.n_guards, spec.guard_cap, spec.event_cap,
+        spec.queue_cap_max, spec.pqueue_cap_max,
+        spec.n_flocals, spec.n_ilocals, spec.max_chain,
+        None if spec.user_init is None
+        else _stable_callable(spec.user_init, seen),
+        tuple(_stable_callable(h, seen) for h in spec.user_handlers),
+        tuple(spec.boundary_pcs),
+    )
+    try:
+        object.__setattr__(spec, "_cimba_stable_fingerprint", fp)
+    except (AttributeError, TypeError):
+        pass  # slotted/frozen spec: recompute per call
+    return fp
+
+
+def callable_digest(fn) -> str:
+    """The stable content digest of one callable (sha256 hex) — how
+    fold artifacts are keyed to their ``summary_path`` across process
+    boundaries.  Raises :class:`UnstableStoreKey` when the callable
+    resists value fingerprinting."""
+    return hashlib.sha256(
+        repr(_stable_callable(fn, {})).encode("utf-8")
+    ).hexdigest()
+
+
+def _mesh_descriptor(mesh) -> Optional[tuple]:
+    if mesh is None:
+        return None
+    kinds = sorted(
+        {
+            f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+            for d in mesh.devices.flat
+        }
+    )
+    return (
+        "mesh", tuple(mesh.axis_names), tuple(mesh.devices.shape),
+        tuple(kinds),
+    )
+
+
+def store_key(
+    spec, with_metrics: bool, *, mesh, pack, chunk_steps: int,
+) -> str:
+    """The persistent program key: sha256 hex over the stable spec
+    fingerprint plus every trace-time setting a compiled program bakes
+    in — the value-based image of ``cache.program_key`` (same field
+    set, trace-time globals resolved NOW), so "same store key" implies
+    "same program" exactly as it does in memory.  Raises
+    :class:`UnstableStoreKey` when the spec has no value identity."""
+    from cimba_tpu import config as _config
+    from cimba_tpu.obs import trace as _trace
+
+    key = (
+        FORMAT,
+        stable_spec_fingerprint(spec),
+        _config.active_profile(),
+        bool(with_metrics),
+        bool(pack if pack is not None else _config.xla_pack_enabled()),
+        _trace.enabled(),
+        _config.eventset_hier_enabled(),
+        _config.eventset_block(),
+        _mesh_descriptor(mesh),
+        int(chunk_steps),
+    )
+    return hashlib.sha256(repr(key).encode("utf-8")).hexdigest()
+
+
+def _environment() -> dict:
+    """The strict-match environment guard recorded per entry: an
+    executable is an opaque backend artifact, so ANY drift here
+    invalidates rather than risking a misload."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "n_devices": jax.device_count(),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def _args_sig_digest(args) -> str:
+    """The shape signature of one compiled specialization: pytree
+    structure plus per-leaf (dtype, shape, weak_type).  The hydration
+    shim dispatches to a stored executable only on an EXACT match —
+    anything else falls back to jit, never to a near-miss program."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = (
+        str(treedef),
+        tuple(
+            (str(a.dtype), tuple(a.shape), bool(a.weak_type))
+            for a in map(shaped_abstractify, leaves)
+        ),
+    )
+    return hashlib.sha256(repr(sig).encode("utf-8")).hexdigest()
+
+
+# -- mechanism (a): jax's persistent compilation cache ------------------------
+
+_XLA_WIRED: Optional[str] = None
+
+
+def maybe_enable_persistent_cache(root: Optional[str] = None):
+    """Wire jax's persistent compilation cache under ``<root>/xla``
+    (mechanism (a)).  ``root=None`` reads ``CIMBA_PROGRAM_STORE`` and
+    no-ops when unset — safe to call on every streaming/serving entry
+    point.  Idempotent; re-wires if the root changes.  Returns the
+    cache dir (or None)."""
+    global _XLA_WIRED
+    import jax
+
+    if root is None:
+        root = os.environ.get(STORE_ENV, "").strip() or None
+        if root is None:
+            return None
+    xdir = os.path.join(os.path.abspath(root), "xla")
+    if _XLA_WIRED == xdir:
+        return xdir
+    os.makedirs(xdir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", xdir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get(XLA_MIN_S_ENV, "0")),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _XLA_WIRED = xdir
+    return xdir
+
+
+# -- the store ----------------------------------------------------------------
+
+_STORES: dict = {}
+
+
+def get_store(root: str) -> "ProgramStore":
+    """The process-wide :class:`ProgramStore` for ``root`` — one
+    instance per (absolute) root, so hit/miss counters aggregate across
+    every cache and ``serve.warm`` call in the process, which is what
+    ``Service.stats()`` reports."""
+    key = os.path.abspath(root)
+    st = _STORES.get(key)
+    if st is None:
+        st = _STORES[key] = ProgramStore(key)
+    return st
+
+
+def default_store() -> Optional["ProgramStore"]:
+    """The process-wide store named by ``CIMBA_PROGRAM_STORE`` (None
+    when unset)."""
+    root = os.environ.get(STORE_ENV, "").strip()
+    if not root:
+        return None
+    return get_store(root)
+
+
+class _LazyArtifact:
+    """One checksum-verified artifact blob whose
+    ``deserialize_and_load`` is deferred until first use (and memoized).
+    Hydration reads+verifies every blob eagerly — corruption is still
+    detected at hydrate time — but a lookup that only ever dispatches
+    one wave shape never pays deserialization for the others.
+    ``serve.warm(manifest=...)`` resolves eagerly on the calling
+    thread (deserialization measured ~4.6x slower on the dispatcher
+    thread, BENCH_NOTES round 8)."""
+
+    __slots__ = ("_blob", "_loaded", "file")
+
+    def __init__(self, blob: bytes, file: str):
+        self._blob = blob
+        self._loaded = None
+        self.file = file
+
+    def resolve(self):
+        if self._loaded is None:
+            from jax.experimental import serialize_executable as _se
+
+            self._loaded = _se.deserialize_and_load(
+                *pickle.loads(self._blob)
+            )
+            self._blob = None
+        return self._loaded
+
+
+class HydratedPrograms(tuple):
+    """What :meth:`ProgramStore.hydrate` returns: ``(init, chunk)``
+    shims plus the loaded fold executables keyed by
+    ``(summary_path digest, shape digest)`` — indexable like the old
+    2-tuple (``hyd[0]``/``hyd[1]``) for ``get_programs``."""
+
+    __slots__ = ()
+
+    def __new__(cls, init, chunk, folds):
+        return tuple.__new__(cls, (init, chunk, folds))
+
+    @property
+    def init(self):
+        return self[0]
+
+    @property
+    def chunk(self):
+        return self[1]
+
+    @property
+    def folds(self) -> dict:
+        return self[2]
+
+
+def hydrated_fold(jit_fn, table: dict, store: "ProgramStore"):
+    """Wrap a jitted fold program with a store-artifact dispatch table
+    (the ``serve.warm(manifest=...)`` fold path)."""
+    return _HydratedProgram(jit_fn, table, store, "fold")
+
+
+class _HydratedProgram:
+    """A callable standing where a jitted ``init``/``chunk`` program
+    stands: stored shapes dispatch straight to the deserialized
+    executable (zero compiles); unseen shapes — and abstract tracers,
+    e.g. the preflight's ``eval_shape`` — fall back to the wrapped
+    ``jax.jit`` program, which mechanism (a) softens to a disk hit."""
+
+    __slots__ = ("_jit", "_table", "_store", "_role", "_fallback_seen")
+
+    def __init__(self, jit_fn, table: dict, store: "ProgramStore",
+                 role: str):
+        self._jit = jit_fn
+        self._table = table
+        self._store = store
+        self._role = role
+        self._fallback_seen: set = set()
+
+    def __call__(self, *args):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+        if any(isinstance(x, jax.core.Tracer) for x in leaves):
+            return self._jit(*args)
+        sig = _args_sig_digest(args)
+        art = self._table.get(sig)
+        if art is None:
+            if sig not in self._fallback_seen:
+                self._fallback_seen.add(sig)
+                self._store._count("fallback_shapes")
+            return self._jit(*args)
+        try:
+            fn = art.resolve()
+        except Exception as e:
+            # a blob that checksummed but won't deserialize: reject
+            # loudly, drop it, and recompile — never serve a maybe
+            self._table.pop(sig, None)
+            warnings.warn(
+                f"program store artifact {art.file} failed to "
+                f"deserialize ({type(e).__name__}: {e}); recompiling",
+                StoreInvalidationWarning,
+            )
+            self._store._count("corrupt")
+            return self._jit(*args)
+        self._store._count("artifact_dispatches")
+        return fn(*args)
+
+    def resolve_all(self) -> None:
+        """Eagerly deserialize every stored shape (the
+        ``serve.warm(manifest=...)`` main-thread path)."""
+        for art in self._table.values():
+            art.resolve()
+
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+
+class ProgramStore:
+    """A directory of serialized compiled programs keyed by
+    :func:`store_key`, with a JSON manifest and strict invalidation.
+
+    Layout::
+
+        <root>/manifest.json     entries: key -> {env, programs, meta}
+        <root>/artifacts/*.bin   pickled (payload, in_tree, out_tree)
+        <root>/xla/              mechanism (a)'s compilation cache
+
+    Writes are crash-atomic (mkstemp + fsync + ``os.replace`` — the
+    checkpoint discipline): a killed save leaves the previous manifest
+    intact, and a torn artifact fails its checksum on load instead of
+    deserializing garbage."""
+
+    def __init__(self, root: str, *, enable_xla_cache: bool = True):
+        self.root = os.path.abspath(root)
+        os.makedirs(os.path.join(self.root, ARTIFACT_DIR), exist_ok=True)
+        if enable_xla_cache:
+            maybe_enable_persistent_cache(self.root)
+        # RLock: _read_manifest counts corrupt/invalidated manifests
+        # via _count while hydrate/save/covered already hold the lock
+        self._lock = threading.RLock()
+        self._stats = {
+            "saves": 0,
+            "hits": 0,
+            "misses": 0,
+            "invalidated": 0,
+            "corrupt": 0,
+            "downgrades": 0,
+            "fallback_shapes": 0,
+            "artifact_dispatches": 0,
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[name] += n
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        out["root"] = self.root
+        return out
+
+    # -- manifest ------------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, MANIFEST)
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path(), "r") as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return {"format": FORMAT, "entries": {}}
+        except (json.JSONDecodeError, OSError) as e:
+            warnings.warn(
+                f"program store manifest at {self._manifest_path()} is "
+                f"unreadable ({e!r}); treating the store as empty",
+                StoreInvalidationWarning,
+            )
+            self._count("corrupt")
+            return {"format": FORMAT, "entries": {}}
+        if m.get("format") != FORMAT:
+            warnings.warn(
+                f"program store manifest format {m.get('format')!r} != "
+                f"{FORMAT} — the whole store is invalidated (rebuild "
+                "with tools/warm_store.py)",
+                StoreInvalidationWarning,
+            )
+            self._count("invalidated")
+            return {"format": FORMAT, "entries": {}}
+        return m
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        import tempfile
+
+        d = os.path.dirname(path)
+        fd, tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _write_manifest(self, manifest: dict) -> None:
+        self._atomic_write(
+            self._manifest_path(),
+            json.dumps(manifest, indent=1, sort_keys=True).encode(),
+        )
+
+    # -- save ----------------------------------------------------------------
+
+    def save_programs(
+        self,
+        spec,
+        params: Any,
+        n_replications: int,
+        *,
+        wave_sizes,
+        mesh=None,
+        pack=None,
+        chunk_steps: int = 1024,
+        with_metrics: Optional[bool] = None,
+        horizon_modes=("none", "column"),
+        summary_paths=None,
+        seed: int = 0,
+    ) -> dict:
+        """AOT-compile and serialize the ``(init, chunk)`` pair for
+        every ``wave_sizes`` × ``horizon_modes`` point of this (spec,
+        settings) program key, exactly as the stream runner / service
+        would dispatch them (``horizon_modes``: ``"none"`` = the
+        run-to-completion pytree without the ``t_stop`` leaf, the
+        stream default; ``"column"`` = the per-lane horizon column the
+        serving layer's padded / finite-horizon waves carry).
+        ``summary_paths`` (default: the runner's
+        ``default_summary_path``) additionally compiles + serializes
+        the wave-FOLD program per path × shape, keyed by the path's
+        :func:`callable_digest` — so ``serve.warm(manifest=...)``
+        reaches first-request readiness with zero executions; pass
+        ``()`` to skip folds.  Returns a report dict with per-program
+        compile seconds and artifact bytes.  A program whose
+        executable cannot be serialized (or a fold whose path is
+        unstable / fails to trace on this model) records a
+        **downgrade** (mechanism (a) still covers it) instead of
+        raising; only an unstable spec fingerprint raises
+        (:class:`UnstableStoreKey` — there is no key to save under)."""
+        import jax
+        from jax.experimental import serialize_executable as _se
+
+        from cimba_tpu.obs import metrics as _metrics
+        from cimba_tpu.runner import experiment as ex
+
+        if with_metrics is None:
+            with_metrics = _metrics.enabled()
+        key = store_key(
+            spec, with_metrics, mesh=mesh, pack=pack,
+            chunk_steps=chunk_steps,
+        )
+        init_j = ex._init_program(spec, mesh)
+        chunk_j = ex._chunk_program(spec, None, pack, chunk_steps, mesh)
+
+        programs = []
+        downgrades = []
+        report = {
+            "key": key, "model": spec.name, "programs": [],
+            "downgrades": downgrades,
+        }
+
+        def emit(role, args_sig_args, compiled, compile_s, path=None):
+            sig = _args_sig_digest(args_sig_args)
+            try:
+                payload = _se.serialize(compiled)
+                blob = pickle.dumps(payload, protocol=4)
+            except Exception as e:
+                self._count("downgrades")
+                downgrades.append(
+                    {"role": role, "shape": sig,
+                     "reason": f"{type(e).__name__}: {e}"}
+                )
+                return
+            frag = f"{path[:8]}-" if path else ""
+            fname = f"{key[:16]}-{role}-{frag}{sig[:16]}.bin"
+            self._atomic_write(
+                os.path.join(self.root, ARTIFACT_DIR, fname), blob
+            )
+            rec = {
+                "role": role,
+                "shape": sig,
+                "file": fname,
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "bytes": len(blob),
+                "compile_s": compile_s,
+            }
+            if path is not None:
+                rec["path"] = path
+            programs.append(rec)
+            report["programs"].append(dict(rec))
+
+        if summary_paths is None:
+            summary_paths = (ex.default_summary_path,)
+        folds = []
+        for sp in summary_paths:
+            try:
+                pdig = callable_digest(sp)
+            except UnstableStoreKey as e:
+                self._count("downgrades")
+                downgrades.append(
+                    {"role": "fold", "shape": "?",
+                     "reason": f"unstable summary_path: {e}"}
+                )
+                continue
+            folds.append((sp, pdig))
+
+        for n in wave_sizes:
+            n = int(n)
+            reps = jax.numpy.arange(n)
+            seeds = ex._seed_column(seed, n)
+            pw = ex._slice_params(params, int(n_replications), 0, n)
+            for hz in horizon_modes:
+                t_stops = (
+                    None if hz == "none" else ex._horizon_column(None, n)
+                )
+                args = (reps, seeds, t_stops, pw)
+                t0 = time.monotonic()
+                init_c = init_j.lower(*args).compile()
+                t_init = time.monotonic() - t0
+                emit("init", args, init_c, t_init)
+                sims_aval = jax.eval_shape(init_j, *args)
+                t0 = time.monotonic()
+                chunk_c = chunk_j.lower(sims_aval).compile()
+                t_chunk = time.monotonic() - t0
+                emit("chunk", (sims_aval,), chunk_c, t_chunk)
+                for sp, pdig in folds:
+                    from cimba_tpu.serve import cache as _pcache
+
+                    acc = _pcache.stream_acc(spec, with_metrics)
+                    fold_j = _pcache._fold_program(with_metrics, sp)
+                    try:
+                        t0 = time.monotonic()
+                        fold_c = fold_j.lower(acc, sims_aval).compile()
+                        t_fold = time.monotonic() - t0
+                    except Exception as e:
+                        # a path that doesn't exist on this model's Sim
+                        # (or doesn't trace) — record, don't crash
+                        self._count("downgrades")
+                        downgrades.append(
+                            {"role": "fold", "shape": "?",
+                             "reason": f"{type(e).__name__}: {e}"}
+                        )
+                        continue
+                    emit(
+                        "fold", (acc, sims_aval), fold_c, t_fold,
+                        path=pdig,
+                    )
+
+        with self._lock:
+            manifest = self._read_manifest()
+            entry = manifest["entries"].get(key, {})
+            # the merge key carries the summary-path digest too: fold
+            # records for different paths share arg shapes, and a
+            # shape+role key would silently keep only the last path's
+            def mkey(p):
+                return (p["role"], p["shape"], p.get("path"))
+
+            merged = {mkey(p): p for p in entry.get("programs", [])}
+            for p in programs:
+                merged[mkey(p)] = p
+            manifest["entries"][key] = {
+                "model": spec.name,
+                "env": _environment(),
+                "created": time.time(),
+                "meta": {
+                    "chunk_steps": int(chunk_steps),
+                    "with_metrics": bool(with_metrics),
+                    "wave_sizes": [int(n) for n in wave_sizes],
+                    "horizon_modes": list(horizon_modes),
+                },
+                "programs": sorted(
+                    merged.values(),
+                    key=lambda p: (p["role"], p["shape"],
+                                   p.get("path") or ""),
+                ),
+                "downgrades": downgrades,
+            }
+            self._write_manifest(manifest)
+            self._stats["saves"] += 1
+        return report
+
+    # -- hydrate -------------------------------------------------------------
+
+    def hydrate(
+        self,
+        spec,
+        *,
+        mesh=None,
+        pack=None,
+        chunk_steps: int = 1024,
+        with_metrics: bool = False,
+    ):
+        """Second-chance lookup for ``cache.get_programs``: return a
+        hydrated ``(init, chunk)`` pair for this program key, or None
+        on any miss.  The invalidation ladder — key absent, jax/jaxlib
+        version drift, backend/platform drift, checksum mismatch,
+        truncated/corrupt artifact, deserialization failure — each
+        step degrades to a counted miss (with a
+        :class:`StoreInvalidationWarning` where a rejected body
+        exists), NEVER to a mismatched program: one corrupt artifact
+        rejects the whole entry so init and chunk can never come from
+        different generations.  Artifact BYTES are read and
+        checksum-verified here; deserialization is lazy per dispatched
+        shape (see :class:`_LazyArtifact`)."""
+        from cimba_tpu.runner import experiment as ex
+
+        try:
+            key = store_key(
+                spec, with_metrics, mesh=mesh, pack=pack,
+                chunk_steps=chunk_steps,
+            )
+        except UnstableStoreKey:
+            self._count("misses")
+            return None
+        with self._lock:
+            manifest = self._read_manifest()
+        entry = manifest["entries"].get(key)
+        if entry is None:
+            self._count("misses")
+            return None
+        env = _environment()
+        if entry.get("env") != env:
+            drift = {
+                k: (entry.get("env", {}).get(k), env[k])
+                for k in env
+                if entry.get("env", {}).get(k) != env[k]
+            }
+            warnings.warn(
+                f"program store entry {key[:16]} was built in a "
+                f"different environment ({drift}); recompiling instead "
+                "of loading a foreign executable",
+                StoreInvalidationWarning,
+            )
+            self._count("invalidated")
+            return None
+        tables: dict = {"init": {}, "chunk": {}}
+        folds: dict = {}
+        for rec in entry.get("programs", []):
+            path = os.path.join(self.root, ARTIFACT_DIR, rec["file"])
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                if hashlib.sha256(blob).hexdigest() != rec["sha256"]:
+                    raise ValueError("artifact checksum mismatch")
+                # checksum verified NOW; deserialization is deferred to
+                # first dispatch of the shape (or warm's resolve_all)
+                loaded = _LazyArtifact(blob, rec["file"])
+            except Exception as e:
+                warnings.warn(
+                    f"program store artifact {rec['file']} is "
+                    f"corrupt/unloadable ({type(e).__name__}: {e}); "
+                    "rejecting the whole entry and recompiling",
+                    StoreInvalidationWarning,
+                )
+                self._count("corrupt")
+                return None
+            if rec["role"] == "fold":
+                folds[(rec.get("path"), rec["shape"])] = loaded
+            else:
+                tables.setdefault(rec["role"], {})[rec["shape"]] = loaded
+        if not tables["init"] and not tables["chunk"]:
+            self._count("misses")
+            return None
+        self._count("hits")
+        init_j = ex._init_program(spec, mesh)
+        chunk_j = ex._chunk_program(spec, None, pack, chunk_steps, mesh)
+        return HydratedPrograms(
+            _HydratedProgram(init_j, tables["init"], self, "init"),
+            _HydratedProgram(chunk_j, tables["chunk"], self, "chunk"),
+            folds,
+        )
+
+    def covered(
+        self, spec, *, mesh=None, pack=None, chunk_steps: int = 1024,
+        with_metrics: bool = False,
+    ) -> bool:
+        """True when a valid-looking manifest entry exists for this
+        program key (environment checked; artifact bytes are only
+        verified at :meth:`hydrate` time)."""
+        try:
+            key = store_key(
+                spec, with_metrics, mesh=mesh, pack=pack,
+                chunk_steps=chunk_steps,
+            )
+        except UnstableStoreKey:
+            return False
+        with self._lock:
+            entry = self._read_manifest()["entries"].get(key)
+        return bool(entry) and entry.get("env") == _environment()

@@ -255,6 +255,80 @@ print("mixed-traffic smoke OK: occupancy", occ,
       "lanes", stats["lane_occupancy"])
 EOF
 
+# program-store roundtrip smoke: build the warm-store artifact in one
+# process, hydrate it in a CLEAN subprocess, and serve the first request
+# without compiling any store-covered program (docs/15_program_store.md)
+# — counters prove the zero-compile path, and the result is bitwise the
+# freshly-compiled direct call
+run_cell "program-store roundtrip smoke" python - <<'EOF'
+import hashlib, json, os, subprocess, sys, tempfile
+
+store = tempfile.mkdtemp()
+# save: AOT-compile + serialize mm1's (init, chunk) pair at wave 16
+save = subprocess.run(
+    [sys.executable, "tools/warm_store.py", "--store", store,
+     "--configs", "mm1", "--wave", "16", "--objects", "30",
+     "--chunk-steps", "128", "--horizons", "none"],
+    capture_output=True, text=True, timeout=600,
+)
+assert save.returncode == 0, save.stderr
+info = json.loads(save.stdout.strip().splitlines()[-1])
+assert info["stats"]["downgrades"] == 0, info
+
+# hydrate: a clean subprocess must serve its first request from the
+# store (hit counters up, zero fallback compiles for covered shapes)
+child = r'''
+import hashlib, json, os
+import jax, numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+serve.warm(cache, spec, mm1.params(30), 16,
+           manifest=os.environ["CIMBA_PROGRAM_STORE"], chunk_steps=128)
+with serve.Service(max_wave=16, cache=cache) as svc:
+    res = svc.submit(serve.Request(
+        spec, mm1.params(30), 16, seed=3, wave_size=16, chunk_steps=128,
+    )).result(600)
+    stats = svc.stats()
+st = stats["program_store"]
+assert st["hits"] >= 1 and st["misses"] == 0, st
+assert st["fallback_shapes"] == 0, st
+assert st["artifact_dispatches"] >= 2, st
+dig = hashlib.sha256(b"".join(
+    np.asarray(x).tobytes()
+    for x in jax.tree.leaves((res.summary, res.n_failed,
+                              res.total_events)))).hexdigest()
+print(json.dumps({"digest": dig, "store": st}))
+'''
+env = dict(os.environ)
+env["CIMBA_PROGRAM_STORE"] = store
+hyd = subprocess.run(
+    [sys.executable, "-c", child], env=env,
+    capture_output=True, text=True, timeout=600,
+)
+assert hyd.returncode == 0, hyd.stderr
+out = json.loads(hyd.stdout.strip().splitlines()[-1])
+
+# direct: a freshly-compiled in-process run must match bitwise
+import jax, numpy as np
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+spec, _ = mm1.build(record=False)
+direct = ex.run_experiment_stream(
+    spec, mm1.params(30), 16, wave_size=16, chunk_steps=128, seed=3,
+    program_cache=pc.ProgramCache(store=False),
+)
+dig = hashlib.sha256(b"".join(
+    np.asarray(x).tobytes()
+    for x in jax.tree.leaves((direct.summary, direct.n_failed,
+                              direct.total_events)))).hexdigest()
+assert dig == out["digest"], (dig, out["digest"])
+print("program-store roundtrip OK: hydrated == direct bitwise,",
+      "store", out["store"])
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)

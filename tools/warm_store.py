@@ -1,0 +1,177 @@
+"""Build the persistent AOT program store for shipped model configs.
+
+The deploy-time half of docs/15_program_store.md: AOT-compile the
+``(init, chunk)`` program pair for each requested config at the wave
+shapes a fleet will serve, serialize the executables into
+``CIMBA_PROGRAM_STORE`` (or ``--store``), and print per-entry compile
+time + artifact size — the minutes this artifact saves every rollout,
+itemized.  A fresh process then reaches warm-serving with
+``serve.warm(cache, spec, params, wave, manifest=store_dir)`` (or just
+by setting ``CIMBA_PROGRAM_STORE``) without invoking XLA.
+
+Usage::
+
+    python tools/warm_store.py --store /path/to/store \\
+        [--configs mm1,mg1,jobshop] [--wave 1024] [--objects 50] \\
+        [--chunk-steps 1024] [--profile f64] [--horizons none,column] \\
+        [--no-prime-fold]
+
+``--prime-fold`` (default on) additionally runs ONE small wave through
+the hydrated cache with the store's XLA disk cache wired, so the fold
+program — which has no explicit artifact — is a disk hit in the fresh
+process too.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _configs(names, objects, reps_per_cell):
+    """(name, spec, params, n_replications, summary_path) per requested
+    config — the shipped model list of ISSUE 8 / ROADMAP item 3.
+    ``summary_path`` is each model's canonical pooled statistic (fold
+    artifacts key on the callable's CONTENT, so the serving process
+    must fold through the same function — these are the shipped
+    defaults)."""
+    from cimba_tpu.runner import experiment as ex
+
+    out = []
+    if "mm1" in names:
+        from cimba_tpu.models import mm1
+
+        spec, _ = mm1.build(record=False)
+        out.append(
+            ("mm1", spec, mm1.params(objects), None,
+             ex.default_summary_path)
+        )
+    if "mg1" in names:
+        from cimba_tpu.models import mg1
+
+        spec, _ = mg1.build()
+        params, cells = mg1.sweep_params(
+            objects, reps_per_cell=reps_per_cell
+        )
+        out.append(
+            ("mg1", spec, params, len(cells), ex.default_summary_path)
+        )
+    if "jobshop" in names:
+        from cimba_tpu.models import jobshop
+
+        spec, _ = jobshop.build()
+        out.append(
+            ("jobshop", spec, jobshop.params(objects), None,
+             jobshop.summary_path)
+        )
+    unknown = set(names) - {"mm1", "mg1", "jobshop"}
+    if unknown:
+        raise SystemExit(f"unknown configs: {sorted(unknown)}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="build the persistent AOT program store"
+    )
+    ap.add_argument(
+        "--store",
+        default=os.environ.get("CIMBA_PROGRAM_STORE", ""),
+        help="store root (default: $CIMBA_PROGRAM_STORE)",
+    )
+    ap.add_argument("--configs", default="mm1,mg1,jobshop")
+    ap.add_argument("--wave", type=int, default=1024,
+                    help="wave size(s) to compile, comma-separable")
+    ap.add_argument("--objects", type=int, default=50,
+                    help="per-lane workload knob (params builder input)")
+    ap.add_argument("--reps-per-cell", type=int, default=10,
+                    help="mg1 sweep cell width")
+    ap.add_argument("--chunk-steps", type=int, default=1024)
+    ap.add_argument("--profile", default="f64", choices=("f64", "f32"))
+    ap.add_argument("--horizons", default="none,column",
+                    help="comma list of {none,column}")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-prime-fold", dest="prime_fold",
+                    action="store_false", default=True)
+    args = ap.parse_args()
+    if not args.store:
+        raise SystemExit(
+            "no store: pass --store DIR or set CIMBA_PROGRAM_STORE"
+        )
+
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.serve import cache as _pcache
+    from cimba_tpu.serve import store as _pstore
+
+    store = _pstore.ProgramStore(args.store)
+    waves = [int(w) for w in str(args.wave).split(",") if w]
+    horizons = tuple(h for h in args.horizons.split(",") if h)
+    rows = []
+    t_all = time.monotonic()
+    with _cfg.profile(args.profile):
+        for name, spec, params, n_total, sp in _configs(
+            args.configs.split(","), args.objects, args.reps_per_cell
+        ):
+            rep = store.save_programs(
+                spec, params,
+                n_total if n_total is not None else max(waves),
+                wave_sizes=waves, chunk_steps=args.chunk_steps,
+                horizon_modes=horizons, summary_paths=(sp,),
+                seed=args.seed,
+            )
+            for p in rep["programs"]:
+                rows.append((name, p["role"], p["shape"][:12],
+                             p["compile_s"], p["bytes"]))
+            for d in rep["downgrades"]:
+                rows.append((name, d["role"] + " (DOWNGRADED)",
+                             d["shape"][:12], float("nan"), 0))
+                print(f"!! downgrade: {name}/{d['role']}: {d['reason']}",
+                      file=sys.stderr)
+            if args.prime_fold:
+                # one small wave through the hydrated cache primes the
+                # XLA disk cache (mechanism (a)) for anything without
+                # an explicit artifact; the init/chunk/fold dispatches
+                # ride the just-saved artifacts.  Guarded: a prime
+                # failure must not lose the artifacts already saved
+                from cimba_tpu.runner import experiment as ex
+
+                try:
+                    cache = _pcache.ProgramCache(store=store)
+                    ex.run_experiment_stream(
+                        spec, params,
+                        n_total if n_total is not None else min(waves),
+                        wave_size=min(waves),
+                        chunk_steps=args.chunk_steps,
+                        summary_path=sp, seed=args.seed,
+                        program_cache=cache,
+                    )
+                except Exception as e:
+                    print(f"!! prime-fold failed for {name}: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    print(f"{'config':<10}{'role':<22}{'shape':<14}"
+          f"{'compile_s':>10}{'bytes':>12}")
+    total_s, total_b = 0.0, 0
+    for name, role, shape, secs, nbytes in rows:
+        print(f"{name:<10}{role:<22}{shape:<14}{secs:>10.2f}{nbytes:>12}")
+        if secs == secs:  # not the NaN of a downgraded row
+            total_s += secs
+        total_b += nbytes
+    print(f"{'TOTAL':<10}{'':<22}{'':<14}{total_s:>10.2f}{total_b:>12}")
+    print(json.dumps({
+        "store": store.root,
+        "profile": args.profile,
+        "waves": waves,
+        "chunk_steps": args.chunk_steps,
+        "compile_s_total": total_s,
+        "artifact_bytes_total": total_b,
+        "wall_s": time.monotonic() - t_all,
+        "stats": store.stats(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
