@@ -6,13 +6,15 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_mixed,mmc,mg1,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_mixed,mmc,mg1,sweep,tandem,jobshop,awacs}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
 docs/15_program_store.md; ``serve_mixed`` is the heterogeneous-traffic
 mix measuring wave-packing occupancy and padding waste,
-docs/14_wave_packing.md);
+docs/14_wave_packing.md; ``sweep`` races fixed-R against adaptive-R
+sequential stopping on the M/G/1 grid, docs/16_sweeps.md; ``tandem``
+is the two-station Jackson network over its scenario grid);
 ``--config all`` runs the whole battery, one JSON line each (BASELINE.json
 configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
 reports a non-null vs_baseline; the others carry the published reference
@@ -269,7 +271,7 @@ def _obs_section():
         om.disable()
 
 
-def _line(metric, rate, vs_baseline, detail):
+def _line(metric, rate, vs_baseline, detail, unit=None):
     _heartbeat()
     detail["backend"] = jax.default_backend()
     if _fallback_reason is not None:
@@ -283,7 +285,7 @@ def _line(metric, rate, vs_baseline, detail):
     line = {
         "metric": metric,
         "value": rate,
-        "unit": "events/s",
+        "unit": unit or "events/s",
         "vs_baseline": vs_baseline,
         "detail": detail,
     }
@@ -1572,9 +1574,12 @@ def bench_mg1():
     prof = _bench_profile()
     with _cfg.profile(prof):
         spec, _ = mg1.build()
-        params, cells = mg1.sweep_params(N, reps_per_cell=reps)
-        warm, _ = mg1.sweep_params(1, reps_per_cell=reps)
-        R = len(cells)
+        # the declarative grid (docs/16_sweeps.md) — rows() reproduces
+        # the historical hand-rolled experiment array bitwise
+        grid = mg1.sweep_grid(N)
+        params, cell_ids = grid.rows(reps)
+        warm, _ = mg1.sweep_grid(1).rows(reps)
+        R = len(cell_ids)
 
         def init_one(rep, args):
             lane = tuple(a[rep] for a in args)
@@ -1602,6 +1607,10 @@ def bench_mg1():
         rate, arm, ev, failed, wall = best
         detail = {
             "cells": "4cv x 5rho",
+            "sweep_grid": {
+                "axes": {k: list(v) for k, v in grid.axes.items()},
+                "n_cells": grid.n_cells,
+            },
             "profile": prof,
             "dispatch_arm": arm,
             "dispatch_arms": arms,
@@ -1616,6 +1625,174 @@ def bench_mg1():
         if failed:
             detail["regrow"] = _regrow_pass(spec, params, R)
     _line("mg1_sweep_events_per_sec", rate, None, detail)
+
+
+def bench_sweep():
+    """Fixed-R vs adaptive-R sequential stopping on the SAME M/G/1 grid
+    (docs/16_sweeps.md): the adaptive arm runs each cell only until its
+    CI halfwidth beats a relative target (freed lanes go to the cells
+    still converging); the fixed arm sizes EVERY cell for the worst
+    cell's demand — what you'd have to run without sequential stopping
+    to make the same per-cell guarantee.  Reports cells/s, total
+    replications spent per arm, per-cell halfwidth-target attainment,
+    and the replication savings fraction (acceptance: >= 30%).  The
+    watchdog heartbeat refreshes every round and every chunk.
+
+    Overrides: CIMBA_BENCH_SWEEP_TARGET (relative halfwidth, default
+    0.08), CIMBA_BENCH_SWEEP_ROUNDS (adaptive round cap), plus the
+    standard CIMBA_BENCH_R (round replications per cell) and
+    CIMBA_BENCH_OBJECTS (per-replication workload)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import sweep as sw
+    from cimba_tpu.models import mg1
+    from cimba_tpu.serve import cache as _pcache
+
+    import numpy as np
+
+    R0, N = _scale(*((64, 2000) if _accel() else (4, 300)))
+    target = float(os.environ.get("CIMBA_BENCH_SWEEP_TARGET", "0.08"))
+    max_rounds = int(os.environ.get("CIMBA_BENCH_SWEEP_ROUNDS", "24"))
+    chunk = _stream_chunk_default()
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mg1.build()
+        grid = mg1.sweep_grid(N)
+        # floored at R0: a large CIMBA_BENCH_R override must widen the
+        # physical wave with it, not trip the cell_wave<=max_wave check
+        max_wave = max(min(4096, max(4 * R0, 64)), R0)
+        rule = sw.HalfwidthTarget(
+            target=target, relative=True, min_reps=2 * R0
+        )
+        cache = _pcache.ProgramCache(capacity=256)
+        # redistribute=False keeps the comparison honest: with freed
+        # lanes redistributed, the last live cell's final round can
+        # overshoot its actual demand by up to a whole oversized round,
+        # and sizing the fixed arm from that inflated worst would
+        # overstate the savings.  R0 per live cell per round means
+        # adaptive.n_reps.max() IS the worst cell's demand at R0
+        # granularity — the same granularity the fixed arm pays.
+        common = dict(
+            seed=2026, cell_wave=R0, max_wave=max_wave,
+            chunk_steps=chunk, pad_waves=True, redistribute=False,
+            program_cache=cache, on_round=_heartbeat,
+            on_chunk=_heartbeat,
+        )
+        # warm the init/chunk/fold programs at the quantized wave
+        # shapes with a tiny-workload twin grid (the _time_vmapped
+        # warm-then-time protocol)
+        sw.run_sweep(
+            spec, mg1.sweep_grid(1), reps_per_cell=R0, **common
+        )
+
+        t0 = time.perf_counter()
+        adaptive = sw.run_sweep(
+            spec, grid, reps_per_cell=R0, stop=rule,
+            max_rounds=max_rounds, **common,
+        )
+        wall_a = time.perf_counter() - t0
+        _heartbeat()
+
+        # fixed-R sized for the worst cell: every cell gets the most
+        # replications ANY cell needed under the same target
+        worst = int(adaptive.n_reps.max())
+        t0 = time.perf_counter()
+        fixed = sw.run_sweep(
+            spec, grid, reps_per_cell=worst, **common
+        )
+        wall_f = time.perf_counter() - t0
+        _heartbeat()
+        fixed_met = rule.met(fixed.summaries, fixed.n_reps)
+
+        reps_a = int(adaptive.n_reps.sum())
+        reps_f = worst * grid.n_cells
+        savings = 1.0 - reps_a / reps_f
+
+        def arm_detail(res, wall, met, reps_total):
+            return {
+                "wall_s": wall,
+                "cells_per_sec": grid.n_cells / wall,
+                "total_replications": reps_total,
+                "cells_met_target": int(np.asarray(met).sum()),
+                "events": int(res.total_events.sum()),
+                "rounds": res.n_rounds,
+                "reps_by_cell": res.n_reps.tolist(),
+                "halfwidth_by_cell": [
+                    round(float(h), 6) for h in res.halfwidth
+                ],
+                "occupancy": {
+                    k: v for k, v in res.occupancy.items()
+                    if k != "slots_by_cell"
+                },
+            }
+
+        detail = {
+            "profile": prof,
+            "grid": {
+                "axes": {k: list(v) for k, v in grid.axes.items()},
+                "n_cells": grid.n_cells,
+            },
+            "objects_per_replication": N,
+            "round_reps_per_cell": R0,
+            "halfwidth_target_rel": target,
+            "confidence": rule.confidence,
+            "adaptive": arm_detail(adaptive, wall_a, adaptive.met, reps_a),
+            "fixed_worst_cell": arm_detail(
+                fixed, wall_f, fixed_met, reps_f
+            ),
+            "replications_saved_frac": savings,
+            "stop_round_by_cell": adaptive.stop_round.tolist(),
+        }
+    _line(
+        "sweep_cells_per_sec", grid.n_cells / wall_a, None, detail,
+        unit="cells/s",
+    )
+
+
+def bench_tandem():
+    """Tandem Jackson network (models/tandem.py): the queueing-NETWORK
+    workload, run across its (arr_rate, p_back) sweep grid at the
+    monolithic dispatch — the model library's sweep-able network
+    config, with the analytic per-station sojourns as the sanity
+    anchor."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.models import tandem
+
+    R, N = _scale(*((65536, 400) if _accel() else (64, 80)))
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = tandem.build()
+        grid = tandem.sweep_grid(N)
+        reps = max(R // grid.n_cells, 1)
+        params, cell_ids = grid.rows(reps)
+        warm, _ = tandem.sweep_grid(1).rows(reps)
+        R = len(cell_ids)
+
+        def init_one(rep, args):
+            lane = tuple(a[rep] for a in args)
+            return cl.init_sim(spec, 2026, rep, lane)
+
+        ev, failed, wall = _time_vmapped(
+            spec, init_one, R, warm, params
+        )
+        detail = {
+            "profile": prof,
+            "sweep_grid": {
+                "axes": {k: list(v) for k, v in grid.axes.items()},
+                "n_cells": grid.n_cells,
+            },
+            "reps_per_cell": reps,
+            "replications": R,
+            "objects_per_replication": N,
+            "total_events": ev,
+            "wall_s": wall,
+            "failed_replications": failed,
+            "theory_mean_visit_sojourn_defaults": (
+                tandem.mean_visit_sojourn(0.5, 1.0, 1.25, 0.25)
+            ),
+        }
+        if failed:
+            detail["regrow"] = _regrow_pass(spec, params, R)
+    _line("tandem_events_per_sec", ev / wall, None, detail)
 
 
 def bench_jobshop():
@@ -1750,6 +1927,8 @@ CONFIGS = {
     "serve_mixed": bench_serve_mixed,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
+    "sweep": bench_sweep,
+    "tandem": bench_tandem,
     "jobshop": bench_jobshop,
     "awacs": bench_awacs,
 }

@@ -106,6 +106,33 @@ def build(queue_cap: int = 512):
     return m.build(), {"queue": q}
 
 
+def sweep_grid(
+    n_objects: int,
+    cvs=(0.25, 0.5, 1.0, 2.0),
+    utilizations=(0.5, 0.6, 0.7, 0.8, 0.9),
+    srv_mean: float = 1.0,
+):
+    """The reference's 4x5 cell table as a declarative
+    :class:`~cimba_tpu.sweep.SweepGrid` (docs/16_sweeps.md): axes over
+    service CV and utilization, each cell's row the
+    ``(arr_mean, srv_mean, srv_cv, n_objects)`` tuple ``build``'s
+    ``user_init`` unpacks.  ``grid.rows(reps_per_cell)`` reproduces the
+    historical hand-rolled experiment array bitwise (pinned in
+    tests/test_sweep.py); the sweep engine consumes the grid per cell
+    instead, fixed-R or adaptive."""
+    from cimba_tpu.sweep import SweepGrid
+
+    def row(cv, rho):
+        return (
+            np.float64(srv_mean / rho),  # lambda = rho/E[S]
+            np.float64(srv_mean),
+            np.float64(cv),
+            np.int32(n_objects),
+        )
+
+    return SweepGrid({"cv": cvs, "rho": utilizations}, row, name="mg1")
+
+
 def sweep_params(
     n_objects: int,
     cvs=(0.25, 0.5, 1.0, 2.0),
@@ -113,29 +140,23 @@ def sweep_params(
     reps_per_cell: int = 10,
     srv_mean: float = 1.0,
 ):
-    """The reference's 4x5x10 experiment array: one row per replication.
+    """The reference's 4x5x10 experiment array: one row per replication
+    (now a :func:`sweep_grid` projection — layout and values bitwise
+    the historical hand-rolled construction).
 
     Returns (params tuple of [R] arrays, cells) where cells[i] = (cv, rho)
     of replication i.
     """
+    grid = sweep_grid(
+        n_objects, cvs=cvs, utilizations=utilizations, srv_mean=srv_mean
+    )
+    params, _ = grid.rows(reps_per_cell)
     cells = [
-        (cv, rho)
-        for cv in cvs
-        for rho in utilizations
+        (c["cv"], c["rho"])
+        for c in grid.cells()
         for _ in range(reps_per_cell)
     ]
-    cv_arr = np.asarray([c for c, _ in cells])
-    rho_arr = np.asarray([r for _, r in cells])
-    arr_mean = srv_mean / rho_arr  # lambda = rho/E[S]
-    return (
-        (
-            jnp.asarray(arr_mean),
-            jnp.full(len(cells), srv_mean),
-            jnp.asarray(cv_arr),
-            jnp.full(len(cells), n_objects, jnp.int32),
-        ),
-        cells,
-    )
+    return params, cells
 
 
 def pk_sojourn(rho: float, cv: float, srv_mean: float = 1.0) -> float:

@@ -121,7 +121,13 @@ def _slice_params(params: Any, n_total: int, lo: int, n: int):
     M/G/1 sweep regression, pinned in tests/test_stream.py).  Shared
     leaves are broadcast here (not left to a later ``_broadcast_params``
     pass) so a shared leaf whose leading axis happens to equal the wave
-    size cannot be misread as per-lane data."""
+    size cannot be misread as per-lane data.
+
+    This is also the delivery contract ``sweep.SweepGrid`` rows ride:
+    a grid cell's scalar row broadcast to its wave slot here equals
+    the monolithic ``grid.rows()`` broadcast row-for-row, which is
+    what makes the sweep engine's cells bitwise the monolithic sweep
+    (docs/16_sweeps.md)."""
     def sl(x):
         x = jnp.asarray(x)
         if x.ndim > 0 and x.shape[0] == n_total:
@@ -547,6 +553,11 @@ def run_experiment_stream(
     compiling, and every jit on this path additionally rides jax's
     persistent compilation cache — a fresh process reaches its first
     result without re-paying XLA compile (docs/15_program_store.md).
+
+    Sweeping many scenarios?  :func:`cimba_tpu.sweep.run_sweep` drives
+    this same chunked machinery per grid cell — per-cell pooled
+    summaries (bitwise these calls'), adaptive replication counts, and
+    shared waves across cells (docs/16_sweeps.md).
     """
     import dataclasses
 

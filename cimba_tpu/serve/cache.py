@@ -187,6 +187,19 @@ def _get_or_create(programs: MutableMapping, key, factory):
     return programs[key]
 
 
+def cached(programs: MutableMapping, key, factory):
+    """Public get-or-create for SUBSYSTEM-specific compiled programs
+    sharing the stream/serve cache (same dict-or-ProgramCache duality
+    as the core accessors).  Callers namespace their keys with a
+    leading tag — the sweep engine keys its serve-result merge as
+    ``("sweep_serve_merge",)`` and its metrics reduce as
+    ``("sweep_metrics_merge",)`` — and follow the entry-pinning
+    invariant above: any object identity in the key must be kept alive
+    by the cached value (a closure referencing the keyed object pins
+    it)."""
+    return _get_or_create(programs, key, factory)
+
+
 # -- key builders (the stream runner's cache contract, factored out) --------
 
 

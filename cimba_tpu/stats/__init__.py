@@ -13,12 +13,14 @@ from cimba_tpu.stats.summary import (
     Summary,
     add,
     empty,
+    halfwidth,
     kurtosis,
     mean,
     merge,
     merge_tree,
     skewness,
     stddev,
+    t_quantile,
     variance,
 )
 from cimba_tpu.stats.timeseries import (

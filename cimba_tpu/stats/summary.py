@@ -156,3 +156,56 @@ def kurtosis(s: Summary):
     """Population kurtosis g2 = (m4/w) / (m2/w)^2 (3.0 for a normal)."""
     w = jnp.maximum(s.w, 1e-300)
     return (s.m4 / w) / jnp.maximum((s.m2 / w) ** 2, 1e-300)
+
+
+def t_quantile(p, dof):
+    """Student-t quantile t_{p, dof} via the Cornish–Fisher expansion
+    around the normal quantile (Abramowitz & Stegun 26.7.5, four
+    correction terms).  Branch-free and jit/vmap-friendly — the sweep
+    engine evaluates it over a whole grid of cells per stopping round.
+
+    Accuracy: converges to the normal quantile as ``dof`` grows (the
+    corrections decay as 1/dof), and is within ~1e-4 of the true
+    quantile for ``dof >= 4`` at the usual confidences; at ``dof`` of
+    2-3 the error is a few tenths of a percent, and ``dof < 2`` (only
+    reachable from a 2-sample summary) is conservative-to-loose by
+    design — a stopping rule should not be trusting 2 samples anyway
+    (see :class:`cimba_tpu.sweep.HalfwidthTarget`'s ``min_reps``).
+    """
+    from jax.scipy.special import ndtri
+
+    z = ndtri(jnp.asarray(p, _R))
+    v = jnp.maximum(jnp.asarray(dof, _R), 1.0)
+    z2 = z * z
+    g1 = (z2 + 1.0) * z / 4.0
+    g2 = ((5.0 * z2 + 16.0) * z2 + 3.0) * z / 96.0
+    g3 = (((3.0 * z2 + 19.0) * z2 + 17.0) * z2 - 15.0) * z / 384.0
+    g4 = (
+        ((((79.0 * z2 + 776.0) * z2 + 1482.0) * z2 - 1920.0) * z2 - 945.0)
+        * z / 92160.0
+    )
+    return z + (g1 + (g2 + (g3 + g4 / v) / v) / v) / v
+
+
+def halfwidth(s: Summary, confidence: float = 0.95):
+    """Confidence-interval halfwidth of the mean:
+    ``t_{q, w-1} * sqrt(variance(s) / w)`` with ``q = 1 - (1-c)/2``.
+
+    The ONE definition the sweep engine's stopping rule
+    (:class:`cimba_tpu.sweep.HalfwidthTarget`) and result reports
+    share, so "the cell converged" means the same thing in both.  Uses
+    the t-quantile at ``w - 1`` degrees of freedom for small summaries
+    and flows into the normal quantile as ``w`` grows (the
+    :func:`t_quantile` corrections decay as ``1/dof``).  A summary
+    with fewer than two samples has no variance estimate: returns
+    ``+inf`` (never "converged"), not a misleading 0.
+    """
+    if not 0.0 < confidence < 1.0:
+        raise ValueError(
+            f"confidence must be in (0, 1), got {confidence}"
+        )
+    q = 1.0 - (1.0 - confidence) / 2.0
+    hw = t_quantile(q, s.w - 1.0) * jnp.sqrt(
+        variance(s) / jnp.maximum(s.w, 1e-300)
+    )
+    return jnp.where(s.w >= 2.0, hw, jnp.asarray(jnp.inf, _R))

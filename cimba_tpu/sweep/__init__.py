@@ -1,0 +1,35 @@
+"""cimba_tpu.sweep — the many-scenario sweep engine (docs/16_sweeps.md).
+
+A :class:`SweepGrid` declares named axes over a model's param-tree
+leaves; :func:`run_sweep` fans the grid's cells x replications across
+waves of the chunked stream program and folds **per-cell** pooled
+Pébay summaries via slot-keyed applications of the shared fold
+program (bitwise the direct per-cell stream calls).
+``stop=HalfwidthTarget(...)``
+turns raw events/second into statistical efficiency: each cell runs
+only until its confidence interval beats the target (adaptive R,
+deterministic seed schedule — reproducible bit-for-bit), and
+``service=`` routes the same schedule through the serving layer so
+sweeps pack into shared heterogeneous waves alongside live traffic.
+
+    from cimba_tpu import sweep
+    grid = mg1.sweep_grid(n_objects=10_000)
+    res = sweep.run_sweep(
+        spec, grid, reps_per_cell=32,
+        stop=sweep.HalfwidthTarget(target=0.05, relative=True),
+    )
+    res.to_csv("mg1_sweep.csv")
+"""
+
+from cimba_tpu.sweep.adaptive import (
+    HalfwidthTarget,
+    replication_means,
+    round_seed,
+)
+from cimba_tpu.sweep.engine import SweepResult, run_sweep
+from cimba_tpu.sweep.grid import SweepGrid
+
+__all__ = [
+    "SweepGrid", "SweepResult", "HalfwidthTarget",
+    "replication_means", "round_seed", "run_sweep",
+]

@@ -1,0 +1,182 @@
+"""Sequential stopping for sweeps: run each cell only until its
+confidence interval is tight enough.
+
+Raw events/second is only half of statistical throughput — a sweep
+whose easy cells run as long as its hardest cell wastes most of its
+replications.  The adaptive engine runs the grid in ROUNDS: after each
+round every still-live cell's CI halfwidth (the shared
+:func:`cimba_tpu.stats.summary.halfwidth` definition) is checked
+against a target, converged cells stop receiving lanes, and the freed
+lanes go to the cells still running.
+
+Determinism contract (docs/16_sweeps.md): the replications of round
+``r`` of cell ``c`` are ``(seed=round_seed(seed, c, r), rep=0..n)`` —
+a pure function of the experiment seed and the (cell, round)
+coordinates, independent of which OTHER cells are still live, of wave
+packing, and of whether the round was dispatched directly or through a
+:class:`~cimba_tpu.serve.service.Service`.  Re-running an adaptive
+sweep therefore reproduces every cell's trajectory set (and its
+summary, bitwise) even though the stopping pattern reshapes every
+round's waves.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+_M64 = (1 << 64) - 1
+#: golden-ratio increment (the same constant ``random.bits.initialize``
+#: uses to separate replication streams under one seed)
+_GOLDEN = 0x9E3779B97F4A7C15
+_ROUND = 0xBF58476D1CE4E5B9  # splitmix64 multiplier — round separation
+
+
+def _fmix64(h: int) -> int:
+    """MurmurHash3 64-bit finalizer on host ints (the pure-python twin
+    of ``random.bits.fmix64`` — scheduling must not touch the device)."""
+    h &= _M64
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _M64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _M64
+    h ^= h >> 33
+    return h
+
+
+def round_seed(seed: int, cell: int, round_: int = 0) -> int:
+    """The u64 seed of (cell, round) under experiment ``seed`` — the
+    deterministic schedule's only source of randomness identity.
+
+    Two fmix64 passes keep distinct (cell, round) pairs on
+    statistically independent Threefry keys even after ``init_sim``'s
+    own ``seed + GOLDEN*rep`` per-lane derivation (independence here is
+    statistical, not cryptographic — same contract as the reference's
+    per-trial seed mix).  ``round_=0`` is also the FIXED-R seed of a
+    cell: a fixed sweep is one round of the same schedule, so a cell's
+    fixed-R result is bitwise a direct ``run_experiment_stream`` call
+    at ``seed=round_seed(seed, c, 0)`` (the tier-1 engine pin)."""
+    h = _fmix64((int(seed) + _GOLDEN * (int(cell) + 1)) & _M64)
+    return _fmix64((h + _ROUND * (int(round_) + 1)) & _M64)
+
+
+@functools.lru_cache(maxsize=None)
+def _halfwidths_jit(confidence: float):
+    """ONE jitted batched-halfwidth program per confidence level —
+    jax.jit caches by function identity, so wrapping a fresh lambda
+    per stopping round would retrace every round."""
+    import jax
+
+    from cimba_tpu.stats import summary as sm
+
+    return jax.jit(jax.vmap(lambda s: sm.halfwidth(s, confidence)))
+
+
+def replication_means(base_path=None):
+    """A ``summary_path`` whose samples are REPLICATION MEANS: each
+    lane's base summary collapses to the single sample ``mean(s)``, so
+    the pooled cell summary is the classic batch-means estimator —
+    ``n`` = replications, and :func:`~cimba_tpu.stats.summary.halfwidth`
+    becomes the replication-level CI.
+
+    Use this as ``run_sweep(..., summary_path=...)`` when the base
+    statistic's within-replication samples are autocorrelated (queue
+    sojourns at high utilization very much are): the default
+    pooled-sample CI treats every sample as exchangeable and reads far
+    too narrow there, while replication means are genuinely
+    independent (counter-derived streams).  Each replication weighs
+    equally regardless of its sample count — the standard batch-means
+    trade.
+
+    ``base_path=None`` wraps the runner's default (the model's
+    ``wait`` summary).  Calls memoize on the base path's identity, so
+    repeated calls return the SAME function object and the fold
+    program / serve compatibility caches keyed on ``summary_path``
+    identity keep hitting."""
+    return _replication_means_cached(base_path)
+
+
+@functools.lru_cache(maxsize=None)
+def _replication_means_cached(base_path):
+    import jax
+
+    from cimba_tpu.stats import summary as sm
+
+    def path(sims):
+        from cimba_tpu.runner.experiment import default_summary_path
+
+        base = base_path if base_path is not None else default_summary_path
+        return jax.vmap(lambda s: sm.add(sm.empty(), sm.mean(s)))(
+            base(sims)
+        )
+
+    path.__name__ = "replication_means(%s)" % getattr(
+        base_path, "__name__", "default_summary_path"
+    )
+    return path
+
+
+@dataclass(frozen=True)
+class HalfwidthTarget:
+    """Stop a cell when the CI halfwidth of its pooled mean beats a
+    target (the ``stop=`` argument of :func:`cimba_tpu.sweep.run_sweep`).
+
+    ``target`` is an absolute halfwidth, or — with ``relative=True`` —
+    a fraction of the cell's |mean| (the usual "mean known to ±5%"
+    framing; relative targets make a grid whose cells live on different
+    scales converge to comparable precision).  ``confidence`` feeds the
+    shared :func:`cimba_tpu.stats.summary.halfwidth` definition.
+    ``min_reps`` guards the small-sample regime: a cell is never judged
+    before it has that many replications, however narrow its early CI
+    happens to look (2 lucky samples have a degenerate variance
+    estimate, and the t-expansion is loosest exactly there).
+
+    Coverage caveat: the CI is computed over whatever samples the
+    sweep's ``summary_path`` pools.  The default path pools every
+    WITHIN-replication sample as if exchangeable; when those are
+    autocorrelated (queue waits at high utilization), the interval is
+    optimistically narrow and the nominal confidence is not attained —
+    ``min_reps`` delays judgment but does not fix the scaling.  For
+    calibrated coverage on autocorrelated statistics, run the sweep
+    with ``summary_path=sweep.replication_means()`` (batch-means CI:
+    ``n`` = independent replications).
+    """
+
+    target: float
+    relative: bool = False
+    confidence: float = 0.95
+    min_reps: int = 8
+
+    def __post_init__(self):
+        if not self.target > 0.0:
+            raise ValueError(
+                f"halfwidth target must be positive, got {self.target}"
+            )
+        if not 0.0 < self.confidence < 1.0:
+            raise ValueError(
+                f"confidence must be in (0, 1), got {self.confidence}"
+            )
+
+    def halfwidths(self, summaries):
+        """Per-cell halfwidths of a batched Summary (device; one
+        cached jitted program per confidence level)."""
+        return _halfwidths_jit(self.confidence)(summaries)
+
+    def met(self, summaries, n_reps):
+        """np bool [C]: which cells' CIs beat the target.  ``n_reps``
+        is the per-cell replication count (the ``min_reps`` guard
+        counts replications, not pooled samples — a cell's summary may
+        hold thousands of autocorrelated within-replication samples
+        and still rest on too few independent replications)."""
+        import numpy as np
+
+        from cimba_tpu.stats import summary as sm
+
+        hw = np.asarray(self.halfwidths(summaries), np.float64)
+        if self.relative:
+            bound = self.target * np.abs(
+                np.asarray(sm.mean(summaries), np.float64)
+            )
+        else:
+            bound = self.target
+        return (hw <= bound) & (np.asarray(n_reps) >= self.min_reps)

@@ -1,0 +1,146 @@
+"""Declarative scenario grids: named axes over param-tree leaves.
+
+The reference's only "sweep" is the hand-rolled M/G/1 experiment array
+(``models/mg1.py::sweep_params``): 4 service CVs x 5 utilizations
+unrolled into one row of parameters per replication.  A
+:class:`SweepGrid` generalizes that pattern to any model: named axes
+(each a sequence of values) span a Cartesian cell table, and a
+``row`` function maps one cell's axis values to one row of the model's
+param pytree.  :meth:`SweepGrid.rows` then stacks the rows into the
+experiment-array layout the runner already understands — leading axis
+``n_cells * reps_per_cell`` in cell-major order, delivered to lanes
+through ``runner.experiment._slice_params`` so each replication's
+trajectory is bitwise the monolithic broadcast (the M/G/1 sweep
+regression, tests/test_stream.py).
+
+The grid itself is pure host-side bookkeeping: no jax import at module
+load, no device arrays until :meth:`rows` builds them.  The sweep
+ENGINE (:mod:`cimba_tpu.sweep.engine`) consumes cells one at a time
+via :meth:`cell_row` — it never materializes the full [R] array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence
+
+
+class SweepGrid:
+    """A Cartesian scenario grid over a model's parameter tree.
+
+    ``axes`` maps axis names to value sequences (insertion order is
+    significant: the LAST axis varies fastest, matching the nested-loop
+    order of the hand-rolled M/G/1 sweep).  ``row`` is called with one
+    keyword argument per axis and returns the param pytree of ONE cell
+    — scalar leaves (``np.float64(...)``/``np.int32(...)`` for exact
+    dtype control); every cell must return the same tree structure and
+    leaf dtypes.
+
+        grid = SweepGrid(
+            {"cv": (0.25, 0.5, 1.0, 2.0),
+             "rho": (0.5, 0.6, 0.7, 0.8, 0.9)},
+            lambda cv, rho: (np.float64(1.0 / rho), np.float64(1.0),
+                             np.float64(cv), np.int32(n_objects)),
+        )
+    """
+
+    def __init__(
+        self,
+        axes: Mapping[str, Sequence],
+        row: Callable[..., Any],
+        *,
+        name: str = "sweep",
+    ):
+        if not axes:
+            raise ValueError("SweepGrid needs at least one axis")
+        self.axes = {str(k): tuple(v) for k, v in axes.items()}
+        for k, vals in self.axes.items():
+            if not vals:
+                raise ValueError(f"axis {k!r} has no values")
+        self.row = row
+        self.name = name
+        self._cells = None
+
+    @property
+    def n_cells(self) -> int:
+        n = 1
+        for vals in self.axes.values():
+            n *= len(vals)
+        return n
+
+    def cells(self) -> tuple:
+        """All cells as ``{axis: value}`` dicts, last axis fastest."""
+        if self._cells is None:
+            import itertools
+
+            names = list(self.axes)
+            self._cells = tuple(
+                dict(zip(names, combo))
+                for combo in itertools.product(*self.axes.values())
+            )
+        return self._cells
+
+    def cell(self, i: int) -> dict:
+        return dict(self.cells()[i])
+
+    def cell_label(self, i: int) -> str:
+        """``"cv=0.25,rho=0.5"`` — stable cell naming for serve labels,
+        CSV rows, and bench reports."""
+        return ",".join(f"{k}={v}" for k, v in self.cells()[i].items())
+
+    def cell_row(self, i: int):
+        """The param pytree of cell ``i`` (scalar leaves)."""
+        return self.row(**self.cells()[i])
+
+    def cell_rows(self) -> list:
+        """Every cell's row, validated to share ONE tree structure —
+        the check both :meth:`rows` and the sweep engine gate on (a
+        ragged grid fails loudly with the offending cell named, not as
+        a stack error deep in jax)."""
+        import jax
+
+        rows = [self.cell_row(i) for i in range(self.n_cells)]
+        first = jax.tree.structure(rows[0])
+        for i, r in enumerate(rows[1:], 1):
+            if jax.tree.structure(r) != first:
+                raise ValueError(
+                    f"SweepGrid {self.name!r}: cell {i} "
+                    f"({self.cell_label(i)}) returned a different param "
+                    "tree structure than cell 0 — every cell must share "
+                    "one structure"
+                )
+        return rows
+
+    def rows(self, reps_per_cell: int):
+        """The experiment array: every cell's row repeated
+        ``reps_per_cell`` times along a new leading axis (cell-major —
+        cell ``i``'s replications occupy rows
+        ``[i*reps_per_cell, (i+1)*reps_per_cell)``), plus the matching
+        ``cell_ids`` int array.  This is the fixed-R layout the
+        monolithic runner (``run_experiment``) and the hand-rolled
+        M/G/1 path consume; the sweep engine builds its waves per cell
+        instead and never calls this."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if reps_per_cell <= 0:
+            raise ValueError(
+                f"reps_per_cell must be positive, got {reps_per_cell}"
+            )
+        rows = self.cell_rows()
+        params = jax.tree.map(
+            lambda *xs: jnp.asarray(
+                np.repeat(
+                    np.stack([np.asarray(x) for x in xs], axis=0),
+                    reps_per_cell,
+                    axis=0,
+                )
+            ),
+            *rows,
+        )
+        cell_ids = np.repeat(np.arange(self.n_cells), reps_per_cell)
+        return params, cell_ids
+
+    def __repr__(self):
+        ax = ", ".join(f"{k}[{len(v)}]" for k, v in self.axes.items())
+        return f"SweepGrid({self.name!r}: {ax} -> {self.n_cells} cells)"

@@ -1,6 +1,13 @@
-"""Example: the M/G/1 4x5x10 parameter sweep (reference README ~"M/G/1
-sweep" experiment) — one batched run, one row of parameters per
-replication, results vs Pollaczek-Khinchine theory.
+"""Example: the M/G/1 4x5 parameter sweep (reference README ~"M/G/1
+sweep" experiment) two ways:
+
+1. the monolithic experiment array — one batched run, one row of
+   parameters per replication (`mg1.sweep_params`, chapter 6);
+2. the sweep ENGINE with adaptive-R sequential stopping — each cell
+   runs only until its CI halfwidth beats a relative target
+   (docs/16_sweeps.md), spending replications where the variance is.
+
+Both report against Pollaczek-Khinchine theory.
 
 Run:  python examples/mg1_sweep.py
 """
@@ -12,22 +19,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from cimba_tpu import sweep
 from cimba_tpu.models import mg1
 from cimba_tpu.runner import experiment as ex
 
 
 def main():
     spec, _ = mg1.build()
+
+    # --- 1. monolithic experiment array (fixed 10 reps everywhere) ---
     params, cells = mg1.sweep_params(n_objects=20_000, reps_per_cell=10)
     res = ex.run_experiment(spec, params, len(cells), seed=7)
     means = np.asarray(res.sims.user["wait"].m1)
-    print(f"{len(cells)} replications, failed: {int(res.n_failed)}")
+    print(f"monolithic: {len(cells)} replications, "
+          f"failed: {int(res.n_failed)}")
     print(" cv    rho   simulated  theory")
     for cv, rho in dict.fromkeys(cells):
         idx = [k for k, c in enumerate(cells) if c == (cv, rho)]
         print(
             f"{cv:4.2f}  {rho:4.2f}  {means[idx].mean():9.3f}  "
             f"{mg1.pk_sojourn(rho, cv):7.3f}"
+        )
+
+    # --- 2. adaptive engine: converge every cell to +/-1% ------------
+    grid = mg1.sweep_grid(n_objects=2_000)
+    adaptive = sweep.run_sweep(
+        spec, grid, reps_per_cell=8,
+        stop=sweep.HalfwidthTarget(target=0.01, relative=True),
+        max_rounds=24, seed=7, cell_wave=8, chunk_steps=2048,
+    )
+    print(f"\nadaptive: {int(adaptive.n_reps.sum())} replications "
+          f"across {grid.n_cells} cells, {adaptive.n_rounds} rounds "
+          f"(fixed-R sized for the worst cell would be "
+          f"{int(adaptive.n_reps.max()) * grid.n_cells})")
+    print(" cv    rho   mean      +/-hw     reps  theory")
+    for row in adaptive.rows():
+        print(
+            f"{row['cv']:4.2f}  {row['rho']:4.2f}  {row['mean']:8.3f}"
+            f"  {row['halfwidth']:8.3f}  {row['reps']:4d}"
+            f"  {mg1.pk_sojourn(row['rho'], row['cv']):7.3f}"
         )
 
 

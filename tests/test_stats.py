@@ -4,6 +4,7 @@ weighted behavior, dataset order statistics, ACF/PACF vs known processes."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import cimba_tpu.stats as cs
 from cimba_tpu.stats import dataset as cds
@@ -82,6 +83,61 @@ def test_weighted_summary():
     assert np.isclose(float(cs.mean(s)), mu)
     m2 = (ws * (xs - mu) ** 2).sum()
     assert np.isclose(float(s.m2), m2)
+
+
+# --- halfwidth (the sweep stopping rule's shared definition) ----------------
+
+
+def test_t_quantile_matches_tables():
+    """Cornish-Fisher t-quantile vs published table values at the
+    confidences the stopping rule uses."""
+    for dof, want in [(3, 3.1824), (5, 2.5706), (10, 2.2281),
+                      (30, 2.0423), (100, 1.9840)]:
+        got = float(cs.t_quantile(0.975, dof))
+        assert abs(got - want) < 0.005 * want, (dof, got, want)
+    for dof, want in [(10, 1.8125), (30, 1.6973)]:
+        got = float(cs.t_quantile(0.95, dof))
+        assert abs(got - want) < 0.005 * want, (dof, got, want)
+    # flows into the normal quantile as dof grows
+    assert abs(float(cs.t_quantile(0.975, 1e7)) - 1.959964) < 1e-4
+
+
+def test_halfwidth_matches_manual_ci():
+    rng = np.random.default_rng(8)
+    xs = rng.normal(3.0, 2.0, size=50)
+    s = fold(xs)
+    want = 2.0096 * xs.std(ddof=1) / np.sqrt(50)  # t_{.975,49}=2.0096
+    assert np.isclose(float(cs.halfwidth(s)), want, rtol=1e-3)
+    # higher confidence -> wider interval
+    assert float(cs.halfwidth(s, 0.99)) > float(cs.halfwidth(s))
+    # more samples -> narrower interval
+    s2 = fold(np.concatenate([xs, rng.normal(3.0, 2.0, size=450)]))
+    assert float(cs.halfwidth(s2)) < float(cs.halfwidth(s))
+
+
+def test_halfwidth_degenerate_summaries():
+    """Fewer than two samples has no variance estimate: +inf (never
+    'converged'), not a misleading zero."""
+    assert float(cs.halfwidth(cs.empty())) == np.inf
+    assert float(cs.halfwidth(cs.add(cs.empty(), 1.0))) == np.inf
+    two = cs.add(cs.add(cs.empty(), 1.0), 2.0)
+    assert np.isfinite(float(cs.halfwidth(two)))
+    with pytest.raises(ValueError, match="confidence"):
+        cs.halfwidth(two, confidence=1.0)
+
+
+def test_halfwidth_vectorizes_under_jit():
+    """The sweep engine evaluates halfwidths over a batched Summary[C]
+    per stopping round — vmap/jit must reproduce the scalar path."""
+    rng = np.random.default_rng(9)
+    rows = rng.exponential(2.0, size=(4, 30))
+    batched = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[fold(r) for r in rows]
+    )
+    hw = jax.jit(jax.vmap(cs.halfwidth))(batched)
+    for i in range(4):
+        one = jax.tree.map(lambda x: x[i], batched)
+        assert np.isclose(float(hw[i]), float(cs.halfwidth(one)))
 
 
 # --- dataset ----------------------------------------------------------------

@@ -1,0 +1,117 @@
+"""Tandem Jackson network (models/tandem.py): per-station sojourns vs
+the product-form M/M/1 marginals, conservation, and the sweep-grid
+integration.  One tier-1 test carries every cheap pin (the model's
+3-process trace dominates the budget at ~12 s compile); the
+at-scale convergence battery is slow (tools/ci.sh runs it)."""
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu.models import tandem
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+
+def test_tandem_matches_jackson_theory():
+    """Per-visit sojourns at both stations vs W_i = 1/(mu_i - lambda_i)
+    with lambda_i = lambda/(1-p) (Jackson traffic equations), the
+    combined ``wait`` vs (W1+W2)/2, and customer conservation — all on
+    one compiled run (tier-1 budget)."""
+    arr_rate, s1_rate, s2_rate, p_back = 0.5, 1.0, 1.25, 0.25
+    spec, _ = tandem.build(queue_cap=64)
+    R, N = 48, 500
+    res = ex.run_experiment(
+        spec,
+        tandem.params(N, arr_rate, s1_rate, s2_rate, p_back),
+        R, seed=3,
+    )
+    assert int(res.n_failed) == 0
+
+    pool = jax.jit(sm.merge_tree)
+    w1 = pool(res.sims.user["w1"])
+    w2 = pool(res.sims.user["w2"])
+    wt = pool(res.sims.user["wait"])
+
+    W1 = tandem.visit_sojourn(arr_rate, s1_rate, p_back)   # 3.0
+    W2 = tandem.visit_sojourn(arr_rate, s2_rate, p_back)   # ~1.714
+    # finite-horizon transient + autocorrelation: generous envelopes
+    # (measured rel err ~2% at this size; 10% envelope)
+    assert abs(float(sm.mean(w1)) - W1) < 0.10 * W1
+    assert abs(float(sm.mean(w2)) - W2) < 0.10 * W2
+    Wm = tandem.mean_visit_sojourn(arr_rate, s1_rate, s2_rate, p_back)
+    assert abs(float(sm.mean(wt)) - Wm) < 0.10 * Wm
+    # station 1 is the slower server: its per-visit sojourn dominates
+    assert float(sm.mean(w1)) > float(sm.mean(w2))
+
+    # conservation: station-2 completions = station-1 completions seen
+    # so far; every replication departed exactly n_objects customers
+    # (the stop condition) and each departure took >= 1 pass, so visit
+    # counts are >= N per station and the two stations agree to within
+    # the in-flight customers at stop time
+    n1 = np.asarray(res.sims.user["w1"].n)
+    n2 = np.asarray(res.sims.user["w2"].n)
+    assert (n2 >= N).all()
+    assert (n1 >= n2 - 1).all()
+    # combined wait holds both stations' samples
+    nt = np.asarray(res.sims.user["wait"].n)
+    np.testing.assert_array_equal(nt, n1 + n2)
+
+    # theory helpers refuse unstable cells
+    with pytest.raises(ValueError, match="unstable"):
+        tandem.visit_sojourn(0.9, 1.0, 0.25)
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_tandem_converges_at_scale():
+    """The acceptance-grade pin: 64 reps x 4000 customers, both
+    stations within 5% of the Jackson marginals, and the feedback
+    probability actually moves the answer (p=0 reduces to a plain
+    tandem line)."""
+    spec, _ = tandem.build()
+    arr_rate, s1_rate, s2_rate, p_back = 0.5, 1.0, 1.25, 0.25
+    res = ex.run_experiment(
+        spec, tandem.params(4000, arr_rate, s1_rate, s2_rate, p_back),
+        64, seed=11,
+    )
+    assert int(res.n_failed) == 0
+    pool = jax.jit(sm.merge_tree)
+    for key, rate in (("w1", s1_rate), ("w2", s2_rate)):
+        got = float(sm.mean(pool(res.sims.user[key])))
+        want = tandem.visit_sojourn(arr_rate, rate, p_back)
+        assert abs(got - want) < 0.05 * want, (key, got, want)
+
+    res0 = ex.run_experiment(
+        spec, tandem.params(4000, arr_rate, s1_rate, s2_rate, 0.0),
+        64, seed=11,
+    )
+    w1_fb = float(sm.mean(pool(res.sims.user["w1"])))
+    w1_nofb = float(sm.mean(pool(res0.sims.user["w1"])))
+    want0 = tandem.visit_sojourn(arr_rate, s1_rate, 0.0)  # 1/(1-0.5)=2
+    assert abs(w1_nofb - want0) < 0.05 * want0
+    assert w1_fb > w1_nofb * 1.2  # feedback visibly loads station 1
+
+
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+def test_tandem_sweep_grid_end_to_end():
+    """The network as a sweep workload: a 2x2 (arr_rate, p_back) grid
+    through the adaptive engine — every cell converges to a relative
+    halfwidth target and the per-cell means track the analytic
+    surface."""
+    from cimba_tpu import sweep
+
+    spec, _ = tandem.build()
+    grid = tandem.sweep_grid(
+        1500, arr_rates=(0.4, 0.6), p_backs=(0.1, 0.25)
+    )
+    res = sweep.run_sweep(
+        spec, grid, reps_per_cell=8,
+        stop=sweep.HalfwidthTarget(target=0.08, relative=True, min_reps=8),
+        max_rounds=6, seed=7, cell_wave=8, chunk_steps=2048,
+    )
+    assert res.met.all(), (res.halfwidth, res.n_reps)
+    for row in res.rows():
+        want = tandem.mean_visit_sojourn(
+            row["arr_rate"], 1.0, 1.25, row["p_back"]
+        )
+        assert abs(row["mean"] - want) < 0.15 * want, (row, want)

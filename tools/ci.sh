@@ -255,6 +255,56 @@ print("mixed-traffic smoke OK: occupancy", occ,
       "lanes", stats["lane_occupancy"])
 EOF
 
+# sweep smoke: the many-scenario engine (docs/16_sweeps.md) — an easy
+# cell must provably stop >= 1 round before a hard cell under adaptive
+# stopping, and fixed-R engine cells must be BITWISE the direct
+# run_experiment_stream calls at the round_seed schedule
+run_cell "sweep smoke" python - <<'EOF'
+import sys
+import numpy as np, jax
+sys.path.insert(0, "tests")
+from test_sweep import _sweep_spec
+from cimba_tpu import sweep
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+
+spec = _sweep_spec()
+cache = pc.ProgramCache()
+# exp(mean) samples: stddev == mean, so an ABSOLUTE halfwidth target
+# makes the low-mean cell provably cheap and the high-mean cell dear
+grid = sweep.SweepGrid(
+    {"m": (0.1, 0.8)},
+    lambda m: (np.float64(m), np.int32(16)), name="smoke",
+)
+res = sweep.run_sweep(
+    spec, grid, reps_per_cell=8,
+    stop=sweep.HalfwidthTarget(target=0.05, min_reps=4),
+    max_rounds=24, seed=7, cell_wave=8, max_wave=32, chunk_steps=16,
+    program_cache=cache,
+)
+assert res.met is not None and res.met.all(), (res.halfwidth, res.n_reps)
+assert res.stop_round[0] + 1 <= res.stop_round[1], res.stop_round
+assert res.n_reps[0] < res.n_reps[1], res.n_reps
+
+fixed = sweep.run_sweep(
+    spec, grid, reps_per_cell=6, seed=5, cell_wave=4, max_wave=16,
+    chunk_steps=16, program_cache=cache,
+)
+for i in range(grid.n_cells):
+    direct = ex.run_experiment_stream(
+        spec, grid.cell_row(i), 6, wave_size=4, chunk_steps=16,
+        seed=sweep.round_seed(5, i, 0), program_cache=cache,
+    )
+    for a, b in zip(jax.tree.leaves(fixed.cell_summary(i)),
+                    jax.tree.leaves(direct.summary)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(fixed.n_failed[i]) == int(direct.n_failed), i
+    assert int(fixed.total_events[i]) == int(direct.total_events), i
+print("sweep smoke OK: stop rounds", res.stop_round.tolist(),
+      "reps", res.n_reps.tolist(),
+      "| fixed-R bitwise vs direct,", fixed.occupancy["waves"], "waves")
+EOF
+
 # program-store roundtrip smoke: build the warm-store artifact in one
 # process, hydrate it in a CLEAN subprocess, and serve the first request
 # without compiling any store-covered program (docs/15_program_store.md)
