@@ -27,9 +27,17 @@ Three parts, all trace-time gated like :mod:`cimba_tpu.utils.logger`
 Kernel-path contract (docs/07): both the recorder and the metrics
 registry raise a loud build-time error when an enabled instance is
 traced under ``config.KERNEL_MODE`` — mirroring ``logger._emit``.
+
+Host-side: :mod:`~cimba_tpu.obs.telemetry` (the serving control-plane's
+time-series registry, request spans, health sampler — stdlib-only) and
+:mod:`~cimba_tpu.obs.expose` (``/metrics`` Prometheus text, ``/healthz``,
+``/varz`` over HTTP).  Opt-in with the same discipline: everything takes
+``telemetry=None`` and a None means no threads, no span allocations, and
+compiled programs bitwise-unchanged (docs/17_telemetry.md).
 """
 
 from cimba_tpu.obs import metrics, trace  # noqa: F401
 
-# export and prof are imported lazily by callers (they pull in numpy/json
-# and the runner surface; the hot loop only ever needs trace/metrics)
+# export, prof, telemetry, and expose are imported lazily by callers
+# (they pull in numpy/json/http and the runner surface; the hot loop
+# only ever needs trace/metrics)

@@ -377,6 +377,31 @@ def _init_program(spec: ModelSpec, mesh: Optional[Mesh]):
     return jax.jit(init)
 
 
+def _tel_hooks(telemetry, kind: str, on_wave, on_chunk):
+    """Generalize the ``on_wave``/``on_chunk`` progress hooks into
+    telemetry ticks (docs/17_telemetry.md): with a
+    :class:`cimba_tpu.obs.telemetry.Telemetry` plane attached, each
+    wave/chunk boundary ticks its counter and refreshes the liveness
+    heartbeat (the watchdog primitive ``bench.py`` reads), THEN calls
+    the user hook.  ``telemetry=None`` returns the hooks untouched —
+    the zero-overhead default (no wrapper closures, no allocations on
+    the drive loop)."""
+    if telemetry is None:
+        return on_wave, on_chunk
+
+    def wave_hook(n_waves, lanes_done, _u=on_wave):
+        telemetry.tick(f"{kind}.wave")
+        if _u is not None:
+            _u(n_waves, lanes_done)
+
+    def chunk_hook(n, _u=on_chunk):
+        telemetry.tick(f"{kind}.chunk")
+        if _u is not None:
+            _u(n)
+
+    return wave_hook, chunk_hook
+
+
 def run_experiment_chunked(
     spec: ModelSpec,
     params: Any,
@@ -390,6 +415,7 @@ def run_experiment_chunked(
     poll_every: int = 4,
     donate: bool = True,
     on_chunk=None,
+    telemetry=None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 0,
     resume: bool = False,
@@ -468,6 +494,7 @@ def run_experiment_chunked(
                 checkpoint_path, s, tag=ckpt_tag, progress=n
             )
 
+    _, on_chunk = _tel_hooks(telemetry, "chunked", None, on_chunk)
     chunk = _chunk_program(spec, t_end, pack, chunk_steps, mesh, donate)
     sims = drive_chunks(
         chunk, sims, poll_every=poll_every, on_chunk=on_chunk,
@@ -496,6 +523,7 @@ def run_experiment_stream(
     max_regrows: int = 0,
     on_wave=None,
     on_chunk=None,
+    telemetry=None,
     program_cache: Optional[dict] = None,
 ) -> StreamResult:
     """Pooled statistics for R replications with R beyond the
@@ -526,6 +554,13 @@ def run_experiment_stream(
 
     ``on_wave(n_waves, lanes_done)`` and ``on_chunk(n)`` are progress
     hooks (bench.py refreshes its watchdog heartbeat there).
+    ``telemetry`` generalizes them: a
+    :class:`cimba_tpu.obs.telemetry.Telemetry` plane gets a tick
+    (counter + liveness heartbeat) per wave and per chunk, and — with
+    spans enabled — one "stream" span covering the call with a
+    per-wave event trail (docs/17_telemetry.md).  All host-side: the
+    compiled programs and the streamed results are bitwise identical
+    with or without it.
 
     ``program_cache``: pass the SAME mapping to repeated calls to reuse
     the compiled init/chunk/fold programs across calls (bench.py's
@@ -613,49 +648,73 @@ def run_experiment_stream(
         R, min(wave_size, R), with_metrics,
     )
 
+    on_wave, on_chunk = _tel_hooks(telemetry, "stream", on_wave, on_chunk)
+    rec = telemetry.spans if telemetry is not None else None
+    trace = None
+    if rec is not None:
+        trace = rec.new_trace()
+        rec.start(
+            trace, "stream", spec=spec.name, R=R, wave_size=wave_size,
+        )
+
     grow_errs = (_cl.ERR_EVENT_OVERFLOW,)
     n_waves = 0
     n_regrows = 0
     lo = 0
-    while lo < R:
-        n = min(wave_size, R - lo)
-        reps = jnp.arange(lo, lo + n)
-        pw = _slice_params(params, R, lo, n)
-        seeds = _seed_column(seed, n)
-        # no horizon -> NO t_stop leaf: the chunk cond then skips the
-        # per-event next-event-min + compare entirely (the historical
-        # t_end=None jaxpr — per-event cost matters on the headline
-        # path).  jit re-specializes per pytree structure under the
-        # same program key, so both variants share the cache entry.
-        t_stops = None if t_end is None else _horizon_column(t_end, n)
-        while True:
-            init_j, chunk_j = get_programs(spec)
-            sims = init_j(reps, seeds, t_stops, pw)
-            sims = drive_chunks(
-                chunk_j, sims, poll_every=poll_every, on_chunk=on_chunk
-            )
-            if n_regrows >= max_regrows:
-                break
-            err = np.asarray(sims.err)
-            if not np.isin(err, grow_errs).any():
-                break
-            # wave-granular regrow: double the event cap and re-run THIS
-            # wave (healthy lanes reproduce bit-identically — streams are
-            # counter-derived); later waves keep the grown spec.  Drop the
-            # failed wave's sims before the re-init allocates — holding
-            # the name across init_j would peak at TWO waves of HBM
-            spec = dataclasses.replace(spec, event_cap=2 * spec.event_cap)
-            n_regrows += 1
+    try:
+        while lo < R:
+            n = min(wave_size, R - lo)
+            reps = jnp.arange(lo, lo + n)
+            pw = _slice_params(params, R, lo, n)
+            seeds = _seed_column(seed, n)
+            # no horizon -> NO t_stop leaf: the chunk cond then skips
+            # the per-event next-event-min + compare entirely (the
+            # historical t_end=None jaxpr — per-event cost matters on
+            # the headline path).  jit re-specializes per pytree
+            # structure under the same program key, so both variants
+            # share the cache entry.
+            t_stops = None if t_end is None else _horizon_column(t_end, n)
+            while True:
+                init_j, chunk_j = get_programs(spec)
+                sims = init_j(reps, seeds, t_stops, pw)
+                sims = drive_chunks(
+                    chunk_j, sims, poll_every=poll_every,
+                    on_chunk=on_chunk,
+                )
+                if n_regrows >= max_regrows:
+                    break
+                err = np.asarray(sims.err)
+                if not np.isin(err, grow_errs).any():
+                    break
+                # wave-granular regrow: double the event cap and re-run
+                # THIS wave (healthy lanes reproduce bit-identically —
+                # streams are counter-derived); later waves keep the
+                # grown spec.  Drop the failed wave's sims before the
+                # re-init allocates — holding the name across init_j
+                # would peak at TWO waves of HBM
+                spec = dataclasses.replace(
+                    spec, event_cap=2 * spec.event_cap
+                )
+                n_regrows += 1
+                sims = None
+            acc = fold_j(acc, sims)
+            # release the wave's batched sims before the next wave's
+            # init allocates: the one-wave peak-memory contract (fold_j
+            # has the buffers; the host must not keep a second live
+            # reference)
             sims = None
-        acc = fold_j(acc, sims)
-        # release the wave's batched sims before the next wave's init
-        # allocates: the one-wave peak-memory contract (fold_j has the
-        # buffers; the host must not keep a second live reference)
-        sims = None
-        n_waves += 1
-        lo += n
-        if on_wave is not None:
-            on_wave(n_waves, lo)
+            n_waves += 1
+            lo += n
+            if rec is not None:
+                rec.event(trace, "wave", n=n_waves, lanes_done=lo)
+            if on_wave is not None:
+                on_wave(n_waves, lo)
+    except BaseException:
+        if rec is not None:
+            rec.end_trace(trace, "error")
+        raise
+    if rec is not None:
+        rec.end_trace(trace, "completed", n_waves=n_waves)
 
     return StreamResult(
         summary=acc[0],

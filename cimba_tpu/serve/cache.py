@@ -163,13 +163,22 @@ class ProgramCache(MutableMapping):
         return self._cap
 
     def stats(self) -> dict:
+        """An atomic counter snapshot (one lock acquisition for every
+        cache-local value — a scrape never sees a hits/misses pair from
+        two different moments).  This dict is what the telemetry
+        sampler mirrors into the ``cimba_program_cache_*`` metric
+        families (docs/17_telemetry.md); ``hit_ratio`` is the
+        cache-health headline, in the spirit of compiler-artifact
+        caching stacks where hit ratio is a first-class signal."""
         with self._lock:
+            lookups = self.hits + self.misses
             out = {
                 "capacity": self._cap,
                 "size": len(self._od),
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "hit_ratio": self.hits / lookups if lookups else 0.0,
             }
         st = self.store
         if st is not None:

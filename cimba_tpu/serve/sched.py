@@ -160,14 +160,34 @@ class AdmissionQueue:
         (the queue stays generic) group under ``None``.  O(depth) scan
         under the lock: the queue is bounded by ``capacity``."""
         with self._lock:
-            out: dict = {}
-            for _, e in self._heap:
-                c = getattr(e, "cls", None)
-                out[c] = out.get(c, 0) + 1
-            for d in self._delayed:
-                c = getattr(d.entry, "cls", None)
-                out[c] = out.get(c, 0) + 1
-            return out
+            return self._class_depths_locked()
+
+    def _class_depths_locked(self) -> dict:
+        out: dict = {}
+        for _, e in self._heap:
+            c = getattr(e, "cls", None)
+            out[c] = out.get(c, 0) + 1
+        for d in self._delayed:
+            c = getattr(d.entry, "cls", None)
+            out[c] = out.get(c, 0) + 1
+        return out
+
+    def snapshot(self) -> dict:
+        """``{"depth", "depth_hwm", "capacity", "by_class"}`` read under
+        ONE lock acquisition — the atomic view ``Service.stats()`` (and
+        the telemetry scraper behind ``/metrics``) reports, so a scrape
+        landing mid-dispatch can never see a total depth that
+        contradicts its own per-class breakdown (``depth`` is always
+        exactly ``sum(by_class.values())``; the torn-read audit of
+        docs/17_telemetry.md)."""
+        with self._lock:
+            by_class = self._class_depths_locked()
+            return {
+                "depth": len(self._heap) + len(self._delayed),
+                "depth_hwm": self.depth_hwm,
+                "capacity": self.capacity,
+                "by_class": by_class,
+            }
 
     # -- admission -----------------------------------------------------------
 
@@ -217,6 +237,15 @@ class AdmissionQueue:
                 heapq.heappush(
                     self._delayed,
                     _Delayed(time.monotonic() + delay, entry.seq, entry),
+                )
+                # the high-water mark tracks DEPTH (ready + delayed);
+                # a backoff-delayed entry raises depth exactly like a
+                # ready one, so it must ratchet the mark the same way
+                # _push does — stats() would otherwise report a depth
+                # above its own recorded maximum
+                self.depth_hwm = max(
+                    self.depth_hwm,
+                    len(self._heap) + len(self._delayed),
                 )
             else:
                 self._push(entry)

@@ -117,6 +117,7 @@ class _Entry:
         "with_metrics", "next_lo", "acc", "n_waves", "retries", "solo",
         "cancelled", "in_flight", "submit_t", "first_dispatch_t",
         "deadline_at", "done", "result", "exc",
+        "trace", "span_root", "span_queue", "span_wave",
     )
 
     def __init__(self, request, seq, cls, eff_wave, with_metrics):
@@ -143,6 +144,12 @@ class _Entry:
         self.done = threading.Event()
         self.result = None
         self.exc = None
+        # telemetry span state — all None when the service has no
+        # telemetry plane (the zero-allocation hot-submit contract)
+        self.trace = None
+        self.span_root = None
+        self.span_queue = None
+        self.span_wave = None
 
 
 class ResultHandle:
@@ -213,7 +220,17 @@ class Service:
       short request can be held hostage by a long wave-mate to one
       bucket ratio.  ``None`` packs ALL finite horizons together
       (truncation stays exact either way; this is purely a latency
-      policy)."""
+      policy).
+
+    ``telemetry`` (default None) attaches a
+    :class:`cimba_tpu.obs.telemetry.Telemetry` plane: the background
+    sampler scrapes :meth:`stats` into the time-series registry, the
+    dispatcher loop heartbeats for ``/healthz`` liveness, request
+    latencies feed the log2 histograms, and — with spans enabled — a
+    ``trace_id`` minted at :meth:`submit` threads through
+    admit → queue → pack → wave → chunk → fold → deliver as a JSONL
+    span log (docs/17_telemetry.md).  None is strictly zero-cost: no
+    threads, no span allocations, compiled programs untouched."""
 
     def __init__(
         self,
@@ -229,11 +246,13 @@ class Service:
         trace_cap: int = 4096,
         pad_waves: bool = True,
         horizon_bucket: Optional[float] = 16.0,
+        telemetry=None,
         name: str = "cimba-serve",
     ):
         if max_wave <= 0:
             raise ValueError(f"max_wave must be positive: {max_wave}")
         self.max_wave = int(max_wave)
+        self.name = name
         self.mesh = mesh
         self.poll_every = poll_every
         self.max_retries = int(max_retries)
@@ -269,6 +288,14 @@ class Service:
         self._ttfw_sum = 0.0
         self._ttfw_max = 0.0
         self._ttfw_n = 0
+        # the host-side telemetry plane (docs/17_telemetry.md) — None
+        # (the default) means zero overhead: no sampler thread, no span
+        # objects on the submit path, nothing new on the dispatch path
+        self._tel = telemetry
+        self._tel_name = (
+            telemetry.attach_service(self, name)
+            if telemetry is not None else None
+        )
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True
         )
@@ -324,6 +351,24 @@ class Service:
             entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
             self._outstanding += 1
+        rec = self._tel.spans if self._tel is not None else None
+        if rec is not None:
+            # the trace_id minted at submit — threaded through
+            # admit → queue → pack → wave → chunk → fold → deliver.
+            # The whole tree skeleton (root AND queue span) exists
+            # BEFORE the entry is published to the queue: the moment
+            # put() returns, the dispatcher may pack, run, and even
+            # finish the request, and a span started after that would
+            # resurrect the already-ended trace as a permanent leak.
+            entry.trace = rec.new_trace()
+            entry.span_root = rec.start(
+                entry.trace, "request", seq=entry.seq,
+                label=entry.label, service=self._tel_name,
+                lanes=R,
+            )
+            entry.span_queue = rec.start(
+                entry.trace, "queue", parent=entry.span_root
+            )
         try:
             self._queue.put(entry, block=block, timeout=timeout)
         except (QueueFull, ServiceClosed):
@@ -331,9 +376,15 @@ class Service:
                 self._outstanding -= 1
                 self._counters["rejected"] += 1
                 self._drained.notify_all()
+            if rec is not None:
+                rec.end_trace(entry.trace, "rejected")
             raise
         with self._lock:
             self._counters["admitted"] += 1
+        if rec is not None:
+            # instant marker only — safe after put even if the request
+            # already completed (events never re-open a trace)
+            rec.event(entry.trace, "admit", parent=entry.span_root)
         return ResultHandle(self, entry)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -369,6 +420,12 @@ class Service:
         self._stop = True
         self._queue.kick()
         self._thread.join(timeout)
+        if self._tel is not None:
+            # stop being observed: the plane takes a final stats
+            # sample, then drops its collector and reference — a
+            # long-lived Telemetry over a churn of services must not
+            # pin or keep scraping shut-down ones (idempotent)
+            self._tel.detach_service(self)
 
     def __enter__(self):
         return self
@@ -384,16 +441,31 @@ class Service:
         (requests per packed wave), lane-level occupancy (live vs
         padded lanes — padding waste is observable, not just
         request-count occupancy), time-to-first-wave aggregate, and
-        the shared program cache's hit/miss/eviction counters."""
+        the shared program cache's hit/miss/eviction counters.
+
+        Every value is read under either the service lock or the
+        queue's one-acquisition :meth:`AdmissionQueue.snapshot`, so a
+        scrape landing mid-dispatch is an atomic snapshot: the
+        queue-depth total always equals the sum of its per-class
+        breakdown, and the lane/occupancy counters always describe
+        waves that were actually recorded together (the torn-read
+        audit; tests/test_telemetry.py hammers this under live load).
+        The dict IS the telemetry snapshot the background sampler
+        scrapes into the ``/metrics`` registry (docs/17_telemetry.md)."""
         with self._lock:
+            qs = self._queue.snapshot()
             out = dict(self._counters)
-            out["queue_depth"] = self._queue.depth()
-            out["queue_depth_hwm"] = self._queue.depth_hwm
+            out["queue_depth"] = qs["depth"]
+            out["queue_depth_hwm"] = qs["depth_hwm"]
+            out["queue_capacity"] = qs["capacity"]
+            # every class ever seen reports, zeros included — a gauge
+            # mirrored from this dict must drop to 0 when a class
+            # drains, not stick at its last nonzero depth (the same
+            # rule _class_sample applies to the chrome counter tracks)
             out["queue_depth_by_class"] = {
-                self._class_ids.get(c, "class?"): d
-                for c, d in sorted(
-                    self._queue.class_depths().items(),
-                    key=lambda cd: self._class_ids.get(cd[0], ""),
+                label: qs["by_class"].get(c, 0)
+                for c, label in sorted(
+                    self._class_ids.items(), key=lambda cl: cl[1],
                 )
             }
             out["classes_seen"] = len(self._class_ids)
@@ -436,11 +508,34 @@ class Service:
         ``obs.export`` emits, and it passes
         ``obs.export.validate_chrome_trace``): each request is one
         complete 'X' span on its own pid track, service stats ride in
-        ``otherData.service``."""
+        ``otherData.service``.  With a telemetry plane recording spans
+        (docs/17_telemetry.md), each request's pid track additionally
+        carries its queue/wave child spans and chunk/fold/deliver
+        instants — the same span tree the JSONL log streams."""
         with self._lock:
             spans = list(self._spans)
             depths = list(self._depth_samples)
+        children: dict = {}
+        if self._tel is not None and self._tel.spans is not None:
+            # the telemetry span trees (queue/wave spans, chunk/fold/
+            # deliver instants) ride their request's pid track, one tid
+            # per phase; the root "request" span is skipped — the
+            # service's own lifecycle span below already draws it
+            trace_pid = {
+                s["trace"]: s["seq"] for s in spans
+                if s.get("trace") is not None
+            }
+            tids = {"queue": 1, "wave": 2, "chunk": 3, "fold": 3,
+                    "admit": 3, "deliver": 3}
+            for e in self._tel.spans.chrome_events(
+                self._t0,
+                pid_of=trace_pid.get,
+                tid_of=lambda n: tids.get(n, 4),
+            ):
+                if e["name"] != "request":
+                    children.setdefault(e["pid"], []).append(e)
         events = []
+        meta = []
         for s in spans:
             events.append({
                 "name": s["label"] or f"request {s['seq']}",
@@ -456,7 +551,13 @@ class Service:
                     "retries": s["retries"],
                 },
             })
-            events.append({
+            # child spans spliced right after their root, sorted by ts:
+            # every child starts at or after submit, so the pid track
+            # stays timestamp-monotone (the validator's contract)
+            events.extend(sorted(
+                children.pop(s["seq"], ()), key=lambda e: e["ts"]
+            ))
+            meta.append({
                 "name": "process_name", "ph": "M", "pid": s["seq"],
                 "args": {"name": s["label"] or f"request {s['seq']}"},
             })
@@ -493,7 +594,7 @@ class Service:
                     "args": {"live": live, "padded": padded},
                 })
         return {
-            "traceEvents": events,
+            "traceEvents": events + meta,
             "displayTimeUnit": "ms",
             "otherData": {"service": self.stats()},
         }
@@ -608,6 +709,10 @@ class Service:
             entry.exc = exc
             now = time.monotonic()
             self._counters[outcome] += 1
+            ttfw = (
+                None if entry.first_dispatch_t is None
+                else entry.first_dispatch_t - entry.submit_t
+            )
             self._spans.append({
                 "seq": entry.seq,
                 "label": entry.label,
@@ -615,23 +720,39 @@ class Service:
                 "end": now,
                 "outcome": outcome,
                 "lanes": entry.request.n_replications,
-                "ttfw": (
-                    None if entry.first_dispatch_t is None
-                    else entry.first_dispatch_t - entry.submit_t
-                ),
+                "ttfw": ttfw,
                 "retries": entry.retries,
+                "trace": entry.trace,
             })
-            if entry.first_dispatch_t is not None:
-                ttfw = entry.first_dispatch_t - entry.submit_t
+            if ttfw is not None:
                 self._ttfw_sum += ttfw
                 self._ttfw_max = max(self._ttfw_max, ttfw)
                 self._ttfw_n += 1
             self._outstanding -= 1
             entry.done.set()
             self._drained.notify_all()
+        tel = self._tel
+        if tel is not None:
+            tel.observe_request(
+                self._tel_name, outcome, now - entry.submit_t, ttfw
+            )
+            if entry.trace is not None:
+                rec = tel.spans
+                rec.event(entry.trace, "deliver",
+                          parent=entry.span_root, outcome=outcome)
+                # closes any still-open queue/wave spans first — a
+                # cancelled, deadline-expired, or retries-exhausted
+                # request still yields one COMPLETE span tree
+                rec.end_trace(entry.trace, outcome,
+                              retries=entry.retries)
 
     def _loop(self) -> None:
         while True:
+            if self._tel is not None:
+                # liveness: the dispatcher beats at least once per
+                # queue poll (and per chunk, via the _run_batch hook),
+                # which is what /healthz judges "stalled" against
+                self._tel.heartbeat(f"serve.{self._tel_name}.dispatch")
             entry = self._queue.pop_ready(timeout=0.25)
             if entry is None:
                 if self._stop or (self._closed and self._outstanding == 0):
@@ -764,6 +885,19 @@ class Service:
                 time.monotonic(), self._queue.depth(),
                 self._class_sample(), total, padded,
             ))
+        rec = self._tel.spans if self._tel is not None else None
+        if rec is not None:
+            for e in members:
+                if e.trace is None:
+                    continue
+                if e.span_queue is not None:
+                    rec.end(e.span_queue)
+                    e.span_queue = None
+                e.span_wave = rec.start(
+                    e.trace, "wave", parent=e.span_root,
+                    batch=self._counters["batches"],
+                    members=len(members), lanes=total, padded=padded,
+                )
         return slots, members
 
     def _run_batch(self, slots):
@@ -875,9 +1009,27 @@ class Service:
                 lambda *xs: jnp.concatenate(xs, axis=0), *pws
             )
         sims = init_j(reps_cat, seed_cat, ts_cat, pw_cat)
+        on_chunk = self._on_chunk
+        tel = self._tel
+        if tel is not None:
+            user_hook = self._on_chunk
+            src = f"serve.{self._tel_name}.chunk"
+            rec = tel.spans
+
+            def on_chunk(n):
+                # per-chunk telemetry tick (heartbeat + counter) and —
+                # with spans on — an instant event on the LEAD's wave
+                # span: the chunk leg of the request-scoped trace
+                tel.tick(src)
+                if rec is not None and lead.span_wave is not None:
+                    rec.event(lead.trace, "chunk",
+                              parent=lead.span_wave, n=n)
+                if user_hook is not None:
+                    user_hook(n)
+
         return drive_chunks(
             chunk_j, sims, poll_every=self.poll_every,
-            on_chunk=self._on_chunk,
+            on_chunk=on_chunk,
         )
 
     def _fold_slots(self, slots, sims) -> None:
@@ -909,6 +1061,11 @@ class Service:
             entry.n_waves += 1
             entry.next_lo = lo + n
             off += n
+            if entry.trace is not None:
+                self._tel.spans.event(
+                    entry.trace, "fold", parent=entry.span_wave,
+                    lo=lo, n=n,
+                )
 
     def _complete_members(self, members) -> None:
         """After a successful fold: finish done requests, requeue the
@@ -917,11 +1074,19 @@ class Service:
         for entry in members:
             with self._lock:
                 entry.in_flight = False
+            if entry.trace is not None and entry.span_wave is not None:
+                self._tel.spans.end(entry.span_wave, outcome="ok")
+                entry.span_wave = None
             if entry.next_lo >= entry.request.n_replications:
                 self._finish_completed(entry)
             else:
                 # a request larger than one packed wave: remaining
                 # slots go back through the queue at its own priority
+                if entry.trace is not None:
+                    entry.span_queue = self._tel.spans.start(
+                        entry.trace, "queue", parent=entry.span_root,
+                        requeue=True,
+                    )
                 self._queue.requeue(entry)
 
     def _finish_completed(self, entry: _Entry) -> None:
@@ -962,6 +1127,12 @@ class Service:
         for entry in members:
             with self._lock:
                 entry.in_flight = False
+            if entry.trace is not None and entry.span_wave is not None:
+                self._tel.spans.end(
+                    entry.span_wave, outcome="error",
+                    error=type(exc).__name__,
+                )
+                entry.span_wave = None
             if entry.next_lo >= entry.request.n_replications:
                 # every one of ITS slots folded before the batch died
                 # (a later member's fold failed): the result is whole —
@@ -988,6 +1159,11 @@ class Service:
             else:
                 with self._lock:
                     self._counters["retries"] += 1
+                if entry.trace is not None:
+                    entry.span_queue = self._tel.spans.start(
+                        entry.trace, "queue", parent=entry.span_root,
+                        retry=entry.retries, backoff=True,
+                    )
                 self._queue.requeue(
                     entry,
                     delay=self.backoff.delay(max(entry.retries, 1)),

@@ -568,6 +568,16 @@ class ProgramStore:
         with self._lock:
             out = dict(self._stats)
         out["root"] = self.root
+        # boolean health flags for /healthz (docs/17_telemetry.md): any
+        # of these true means the store degraded at least once this
+        # process — serving still works (every rung degrades to
+        # recompile), but an operator should look before trusting
+        # cold-start numbers
+        out["flags"] = {
+            "corruption": out["corrupt"] > 0,
+            "invalidated": out["invalidated"] > 0,
+            "downgraded": out["downgrades"] > 0,
+        }
         return out
 
     # -- manifest ------------------------------------------------------------

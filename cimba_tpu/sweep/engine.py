@@ -231,6 +231,7 @@ def run_sweep(
     serve_timeout: float = 600.0,
     on_round: Optional[Callable] = None,
     on_chunk: Optional[Callable] = None,
+    telemetry=None,
 ) -> SweepResult:
     """Run a scenario grid: ``reps_per_cell`` replications per cell
     (per ROUND when ``stop`` is given), folded into per-cell pooled
@@ -259,7 +260,13 @@ def run_sweep(
     wave shapes with dead ``t_stop=-inf`` lanes (bitwise-inert; a mesh
     always pads to its device-count multiple).  ``on_round(round,
     n_live, reps_total)`` is the progress hook (bench.py's watchdog
-    heartbeat ticks there)."""
+    heartbeat ticks there).  ``telemetry`` attaches the host-side
+    telemetry plane (docs/17_telemetry.md): per-round/per-chunk ticks
+    (counter + liveness heartbeat) and — with spans enabled — one
+    "sweep" trace whose per-round spans carry live-cell/replication
+    counts; serve-backed sweeps additionally get the service's own
+    request spans per (cell, round).  Host-side only: results are
+    bitwise identical with or without it."""
     import jax
     import jax.numpy as jnp
 
@@ -297,6 +304,16 @@ def run_sweep(
     if summary_path is None:
         summary_path = ex.default_summary_path
     with_metrics = _metrics.enabled()
+
+    _, on_chunk = ex._tel_hooks(telemetry, "sweep", None, on_chunk)
+    rec = telemetry.spans if telemetry is not None else None
+    trace = root = None
+    if rec is not None:
+        trace = rec.new_trace()
+        root = rec.start(
+            trace, "sweep", grid=grid.name, n_cells=C,
+            adaptive=stop is not None, serve_backed=service is not None,
+        )
 
     t0 = time.perf_counter()
     occ = {
@@ -460,32 +477,50 @@ def run_sweep(
     n_rounds = 0
     total_rounds = 1 if stop is None else int(max_rounds)
     rep_cap = max(R0, max_wave)
-    while n_rounds < total_rounds and live.any():
-        live_cells = np.flatnonzero(live)
-        if stop is not None and redistribute:
-            reps_r = min(max(R0, R0 * C // len(live_cells)), rep_cap)
-        else:
-            reps_r = R0
-        jobs = [
-            (int(c), round_seed(seed, int(c), n_rounds), reps_r)
-            for c in live_cells
-        ]
-        if service is None:
-            dispatch_direct(jobs)
-        else:
-            dispatch_serve(jobs, n_rounds)
-        for c, _, n in jobs:
-            n_reps[c] += n
-        n_rounds += 1
-        if stop is not None:
-            met_now = stop.met(_stack_summaries(accs), n_reps)
-            newly = live & met_now
-            stop_round[np.flatnonzero(newly)] = n_rounds - 1
-            live &= ~met_now
-        else:
-            live[:] = False
-        if on_round is not None:
-            on_round(n_rounds, int(live.sum()), int(n_reps.sum()))
+    try:
+        while n_rounds < total_rounds and live.any():
+            live_cells = np.flatnonzero(live)
+            if stop is not None and redistribute:
+                reps_r = min(max(R0, R0 * C // len(live_cells)), rep_cap)
+            else:
+                reps_r = R0
+            jobs = [
+                (int(c), round_seed(seed, int(c), n_rounds), reps_r)
+                for c in live_cells
+            ]
+            span_round = None
+            if rec is not None:
+                span_round = rec.start(
+                    trace, "round", parent=root, round=n_rounds,
+                    n_live=len(live_cells), reps_per_cell=reps_r,
+                )
+            if service is None:
+                dispatch_direct(jobs)
+            else:
+                dispatch_serve(jobs, n_rounds)
+            for c, _, n in jobs:
+                n_reps[c] += n
+            n_rounds += 1
+            if stop is not None:
+                met_now = stop.met(_stack_summaries(accs), n_reps)
+                newly = live & met_now
+                stop_round[np.flatnonzero(newly)] = n_rounds - 1
+                live &= ~met_now
+            else:
+                live[:] = False
+            if span_round is not None:
+                rec.end(span_round, outcome="ok",
+                        still_live=int(live.sum()))
+            if telemetry is not None:
+                telemetry.tick("sweep.round")
+            if on_round is not None:
+                on_round(n_rounds, int(live.sum()), int(n_reps.sum()))
+    except BaseException:
+        if rec is not None:
+            rec.end_trace(trace, "error")
+        raise
+    if rec is not None:
+        rec.end_trace(trace, "completed", rounds=n_rounds)
 
     confidence = 0.95 if stop is None else stop.confidence
     from cimba_tpu.sweep.adaptive import _halfwidths_jit

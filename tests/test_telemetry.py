@@ -1,0 +1,634 @@
+"""The host-side telemetry plane (docs/17_telemetry.md).
+
+Contracts pinned here:
+
+* **registry**: counters/gauges/log2-histograms with labels render to
+  Prometheus text that round-trips through the in-repo minimal parser
+  (the same one tools/metrics_dump.py uses); log2 bucket edges land on
+  exact powers of two; ring history is bounded;
+* **atomic snapshots**: ``AdmissionQueue.snapshot()`` and
+  ``Service.stats()`` are torn-read-free — a scraper thread hammering
+  a live mixed load never sees a queue-depth total that contradicts
+  its per-class breakdown, occupancy that doesn't add up, or a counter
+  going backwards;
+* **exposition**: ``/metrics`` parses and carries the request
+  counters, ``/healthz`` is OK on a live service, ``/varz`` is JSON —
+  over real HTTP on an ephemeral port, scraped both raw and through
+  ``tools/metrics_dump.py``;
+* **span lifecycle**: every submitted request — completed, cancelled,
+  deadline-exceeded, retries-exhausted — yields exactly ONE complete
+  span tree in the JSONL log (single root, parents resolve, nothing
+  left open), and ``chrome_trace()`` with spans enabled still passes
+  ``obs.export.validate_chrome_trace``;
+* **disabled == zero overhead**: ``telemetry=None`` starts no threads
+  and allocates no span state on the submit path, and results (serve
+  and stream) are BITWISE identical with the plane on or off — the
+  host-side image of ``obs.trace``'s disabled == jaxpr-identical rule.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.obs import expose as xp
+from cimba_tpu.obs import telemetry as tm
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.serve.sched import AdmissionQueue
+from cimba_tpu.stats import summary as sm
+
+
+def _tiny_spec(t_stop=12.0):
+    """The serve-test tiny model: one process holding unit steps —
+    compiles in a fraction of mm1's time."""
+    m = Model("tinytel", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    """Module-level summary path (fold/compat keys pin its identity)."""
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_spec()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+def _req(spec, R=4, *, seed=1, **kw):
+    return serve.Request(
+        spec, (), R, seed=seed, chunk_steps=16,
+        summary_path=_clock_path, **kw,
+    )
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+class _Gated(serve.Service):
+    """Dispatch blocks until the test opens the gate (queue states are
+    constructed, not raced) — the test_serve.py idiom."""
+
+    def __init__(self, **kw):
+        self.gate = threading.Event()
+        super().__init__(**kw)
+
+    def _run_batch(self, slots):
+        assert self.gate.wait(60), "test gate never opened"
+        return super()._run_batch(slots)
+
+
+# --------------------------------------------------------------------------
+# registry + prometheus round-trip
+# --------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_roundtrip():
+    reg = tm.Registry(history=8)
+    c = reg.counter("cimba_test_ops_total", "ops", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc(5)
+    g = reg.gauge("cimba_test_depth", "depth")
+    g.set(3.5)
+    h = reg.histogram("cimba_test_lat_seconds", "lat", labels=("o",))
+    for v in (0.001, 0.5, 0.5, 3.0):
+        h.labels(o="ok").observe(v)
+
+    # get-or-create returns the SAME family; kind drift is loud
+    assert reg.counter("cimba_test_ops_total", labels=("kind",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("cimba_test_ops_total")
+    # counters only go up; set_total mirrors are monotone
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)
+    c.labels(kind="b").set_total(4)  # below current 5: ignored
+    assert c.get(kind="b") == 5.0
+    c.labels(kind="b").set_total(9)
+    assert c.get(kind="b") == 9.0
+
+    text = xp.render_prometheus(reg)
+    parsed = xp.parse_prometheus_text(text)
+    assert parsed["types"]["cimba_test_ops_total"] == "counter"
+    assert parsed["types"]["cimba_test_lat_seconds"] == "histogram"
+    assert parsed["samples"]["cimba_test_ops_total"][
+        (("kind", "a"),)
+    ] == 3.0
+    assert parsed["samples"]["cimba_test_depth"][()] == 3.5
+    key_inf = (("le", "+Inf"), ("o", "ok"))
+    assert parsed["samples"]["cimba_test_lat_seconds_bucket"][
+        key_inf
+    ] == 4.0
+    assert parsed["samples"]["cimba_test_lat_seconds_count"][
+        (("o", "ok"),)
+    ] == 4.0
+    assert parsed["samples"]["cimba_test_lat_seconds_sum"][
+        (("o", "ok"),)
+    ] == pytest.approx(4.001)
+    # label escaping round-trips — including the adversarial cases: a
+    # value ENDING in a backslash (the closing quote follows an escaped
+    # backslash) and a literal backslash-then-n (must not come back as
+    # a newline)
+    g2 = reg.gauge("cimba_test_esc", "esc", labels=("path",))
+    for v in ('a"b\\c\nd', "trail\\", "x\\n,y", "srv\\1"):
+        g2.labels(path=v).set(1)
+    parsed2 = xp.parse_prometheus_text(xp.render_prometheus(reg))
+    for v in ('a"b\\c\nd', "trail\\", "x\\n,y", "srv\\1"):
+        assert parsed2["samples"]["cimba_test_esc"][
+            (("path", v),)
+        ] == 1.0
+
+
+def test_histogram_log2_bucket_edges():
+    reg = tm.Registry()
+    h = reg.histogram("cimba_test_h", "h")
+    # an exact power of two sits ON its boundary (le = itself); one ulp
+    # above rolls into the next bucket
+    h.observe(1.0)      # -> le=1  (2^0)
+    h.observe(1.0001)   # -> le=2  (2^1)
+    h.observe(0.75)     # -> le=1
+    h.observe(4.0)      # -> le=4  (2^2)
+    h.observe(0.0)      # non-positive: clamps to the lowest bucket
+    h.observe(float("inf"))  # clamps to the highest bucket
+    fam = reg.collect()[-1]
+    s = fam["series"][0]
+    assert s["buckets"][0] == 2          # le=2^0: 1.0 and 0.75
+    assert s["buckets"][1] == 1          # le=2^1: 1.0001
+    assert s["buckets"][2] == 1          # le=2^2: 4.0
+    assert s["buckets"][tm._EXP_MIN] == 1
+    assert s["buckets"][tm._EXP_MAX] == 1
+    assert s["count"] == 6
+    # cumulative rendering is monotone and ends at count
+    text = xp.render_prometheus(reg)
+    parsed = xp.parse_prometheus_text(text)
+    buckets = parsed["samples"]["cimba_test_h_bucket"]
+    vals = [v for _, v in sorted(
+        buckets.items(),
+        key=lambda kv: float(dict(kv[0])["le"].replace("+Inf", "inf")),
+    )]
+    assert vals == sorted(vals) and vals[-1] == 6.0
+
+
+def test_ring_history_bounded_and_sampled():
+    reg = tm.Registry(history=4)
+    g = reg.gauge("cimba_test_g", "g")
+    for i in range(10):
+        g.set(i)
+        reg.tick_history(t=float(i))
+    hist = reg.collect()[0]["series"][0]["history"]
+    assert len(hist) == 4                      # bounded ring
+    assert [v for _, v in hist] == [6.0, 7.0, 8.0, 9.0]
+    assert [t for t, _ in hist] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_admission_queue_snapshot_is_one_lock_view():
+    class E:
+        def __init__(self, seq, prio, cls):
+            self.seq, self.priority, self.cls = seq, prio, cls
+            self.label = f"e{seq}"
+
+    q = AdmissionQueue(capacity=8)
+    for i, cls in enumerate(["a", "a", "b", None]):
+        q.put(E(i, 0, cls))
+    q.requeue(E(9, 0, "b"), delay=30.0)     # delayed entries count too
+    snap = q.snapshot()
+    assert snap["depth"] == 5
+    assert snap["depth"] == sum(snap["by_class"].values())
+    assert snap["by_class"] == {"a": 2, "b": 2, None: 1}
+    assert snap["capacity"] == 8
+    assert snap["depth_hwm"] >= snap["depth"]
+
+
+# --------------------------------------------------------------------------
+# exposition over a live service (+ the operator CLI)
+# --------------------------------------------------------------------------
+
+
+def test_exposition_endpoints_and_metrics_dump(
+    tiny, shared_cache, tmp_path, capsys,
+):
+    import urllib.request
+
+    span_path = tmp_path / "spans.jsonl"
+    tel = tm.Telemetry(interval=0.05, spans=True, span_path=span_path)
+    with xp.start(tel) as srv:
+        with serve.Service(
+            max_wave=16, cache=shared_cache, telemetry=tel,
+        ) as svc:
+            results = [
+                svc.submit(_req(tiny, seed=i + 1, label=f"r{i}"))
+                .result(60)
+                for i in range(3)
+            ]
+            tel.sample()     # deterministic scrape (sampler also runs)
+            met = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10
+            ).read().decode()
+            hz = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            assert hz.status == 200
+            health = json.loads(hz.read())
+            varz = json.loads(urllib.request.urlopen(
+                srv.url + "/varz", timeout=10
+            ).read())
+            # the operator CLI against the SAME live endpoint: parses,
+            # prints, exits 0 on a healthy service
+            import importlib.util
+            import os as _os
+
+            spec_ = importlib.util.spec_from_file_location(
+                "metrics_dump", _os.path.join(
+                    _os.path.dirname(_os.path.dirname(
+                        _os.path.abspath(__file__)
+                    )), "tools", "metrics_dump.py",
+                ),
+            )
+            md = importlib.util.module_from_spec(spec_)
+            spec_.loader.exec_module(md)
+            assert md.main(["--url", srv.url]) == 0
+            out = capsys.readouterr().out
+            assert "cimba_serve_requests_completed_total" in out
+            assert "HEALTH: ok" in out
+    tel.close()
+
+    parsed = xp.parse_prometheus_text(met)
+    key = (("service", "cimba-serve"),)
+    s = parsed["samples"]
+    assert s["cimba_serve_requests_completed_total"][key] == 3.0
+    assert s["cimba_serve_requests_submitted_total"][key] == 3.0
+    assert s["cimba_serve_queue_depth"][key] == 0.0
+    assert parsed["types"][
+        "cimba_serve_request_latency_seconds"
+    ] == "histogram"
+    lat_count = s["cimba_serve_request_latency_seconds_count"]
+    assert lat_count[
+        (("outcome", "completed"), ("service", "cimba-serve"))
+    ] == 3.0
+    assert health["status"] == "ok"
+    assert health["services"]["cimba-serve"]["dispatcher_alive"]
+    assert varz["spans"]["open"] == 0
+    # shutdown detached the service: the plane no longer health-checks
+    # (or pins) it, but the final counter values stay in the registry
+    assert tel.healthz()["services"] == {}
+    assert tel.registry.get_sample(
+        "cimba_serve_requests_completed_total", service="cimba-serve"
+    ) == 3.0
+    assert any(
+        f["name"] == "cimba_serve_requests_completed_total"
+        for f in varz["metrics"]
+    )
+    # and the serving results are REAL: bitwise the direct calls
+    direct = ex.run_experiment_stream(
+        tiny, (), 4, wave_size=4, chunk_steps=16, seed=1,
+        summary_path=_clock_path, program_cache=shared_cache,
+    )
+    for a, b in zip(
+        jax.tree.leaves(results[0].summary),
+        jax.tree.leaves(direct.summary),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------------------------
+# span lifecycle: all four request outcomes, one complete tree each
+# --------------------------------------------------------------------------
+
+
+class _GatedPoison(_Gated):
+    def _run_batch(self, slots):
+        if slots[0][0].label == "poison":
+            raise RuntimeError("injected dispatch failure")
+        return super()._run_batch(slots)
+
+
+def test_span_lifecycle_all_four_outcomes(tiny, shared_cache, tmp_path):
+    from cimba_tpu.obs import export as oe
+
+    span_path = tmp_path / "lifecycle.jsonl"
+    tel = tm.Telemetry(interval=0, spans=True, span_path=span_path)
+    svc = _GatedPoison(
+        max_wave=8, cache=shared_cache, telemetry=tel,
+        max_retries=0, backoff=serve.Backoff(base=0.01, cap=0.01),
+    )
+    try:
+        lead = svc.submit(_req(tiny, label="lead"))
+        _wait(lambda: svc.stats()["batches"] == 1)
+        h_cancel = svc.submit(_req(tiny, seed=2, label="victim"))
+        h_dead = svc.submit(
+            _req(tiny, seed=3, label="late", deadline=0.01)
+        )
+        h_poison = svc.submit(_req(tiny, seed=4, label="poison"))
+        assert h_cancel.cancel()
+        time.sleep(0.05)      # let the deadline expire while queued
+        svc.gate.set()
+        assert lead.result(60) is not None
+        with pytest.raises(serve.Cancelled):
+            h_cancel.result(60)
+        with pytest.raises(serve.DeadlineExceeded):
+            h_dead.result(60)
+        with pytest.raises(serve.RetriesExhausted):
+            h_poison.result(60)
+        doc = svc.chrome_trace()
+        oe.validate_chrome_trace(doc)
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+        tel.close()
+
+    # the JSONL log: 4 traces, each exactly one complete tree
+    lines = [json.loads(l) for l in open(span_path)]
+    by_trace: dict = {}
+    for l in lines:
+        by_trace.setdefault(l["trace"], []).append(l)
+    assert len(by_trace) == 4
+    outcomes = {}
+    for trace, recs in by_trace.items():
+        spans = [r for r in recs if r.get("ph") != "i"]
+        roots = [r for r in spans if r["parent"] is None]
+        assert len(roots) == 1, (trace, spans)      # exactly one root
+        assert roots[0]["name"] == "request"
+        sids = {r["span"] for r in spans}
+        for r in recs:                # every parent resolves in-trace
+            assert r["parent"] is None or r["parent"] in sids, r
+        for r in spans:               # every span is complete
+            assert r["dur"] >= 0.0
+        outcomes[roots[0]["label"]] = roots[0]["outcome"]
+    assert outcomes == {
+        "lead": "completed",
+        "victim": "cancelled",
+        "late": "deadline_exceeded",
+        "poison": "failed",           # RetriesExhausted delivers failed
+    }
+    assert tel.spans.open_count() == 0               # no leaks
+    assert (
+        tel.spans.counters["spans_started"]
+        == tel.spans.counters["spans_ended"]
+    )
+    # the completed request's chrome track carries its child spans
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"queue", "wave", "fold", "deliver"} <= names
+    # latency histogram recorded every outcome
+    for outcome in ("completed", "cancelled", "deadline_exceeded",
+                    "failed"):
+        assert tel.registry.get_sample(
+            "cimba_serve_request_latency_seconds",
+            service="cimba-serve", outcome=outcome,
+        ) == 1.0
+
+
+def test_multiwave_and_rejected_spans_close(tiny, shared_cache):
+    """A request spanning several waves re-enters the queue between
+    them (queue → wave → queue → wave …) and still closes into one
+    tree; an admission-rejected submit closes its trace as rejected."""
+    tel = tm.Telemetry(interval=0, spans=True)
+    with serve.Service(
+        max_wave=4, max_pending=1, cache=shared_cache, telemetry=tel,
+    ) as svc:
+        assert svc.submit(
+            _req(tiny, R=12, wave_size=4, label="multi")
+        ).result(60) is not None
+        _wait(lambda: tel.spans.open_count() == 0)
+    # deterministic QueueFull: a gated service whose lead is claimed,
+    # one filler occupying the single queue slot, then a non-blocking
+    # submit — its freshly-minted trace must close as "rejected"
+    gated = _Gated(max_wave=4, max_pending=1, cache=shared_cache,
+                   telemetry=tel)
+    try:
+        lead = gated.submit(_req(tiny, label="glead"))
+        _wait(lambda: gated.stats()["batches"] == 1)
+        filler = gated.submit(_req(tiny, seed=2, label="filler"))
+        with pytest.raises(serve.QueueFull):
+            gated.submit(_req(tiny, seed=3, label="tooslow"),
+                         block=False)
+        gated.gate.set()
+        assert lead.result(60) is not None
+        assert filler.result(60) is not None
+    finally:
+        gated.gate.set()
+        gated.shutdown()
+    recs = list(tel.spans.completed)
+    multi = [r for r in recs if r["parent"] is None
+             and r.get("attrs", {}).get("label") == "multi"]
+    assert len(multi) == 1 and multi[0]["outcome"] == "completed"
+    mt = multi[0]["trace"]
+    waves = [r for r in recs if r["name"] == "wave"
+             and r["trace"] == mt]
+    queues = [r for r in recs if r["name"] == "queue"
+              and r["trace"] == mt]
+    assert len(waves) == 3 and len(queues) == 3     # 12 reps / wave 4
+    rejected = [r for r in recs if r.get("outcome") == "rejected"]
+    assert len(rejected) == 1
+    assert rejected[0]["attrs"]["label"] == "tooslow"
+    assert tel.spans.open_count() == 0
+    tel.close()
+
+
+# --------------------------------------------------------------------------
+# disabled == zero overhead
+# --------------------------------------------------------------------------
+
+
+def test_disabled_is_zero_overhead_and_bitwise(tiny, shared_cache):
+    plane_threads = ("cimba-telemetry", "cimba-exposition")
+    before = {
+        t.name for t in threading.enumerate()
+        if t.name in plane_threads
+    }
+    with serve.Service(max_wave=8, cache=shared_cache) as svc:
+        h = svc.submit(_req(tiny, label="plain"))
+        res_off = h.result(60)
+        # no span state allocated on the submit path
+        assert h._entry.trace is None
+        assert h._entry.span_root is None
+    after = {
+        t.name for t in threading.enumerate()
+        if t.name in plane_threads
+    }
+    assert after == before          # telemetry=None started no threads
+
+    # stream results bitwise identical with the full plane attached
+    # (sampler thread + spans) vs without — telemetry is host-side
+    # bookkeeping, the compiled programs and the folds never see it
+    st_off = ex.run_experiment_stream(
+        tiny, (), 8, wave_size=4, chunk_steps=16, seed=7,
+        summary_path=_clock_path, program_cache=shared_cache,
+    )
+    tel = tm.Telemetry(interval=0.01, spans=True)
+    tel.start()
+    st_on = ex.run_experiment_stream(
+        tiny, (), 8, wave_size=4, chunk_steps=16, seed=7,
+        summary_path=_clock_path, program_cache=shared_cache,
+        telemetry=tel,
+    )
+    tel.close()
+    for a, b in zip(
+        jax.tree.leaves((st_off.summary, st_off.n_failed,
+                         st_off.total_events)),
+        jax.tree.leaves((st_on.summary, st_on.n_failed,
+                         st_on.total_events)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the telemetry run hit the SAME compiled programs (no
+    # recompiles — the program key does not know telemetry exists)
+    misses_before = shared_cache.stats()["misses"]
+    tel2 = tm.Telemetry(interval=0, spans=True)
+    ex.run_experiment_stream(
+        tiny, (), 8, wave_size=4, chunk_steps=16, seed=7,
+        summary_path=_clock_path, program_cache=shared_cache,
+        telemetry=tel2,
+    )
+    assert shared_cache.stats()["misses"] == misses_before
+
+
+def test_runner_and_sweep_telemetry_ticks(tiny, shared_cache, tmp_path):
+    from test_sweep import _grid, _sweep_spec
+
+    tel = tm.Telemetry(interval=0, spans=True,
+                       span_path=tmp_path / "sweep.jsonl")
+    st = ex.run_experiment_stream(
+        tiny, (), 8, wave_size=4, chunk_steps=16, seed=3,
+        summary_path=_clock_path, program_cache=shared_cache,
+        telemetry=tel,
+    )
+    assert st.n_waves == 2
+    assert tel.registry.get_sample(
+        tm.METRIC_PREFIX + "ticks_total", source="stream.wave"
+    ) == 2.0
+    assert tel.registry.get_sample(
+        tm.METRIC_PREFIX + "ticks_total", source="stream.chunk"
+    ) >= 1.0
+    assert tel.heartbeat_age("stream.wave") < 60.0
+
+    from cimba_tpu import sweep
+
+    spec = _sweep_spec()
+    grid = _grid(means=(0.2, 0.9), n_steps=6)
+    res = sweep.run_sweep(
+        spec, grid, reps_per_cell=4, seed=5, cell_wave=4, max_wave=8,
+        chunk_steps=16, program_cache=pc.ProgramCache(), telemetry=tel,
+    )
+    assert res.n_rounds == 1
+    assert tel.registry.get_sample(
+        tm.METRIC_PREFIX + "ticks_total", source="sweep.round"
+    ) == 1.0
+    assert tel.spans.open_count() == 0
+    tel.close()
+    lines = [json.loads(l) for l in open(tmp_path / "sweep.jsonl")]
+    sweeps = [l for l in lines if l.get("name") == "sweep"]
+    rounds = [l for l in lines if l.get("name") == "round"]
+    assert len(sweeps) == 1 and sweeps[0]["outcome"] == "completed"
+    assert len(rounds) == 1 and rounds[0]["n_live"] == 2
+
+
+# --------------------------------------------------------------------------
+# the hammer: a scraper thread vs live mixed load, no torn reads
+# --------------------------------------------------------------------------
+
+
+def test_stats_hammer_scraper_vs_live_load(tiny, shared_cache):
+    """A scraper thread polls ``Service.stats()`` + the rendered
+    ``/metrics`` text as fast as it can while mixed traffic (different
+    seeds, two horizon buckets → two compatibility classes) runs.
+    EVERY snapshot must be internally consistent: the queue-depth total
+    equals its per-class sum, occupancy fractions match their own
+    numerator/denominator, outcome counts never exceed admissions, and
+    no counter ever decreases between consecutive snapshots."""
+    tel = tm.Telemetry(interval=0.01, spans=True)
+    svc = serve.Service(
+        max_wave=8, cache=shared_cache, telemetry=tel,
+    )
+    snapshots: list = []
+    bad: list = []
+    stop = threading.Event()
+
+    def scraper():
+        prev = None
+        while not stop.is_set():
+            st = svc.stats()
+            text = xp.render_prometheus(tel.registry)
+            try:
+                xp.parse_prometheus_text(text)
+            except ValueError as e:
+                bad.append(f"unparseable /metrics: {e}")
+            snapshots.append(st)
+            if st["queue_depth"] != sum(
+                st["queue_depth_by_class"].values()
+            ):
+                bad.append(f"torn queue depth: {st}")
+            occ = st["lane_occupancy"]
+            lanes = occ["lanes_live"] + occ["lanes_padded"]
+            want = occ["lanes_padded"] / lanes if lanes else 0.0
+            if occ["padding_waste_frac"] != want:
+                bad.append(f"torn occupancy: {occ}")
+            if st["admitted"] + st["rejected"] > st["submitted"]:
+                bad.append(f"counters out of order: {st}")
+            done = sum(
+                st[o] for o in (
+                    "completed", "failed", "cancelled",
+                    "deadline_exceeded",
+                )
+            )
+            if done > st["admitted"]:
+                bad.append(f"more outcomes than admissions: {st}")
+            if prev is not None:
+                for k in ("submitted", "admitted", "completed",
+                          "batches", "waves", "lanes_dispatched"):
+                    if st[k] < prev[k]:
+                        bad.append(f"counter {k} went backwards")
+            prev = st
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    try:
+        handles = []
+        for i in range(18):
+            handles.append(svc.submit(_req(
+                tiny, seed=i + 1, label=f"mix{i}",
+                t_end=5.0 if i % 3 else 500.0,   # two horizon buckets
+            )))
+        for h in handles:
+            assert h.result(120) is not None
+    finally:
+        stop.set()
+        t.join(10)
+        svc.shutdown()
+        tel.close()
+    assert not bad, bad[:5]
+    assert len(snapshots) > 20     # the scraper really hammered
+    final = svc.stats()
+    assert final["completed"] == 18
+    assert final["classes_seen"] == 2
+    # fast requests racing concurrent submits: the span skeleton is
+    # minted BEFORE the entry is published, so nothing can resurrect
+    # an ended trace — no span may be left open
+    assert tel.spans.open_count() == 0
+    assert (
+        tel.spans.counters["traces_started"]
+        == tel.spans.counters["traces_ended"]
+        == 18
+    )

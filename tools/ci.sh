@@ -379,6 +379,66 @@ print("program-store roundtrip OK: hydrated == direct bitwise,",
       "store", out["store"])
 EOF
 
+# telemetry smoke: a Service with the exposition server on an ephemeral
+# port under live requests — /healthz OK, /metrics parses with the right
+# request counters, the span JSONL is complete (one tree per request),
+# and every served result stays bitwise the direct call (telemetry must
+# never perturb programs; docs/17_telemetry.md)
+run_cell "telemetry smoke" python - <<'EOF'
+import json, tempfile, os, urllib.request
+import jax, numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.obs import expose as xp, telemetry as tm
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+fd, span_path = tempfile.mkstemp(suffix=".jsonl"); os.close(fd)
+tel = tm.Telemetry(interval=0.05, spans=True, span_path=span_path)
+cases = [("a", 60, 8, 1), ("b", 90, 8, 5), ("c", 75, 8, 9)]
+out = {}
+with xp.start(tel) as srv:
+    with serve.Service(max_wave=16, cache=cache, telemetry=tel) as svc:
+        for label, n, R, seed in cases:
+            out[label] = svc.submit(serve.Request(
+                spec, mm1.params(n), R, seed=seed, wave_size=8,
+                chunk_steps=64, label=label,
+            )).result(600)
+        tel.sample()
+        hz = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert hz.status == 200, hz.status
+        health = json.loads(hz.read())
+        assert health["status"] == "ok", health
+        met = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+parsed = xp.parse_prometheus_text(met)
+done = parsed["samples"]["cimba_serve_requests_completed_total"]
+assert done[(("service", "cimba-serve"),)] == 3.0, done
+tel.close()
+lines = [json.loads(l) for l in open(span_path)]
+os.unlink(span_path)
+roots = [l for l in lines if l.get("parent") is None
+         and l.get("name") == "request"]
+assert len(roots) == 3, roots
+assert all(r["outcome"] == "completed" for r in roots), roots
+assert tel.spans.open_count() == 0
+# telemetry must never perturb programs: bitwise vs the direct calls
+for label, n, R, seed in cases:
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(n), R, wave_size=8, chunk_steps=64,
+        seed=seed, program_cache=cache,
+    )
+    res = out[label]
+    assert int(res.total_events) == int(direct.total_events), label
+    for a, b in zip(jax.tree.leaves(res.summary),
+                    jax.tree.leaves(direct.summary)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+print("telemetry smoke OK: health", health["status"], "| completed 3 |",
+      len(lines), "span lines | bitwise vs direct")
+EOF
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""One-shot telemetry dump: the operator's first-contact tool.
+
+Hit a running exposition endpoint (``--url``) — or spin up an
+in-process demo ``Service`` (``--demo``) — and pretty-print the
+Prometheus metric families plus the ``/healthz`` verdict:
+
+    python tools/metrics_dump.py --url http://127.0.0.1:9321
+    python tools/metrics_dump.py --url http://host:9321 --varz
+    python tools/metrics_dump.py --demo
+
+Exit code: 0 when health is ``ok`` or ``degraded`` (degraded prints a
+warning), 1 when ``unhealthy`` or the endpoint is unreachable — so the
+tool slots straight into a shell health check.
+
+``--url`` mode is stdlib-only (urllib + the in-repo Prometheus parser);
+``--demo`` imports jax and drives three real requests through a tiny
+model with the full plane attached — the zero-to-scrape sanity path
+when you don't have a service running yet.  See docs/17_telemetry.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from cimba_tpu.obs.expose import parse_prometheus_text  # noqa: E402
+
+
+def _fetch(url: str, timeout: float):
+    """(status_code, body_text) — 503 healthz bodies are still read."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def print_families(text: str) -> None:
+    """Pretty-print parsed Prometheus families: name, type, series
+    sorted by labels; histogram child series (_bucket/_sum/_count)
+    group under their parent family's header.  Raises ValueError on
+    malformed input — the same minimal parser the round-trip tests
+    use, so 'it printed' means 'it parses'."""
+    parsed = parse_prometheus_text(text)
+    types, samples = parsed["types"], parsed["samples"]
+
+    def base_of(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                return name[: -len(suffix)]
+        return name
+
+    for name in sorted({base_of(n) for n in samples} | set(types)):
+        kind = types.get(name)
+        print(f"{name}  [{kind or 'untyped'}]")
+        if kind == "histogram":
+
+            def le_order(item):
+                lab = dict(item[0])
+                le = lab.pop("le", None)
+                return (
+                    tuple(sorted(lab.items())),
+                    float("inf") if le in (None, "+Inf") else float(le),
+                )
+
+            for suffix in ("_bucket", "_count", "_sum"):
+                for labels, value in sorted(
+                    samples.get(name + suffix, {}).items(),
+                    key=le_order,
+                ):
+                    lab = ", ".join(f"{k}={v}" for k, v in labels)
+                    print(f"  {suffix[1:]:<8} {{{lab}}} {value:g}")
+        else:
+            for labels, value in sorted(samples.get(name, {}).items()):
+                lab = (
+                    "{" + ", ".join(f"{k}={v}" for k, v in labels) + "}"
+                    if labels else ""
+                )
+                print(f"  {lab or '(no labels)':<48} {value:g}")
+    print()
+
+
+def print_health(body: str, status: int) -> str:
+    try:
+        h = json.loads(body)
+    except json.JSONDecodeError:
+        print(f"HEALTH: unparseable body (HTTP {status})")
+        return "unhealthy"
+    verdict = h.get("status", "unhealthy")
+    print(f"HEALTH: {verdict} (HTTP {status})")
+    for name, c in (h.get("services") or {}).items():
+        flags = ", ".join(
+            f"{k}={v}" for k, v in c.items() if k != "store_flags"
+        )
+        print(f"  service {name}: {flags}")
+        if c.get("store_flags"):
+            print(f"    store flags: {c['store_flags']}")
+    if h.get("collector_errors"):
+        print(f"  collector errors: {h['collector_errors']}")
+    return verdict
+
+
+def dump_url(url: str, timeout: float, varz: bool) -> int:
+    url = url.rstrip("/")
+    try:
+        _, metrics_text = _fetch(url + "/metrics", timeout)
+        hz_status, hz_body = _fetch(url + "/healthz", timeout)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"unreachable: {url} ({e})", file=sys.stderr)
+        return 1
+    print(f"== {url}/metrics ==")
+    print_families(metrics_text)
+    if varz:
+        _, vz = _fetch(url + "/varz", timeout)
+        print(f"== {url}/varz ==")
+        print(json.dumps(json.loads(vz), indent=2))
+        print()
+    print(f"== {url}/healthz ==")
+    verdict = print_health(hz_body, hz_status)
+    if verdict == "degraded":
+        print("warning: degraded — serving works, somebody should look")
+    return 0 if verdict in ("ok", "degraded") else 1
+
+
+def run_demo(varz: bool) -> int:
+    """Spin a tiny in-process Service with the full plane attached,
+    drive 3 requests, then scrape it over real HTTP (the whole path the
+    operator would scrape in production, on an ephemeral port)."""
+    import jax
+
+    from cimba_tpu import serve
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+    from cimba_tpu.obs import expose as xp
+    from cimba_tpu.obs import telemetry as tm
+    from cimba_tpu.stats import summary as sm
+
+    m = Model("demo", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > 6.0
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    spec = m.build()
+
+    def clock_path(sims):
+        return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+    tel = tm.Telemetry(interval=0.05, spans=True)
+    with xp.start(tel) as srv:
+        with serve.Service(
+            max_wave=16, cache=serve.ProgramCache(), telemetry=tel,
+        ) as svc:
+            for i in range(3):
+                svc.submit(serve.Request(
+                    spec, (), 4, seed=i + 1, chunk_steps=16,
+                    summary_path=clock_path, label=f"demo{i}",
+                )).result(120)
+            tel.sample()  # one explicit scrape so counters are fresh
+            print(f"(demo service on {srv.url})\n")
+            rc = dump_url(srv.url, 10.0, varz)
+    tel.close()
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dump a cimba telemetry endpoint: Prometheus "
+        "families + health verdict",
+    )
+    ap.add_argument(
+        "--url", help="exposition endpoint base, e.g. "
+        "http://127.0.0.1:9321 (obs.expose.start's .url)",
+    )
+    ap.add_argument(
+        "--demo", action="store_true",
+        help="no endpoint? start an in-process demo Service and "
+        "scrape that",
+    )
+    ap.add_argument(
+        "--varz", action="store_true",
+        help="also dump the full /varz JSON snapshot",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=10.0,
+        help="per-request HTTP timeout, seconds",
+    )
+    args = ap.parse_args(argv)
+    if bool(args.url) == bool(args.demo):
+        ap.error("pass exactly one of --url or --demo")
+    if args.demo:
+        return run_demo(args.varz)
+    return dump_url(args.url, args.timeout, args.varz)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
