@@ -334,6 +334,29 @@ def _line(metric, rate, vs_baseline, detail, unit=None):
     # heartbeat ages and progress-tick counters accumulated since the
     # battery started — how live the run was, not just how fast
     line["telemetry"] = _TEL.snapshot()
+    # provenance: with CIMBA_BENCH_RUN_CARD=<dir>, every battery line
+    # also lands as a content-addressed run card (docs/18_audit.md) —
+    # the env block + full line, so a BENCH number is citable against
+    # the process that produced it (tools/bench_history.py collates)
+    card_dir = os.environ.get("CIMBA_BENCH_RUN_CARD")
+    if card_dir:
+        try:
+            from cimba_tpu.obs import audit as _audit
+
+            card = _audit.run_card(
+                "bench",
+                label=metric,
+                geometry={"metric": metric, "unit": line["unit"]},
+                extra={
+                    "value": rate,
+                    "vs_baseline": vs_baseline,
+                    "detail": detail,
+                },
+                telemetry=line["telemetry"],
+            )
+            line["run_card"] = _audit.write_run_card(card, card_dir)
+        except Exception as e:  # a card bug must never kill the line
+            line["run_card_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(line), flush=True)
 
 
@@ -795,6 +818,43 @@ def _warm_stream(spec, R, wave, chunk, cache):
     )
 
 
+def _audit_rerun(spec, N, R, wave, chunk, cache, timed_result):
+    """One UNTIMED audited re-run of the streamed point (docs/18):
+    digest trail + result digest + content-addressed run card written
+    to ``CIMBA_BENCH_RUN_CARD`` make the battery's "bitwise" statement
+    citable.  Never inside a timed region (audit on costs a digest
+    program per chunk).  A card/IO failure degrades to an ``error``
+    field — it must never kill the config line — but a digest MISMATCH
+    between the audited and timed runs raises: that assert is the
+    measurement's integrity, not bookkeeping."""
+    from cimba_tpu.models import mm1
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.runner import experiment as ex
+
+    try:
+        aud = _audit.Audit(
+            out_dir=os.environ["CIMBA_BENCH_RUN_CARD"],
+            label="mm1_stream",
+        )
+        ast_ = ex.run_experiment_stream(
+            spec, mm1.params(N), R, wave_size=wave, chunk_steps=chunk,
+            seed=2026, on_wave=_heartbeat, on_chunk=_heartbeat,
+            program_cache=cache, audit=aud,
+        )
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+    assert (
+        _audit.stream_result_digest(timed_result)
+        == ast_.audit["result_digest"]
+    ), "audited stream re-run diverged from the timed run"
+    return {
+        "result_digest": ast_.audit["result_digest"],
+        "card_digest": ast_.audit["card_digest"],
+        "run_card": aud.card_path,
+        "trail_chunks": len(ast_.audit["digest_trail"]),
+    }
+
+
 def _mm1_stream_arm(R, N, prof, mono_rate):
     """The chunked + streamed arms at the SAME R x N as the monolithic
     headline (warm-then-time, mirroring ``_time_vmapped``): chunked =
@@ -895,6 +955,10 @@ def _mm1_stream_arm(R, N, prof, mono_rate):
             "failed_replications": int(st.n_failed),
             "pooled_mean_sojourn": float(sm.mean(st.summary)),
         }
+        if os.environ.get("CIMBA_BENCH_RUN_CARD"):
+            detail["streamed"]["audit"] = _audit_rerun(
+                spec, N, R, wave, chunk, cache, st
+            )
     return detail
 
 
@@ -1000,28 +1064,34 @@ def bench_mm1_stream():
             )
         except Exception as e:  # the arm must never kill the config line
             tel_overhead = {"error": f"{type(e).__name__}: {e}"[:200]}
+        audit_info = None
+        if os.environ.get("CIMBA_BENCH_RUN_CARD"):
+            audit_info = _audit_rerun(spec, N, R, wave, chunk, cache, st)
     rate = ev / wall
+    detail = {
+        "path": "xla_while_streamed",
+        "profile": prof,
+        "replications": R,
+        "wave_size": wave,
+        "n_waves": st.n_waves,
+        "chunk_steps": chunk,
+        "objects_per_replication": N,
+        "total_events": ev,
+        "wall_s": wall,
+        "failed_replications": int(st.n_failed),
+        "pooled_mean_sojourn": float(sm.mean(st.summary)),
+        "pooled_n": float(st.summary.n),
+        # 1/(mu - lambda) for the config's rates — the sanity anchor
+        "theory_mean_sojourn": 10.0,
+        "telemetry_overhead": tel_overhead,
+    }
+    if audit_info is not None:
+        detail["audit"] = audit_info
     _line(
         "mm1_stream_events_per_sec",
         rate,
         rate / BASELINE_EVENTS_PER_SEC,
-        {
-            "path": "xla_while_streamed",
-            "profile": prof,
-            "replications": R,
-            "wave_size": wave,
-            "n_waves": st.n_waves,
-            "chunk_steps": chunk,
-            "objects_per_replication": N,
-            "total_events": ev,
-            "wall_s": wall,
-            "failed_replications": int(st.n_failed),
-            "pooled_mean_sojourn": float(sm.mean(st.summary)),
-            "pooled_n": float(st.summary.n),
-            # 1/(mu - lambda) for the config's rates — the sanity anchor
-            "theory_mean_sojourn": 10.0,
-            "telemetry_overhead": tel_overhead,
-        },
+        detail,
     )
 
 

@@ -2371,19 +2371,42 @@ def make_chunk(
     t_end: Optional[float] = None,
     pack: Optional[bool] = None,
     max_steps: int = 1024,
+    audit: bool = False,
 ):
     """Build ``chunk(sims) -> (sims, any_live)`` over a BATCHED Sim
     (leading lane axis): one bounded dispatch chunk (each lane advances
     at most ``max_steps`` events) plus the cheap liveness scalar the
     host loop polls.  Not jitted — callers jit it with donation
     (:func:`make_chunked_run`) or wrap it in ``shard_map`` first
-    (``runner.experiment`` composes it with the replication mesh)."""
+    (``runner.experiment`` composes it with the replication mesh).
+
+    ``audit=True`` (the determinism-audit plane, docs/18_audit.md)
+    appends a THIRD output: the per-wave carry-class digest vector
+    (:func:`cimba_tpu.obs.audit.sim_digest` over the post-chunk Sim),
+    which :func:`drive_chunks` hands to its ``on_digest`` hook at every
+    chunk boundary.  Trace-time gated like the flight recorder:
+    ``audit=False`` (the default) takes the historical code path —
+    the chunk jaxpr is character-identical to one built before the
+    knob existed (pinned in tests/test_audit.py)."""
     bounded = make_run(spec, t_end=t_end, pack=pack, max_steps=max_steps)
     cond = make_cond(spec, t_end)
 
+    if not audit:
+        def chunk(sims: Sim):
+            sims = jax.vmap(bounded)(sims)
+            return sims, jnp.any(jax.vmap(cond)(sims))
+
+        return chunk
+
+    from cimba_tpu.obs import audit as obs_audit
+
     def chunk(sims: Sim):
         sims = jax.vmap(bounded)(sims)
-        return sims, jnp.any(jax.vmap(cond)(sims))
+        return (
+            sims,
+            jnp.any(jax.vmap(cond)(sims)),
+            obs_audit.sim_digest(sims),
+        )
 
     return chunk
 
@@ -2398,6 +2421,7 @@ def drive_chunks(
     on_state_every: int = 0,
     max_chunks: Optional[int] = None,
     n0: int = 0,
+    on_digest=None,
 ) -> Sim:
     """Host loop over a jitted, donated ``chunk(sims) -> (sims,
     any_live)``: re-dispatch until every lane is done.
@@ -2419,6 +2443,13 @@ def drive_chunks(
     run keeps counting where the checkpoint left off).  ``max_chunks``
     is an optional hard stop (the returned Sim may then be unfinished;
     :func:`make_cond` tells).
+
+    ``on_digest(n, vec)`` fires per chunk when the chunk program was
+    built with ``audit=True`` (a third output — the carry-class digest
+    vector, docs/18_audit.md); the vector is handed over as a device
+    array so the drive loop stays asynchronous.  Over-dispatched no-op
+    chunks after completion still append (their digests repeat the
+    settled state — deterministic, so trails stay comparable).
     """
     from collections import deque
 
@@ -2426,8 +2457,11 @@ def drive_chunks(
     pending = deque()
     n = n0
     while max_chunks is None or n - n0 < max_chunks:
-        sims, any_live = chunk(sims)
+        out = chunk(sims)
+        sims, any_live = out[0], out[1]
         n += 1
+        if on_digest is not None and len(out) > 2:
+            on_digest(n, out[2])
         if on_chunk is not None:
             on_chunk(n)
         if (

@@ -34,6 +34,11 @@ time-series registry, request spans, health sampler — stdlib-only) and
 ``/varz`` over HTTP).  Opt-in with the same discipline: everything takes
 ``telemetry=None`` and a None means no threads, no span allocations, and
 compiled programs bitwise-unchanged (docs/17_telemetry.md).
+
+Provenance: :mod:`~cimba_tpu.obs.audit` — the determinism-audit plane
+(docs/18_audit.md): chunk-boundary carry digests (trace-time gated,
+``audit=False`` == jaxpr-identical), content-addressed run cards, and
+divergence localization (``tools/audit_diff.py``).
 """
 
 from cimba_tpu.obs import metrics, trace  # noqa: F401

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -46,11 +47,54 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "Registry", "Family", "SpanRecorder", "Telemetry",
-    "METRIC_PREFIX",
+    "METRIC_PREFIX", "build_info",
 ]
 
 #: every metric family this package creates is namespaced under this
 METRIC_PREFIX = "cimba_"
+
+_BUILD_INFO: Optional[dict] = None
+
+
+def build_info() -> dict:
+    """The process's build/provenance block — python, package version,
+    and (when jax is importable) jax/jaxlib versions, backend, device
+    kind/count, and the x64 flag.  ONE definition serves both the
+    ``/varz`` ``build`` section and the run cards' ``env`` block
+    (:func:`cimba_tpu.obs.audit.environment`), so a fleet audit can
+    cross-check a scraped process against a stored artifact
+    field-for-field (docs/18_audit.md).  jax is imported lazily and
+    guarded: this module stays stdlib-only at import time.  Cached —
+    none of it changes within a process."""
+    global _BUILD_INFO
+    if _BUILD_INFO is not None:
+        return dict(_BUILD_INFO)
+    import platform
+
+    out: dict = {"python": platform.python_version()}
+    try:
+        from importlib import metadata as _md
+
+        out["package"] = _md.version("cimba_tpu")
+    except Exception:
+        out["package"] = None
+    try:
+        import jax
+        import jaxlib
+
+        dev = jax.devices()[0]
+        out.update(
+            jax=jax.__version__,
+            jaxlib=jaxlib.__version__,
+            backend=jax.default_backend(),
+            device_kind=getattr(dev, "device_kind", "?"),
+            n_devices=jax.device_count(),
+            x64=bool(jax.config.jax_enable_x64),
+        )
+    except Exception:
+        pass  # jax-less scrape tooling still gets the python/package half
+    _BUILD_INFO = out
+    return dict(out)
 
 #: log2 histogram exponent clamp — buckets span 2^-30 .. 2^30 (seconds:
 #: ~1 ns to ~34 years), anything outside lands in the edge buckets, so
@@ -325,9 +369,17 @@ class SpanRecorder:
     or retried-to-exhaustion still yields exactly one complete span
     tree (tests/test_telemetry.py pins all four outcomes).  A bounded
     ring keeps recent completed spans in memory for the
-    ``chrome_trace()`` export."""
+    ``chrome_trace()`` export.
 
-    def __init__(self, path=None, cap: int = 4096):
+    ``max_bytes`` (opt-in) caps the JSONL file's growth: once the
+    current file exceeds it, the log rotates (``path`` →
+    ``path + ".1"``, replacing the previous generation) — but ONLY at
+    a trace boundary with NO other trace open, so a span tree is never
+    torn across files (a long soak keeps at most two generations on
+    disk; ``counters["rotations"]`` says how often it happened)."""
+
+    def __init__(self, path=None, cap: int = 4096,
+                 max_bytes: Optional[int] = None):
         self._lock = threading.Lock()
         self._m0 = time.monotonic()
         self._n = 0
@@ -337,11 +389,18 @@ class SpanRecorder:
         self.counters = {
             "traces_started": 0, "traces_ended": 0,
             "spans_started": 0, "spans_ended": 0, "events": 0,
+            "rotations": 0,
         }
         self._path = None if path is None else str(path)
+        self._max_bytes = None if max_bytes is None else int(max_bytes)
+        self._bytes = 0
         self._fh = None
         if self._path is not None:
             self._fh = open(self._path, "a", buffering=1)
+            try:
+                self._bytes = os.path.getsize(self._path)
+            except OSError:
+                self._bytes = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -401,7 +460,9 @@ class SpanRecorder:
                 line["outcome"] = rec["outcome"]
             if "attrs" in rec:
                 line.update(rec["attrs"])
-            self._fh.write(json.dumps(line) + "\n")
+            data = json.dumps(line) + "\n"
+            self._fh.write(data)
+            self._bytes += len(data)
 
     def event(self, trace: str, name: str,
               parent: Optional[str] = None, **attrs) -> None:
@@ -422,7 +483,9 @@ class SpanRecorder:
                     "t": now - self._m0, "ph": "i",
                 }
                 line.update(attrs)
-                self._fh.write(json.dumps(line) + "\n")
+                data = json.dumps(line) + "\n"
+                self._fh.write(data)
+                self._bytes += len(data)
 
     def end_trace(self, trace: str, outcome: str, **attrs) -> None:
         """Close the trace: every still-open span ends in reverse start
@@ -443,6 +506,38 @@ class SpanRecorder:
                     attrs if is_root else {},
                 )
             self.counters["traces_ended"] += 1
+            self._maybe_rotate_locked()
+
+    def _maybe_rotate_locked(self) -> None:
+        """Rotate the JSONL log once it exceeds ``max_bytes`` — called
+        only from :meth:`end_trace` (a trace boundary) and only when NO
+        trace remains open, so every trace's lines live in exactly one
+        generation (the never-tear-a-tree contract)."""
+        if (
+            self._fh is None
+            or self._max_bytes is None
+            or self._bytes <= self._max_bytes
+            or self._by_trace
+        ):
+            return
+        self._fh.close()
+        try:
+            os.replace(self._path, self._path + ".1")
+            rotated = True
+        except OSError:
+            rotated = False  # best-effort; keep appending either way
+        self._fh = open(self._path, "a", buffering=1)
+        if rotated:
+            self._bytes = 0
+            self.counters["rotations"] += 1
+        else:
+            # the full file is still live: keep the byte count honest
+            # (a reset here would silently defeat the cap and report
+            # phantom rotations forever)
+            try:
+                self._bytes = os.path.getsize(self._path)
+            except OSError:
+                pass
 
     def open_count(self) -> int:
         with self._lock:
@@ -523,6 +618,7 @@ class Telemetry:
         history: int = 256,
         spans: bool = False,
         span_path=None,
+        span_max_bytes: Optional[int] = None,
         registry: Optional[Registry] = None,
         stall_s: float = 30.0,
         autostart: bool = True,
@@ -531,7 +627,7 @@ class Telemetry:
             history=history
         )
         self.spans: Optional[SpanRecorder] = (
-            SpanRecorder(path=span_path)
+            SpanRecorder(path=span_path, max_bytes=span_max_bytes)
             if (spans or span_path is not None) else None
         )
         self.interval = float(interval)
@@ -767,6 +863,14 @@ class Telemetry:
                 c["store_flags"] = flags
                 if any(flags.values()):
                     worse("degraded")
+            # determinism audit (docs/18_audit.md): a result digest
+            # that failed its expectation means the fleet is no longer
+            # bitwise-reproducible — serving still works, but somebody
+            # must look before citing any run card
+            mism = st.get("digest_mismatches", 0)
+            c["digest_mismatches"] = mism
+            if mism:
+                worse("degraded")
             checks[name] = c
         return {
             "status": status,
@@ -791,6 +895,10 @@ class Telemetry:
                 k: round(now - t, 3) for k, t in hb.items()
             },
             "health": self.healthz(),
+            # the build/provenance block — the SAME dict run cards
+            # record as their env block (docs/18_audit.md), so a
+            # scraped process cross-checks against a stored artifact
+            "build": build_info(),
         }
         svc_stats = {}
         for name, svc in services:
@@ -841,6 +949,7 @@ def _service_collector(registry: Registry, name: str, service):
     )
     raw_counters = (
         "retries", "batches", "waves", "lanes_dispatched", "lanes_padded",
+        "digest_mismatches",
     )
     rate_keys = ("completed", "cancelled", "deadline_exceeded", "retries")
     prev = {"t": None, "vals": {}}

@@ -87,6 +87,7 @@ class StreamResult(NamedTuple):
     n_waves: int
     n_regrows: int             # wave-granular capacity regrows performed
     metrics: Any = None        # pooled obs.metrics registry when enabled
+    audit: Any = None          # run card (docs/18_audit.md) when audited
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -295,24 +296,38 @@ def _chunk_program(
     chunk_steps: int,
     mesh: Optional[Mesh],
     donate: bool = True,
+    audit: bool = False,
 ):
     """One compiled chunk program: ``chunk(sims) -> (sims, any_live)``,
     jitted with the batched Sim DONATED so chunk n+1 aliases chunk n's
     output buffers — zero inter-chunk copies, flat steady-state device
     memory (the donation contract, docs/12_streaming.md).  Under a mesh
     the chunk runs per-shard with the liveness flag psum-reduced over
-    ICI, so the host polls one replicated scalar."""
-    chunk_local = make_chunk(
-        spec, t_end=t_end, pack=pack, max_steps=chunk_steps
-    )
+    ICI, so the host polls one replicated scalar.
+
+    ``audit=True`` (docs/18_audit.md) appends the per-wave carry-class
+    digest vector as a third output.  Under a mesh the digest is
+    computed per shard with GLOBAL lane offsets (``axis_index x local
+    lanes``) and psum-combined — integer sums mod 2^64 are exact and
+    commutative, so the combined digest equals the single-device digest
+    of the same wave.  ``audit=False`` is the historical program,
+    jaxpr-identical (pinned in tests/test_audit.py)."""
     if mesh is None:
-        chunk = chunk_local
+        chunk = make_chunk(
+            spec, t_end=t_end, pack=pack, max_steps=chunk_steps,
+            audit=audit,
+        )
     else:
+        chunk_local = make_chunk(
+            spec, t_end=t_end, pack=pack, max_steps=chunk_steps
+        )
+        out_specs = (P(REP_AXIS), P()) + ((P(),) if audit else ())
+
         @partial(
             shard_map,
             mesh=mesh,
             in_specs=(P(REP_AXIS),),
-            out_specs=(P(REP_AXIS), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
         def chunk(sims):
@@ -320,7 +335,17 @@ def _chunk_program(
             n_live = jax.lax.psum(
                 live_local.astype(jnp.int32), REP_AXIS
             )
-            return sims, n_live > 0
+            out = (sims, n_live > 0)
+            if audit:
+                from cimba_tpu.obs import audit as _obs_audit
+
+                n_local = jax.tree.leaves(sims)[0].shape[0]
+                off = jax.lax.axis_index(REP_AXIS).astype(
+                    jnp.uint64
+                ) * jnp.uint64(n_local)
+                dig = _obs_audit.sim_digest(sims, lane_offset=off)
+                out = out + (jax.lax.psum(dig, REP_AXIS),)
+            return out
 
     return jax.jit(chunk, donate_argnums=(0,) if donate else ())
 
@@ -525,6 +550,7 @@ def run_experiment_stream(
     on_chunk=None,
     telemetry=None,
     program_cache: Optional[dict] = None,
+    audit=None,
 ) -> StreamResult:
     """Pooled statistics for R replications with R beyond the
     per-dispatch lane budget: stream waves of ``wave_size`` lanes
@@ -593,12 +619,27 @@ def run_experiment_stream(
     this same chunked machinery per grid cell — per-cell pooled
     summaries (bitwise these calls'), adaptive replication counts, and
     shared waves across cells (docs/16_sweeps.md).
+
+    ``audit`` (docs/18_audit.md): ``None`` defers to the
+    ``CIMBA_AUDIT`` env knob (unset = off — the chunk program is then
+    jaxpr-identical to the unaudited one, pinned); ``True`` / a
+    directory path / an :class:`cimba_tpu.obs.audit.Audit` enable the
+    determinism audit — the chunk program additionally folds each
+    packed carry class into a per-wave digest vector at every chunk
+    boundary (the digest trail), and the returned ``StreamResult``
+    carries a content-addressed **run card** in ``.audit`` (spec
+    fingerprint, seed schedule, program key, env, geometry, trail,
+    result digest), written to the Audit's ``out_dir`` when set.  Two
+    clean same-seed runs produce identical trails and the same card
+    digest; ``tools/audit_diff.py`` localizes any divergence to its
+    first (wave, chunk, carry-class).
     """
     import dataclasses
 
     import numpy as np
 
     from cimba_tpu.core import loop as _cl
+    from cimba_tpu.obs import audit as _obs_audit
     from cimba_tpu.obs import metrics as _metrics
     from cimba_tpu.serve import cache as _pcache
 
@@ -616,6 +657,10 @@ def run_experiment_stream(
                 f"wave_size={wave_size} and n_replications={R} must "
                 f"divide evenly over {n_dev} devices"
             )
+
+    aud = _obs_audit.resolve(audit)
+    use_audit = aud is not None
+    spec0 = spec  # regrow replaces spec; the card cites the original
 
     with_metrics = _metrics.enabled()
     acc = _pcache.stream_acc(spec, with_metrics)
@@ -640,6 +685,7 @@ def run_experiment_stream(
         return _pcache.get_programs(
             programs, spec, mesh=mesh, pack=pack,
             chunk_steps=chunk_steps, with_metrics=with_metrics,
+            audit=use_audit,
         )
 
     init_probe, _ = get_programs(spec)
@@ -674,12 +720,16 @@ def run_experiment_stream(
             # structure under the same program key, so both variants
             # share the cache entry.
             t_stops = None if t_end is None else _horizon_column(t_end, n)
+            on_digest = None
+            if use_audit:
+                def on_digest(c, d, _w=n_waves, _aud=aud):
+                    _aud.on_chunk(_w, c, d)
             while True:
                 init_j, chunk_j = get_programs(spec)
                 sims = init_j(reps, seeds, t_stops, pw)
                 sims = drive_chunks(
                     chunk_j, sims, poll_every=poll_every,
-                    on_chunk=on_chunk,
+                    on_chunk=on_chunk, on_digest=on_digest,
                 )
                 if n_regrows >= max_regrows:
                     break
@@ -716,7 +766,7 @@ def run_experiment_stream(
     if rec is not None:
         rec.end_trace(trace, "completed", n_waves=n_waves)
 
-    return StreamResult(
+    result = StreamResult(
         summary=acc[0],
         n_failed=acc[1],
         total_events=acc[2],
@@ -724,6 +774,45 @@ def run_experiment_stream(
         n_regrows=n_regrows,
         metrics=acc[3] if with_metrics else None,
     )
+    if use_audit:
+        from cimba_tpu import config as _config
+        from cimba_tpu.serve import store as _pstore
+
+        try:
+            pkey = _pstore.store_key(
+                spec0, with_metrics, mesh=mesh, pack=pack,
+                chunk_steps=chunk_steps,
+            )
+        except Exception:
+            pkey = None  # unstable spec: the card's spec block says why
+        card = aud.finalize(
+            "stream",
+            spec=spec0,
+            seed_schedule={"seed": int(seed)},
+            geometry={
+                "R": R,
+                "wave_size": wave_size,
+                "chunk_steps": chunk_steps,
+                "poll_every": poll_every,
+                "t_end": t_end,
+                "pack": bool(
+                    pack if pack is not None
+                    else _config.xla_pack_enabled()
+                ),
+                "profile": _config.active_profile(),
+                "with_metrics": with_metrics,
+                "mesh": _pstore._mesh_descriptor(mesh),
+                "n_waves": n_waves,
+                "n_regrows": n_regrows,
+            },
+            program_key=pkey,
+            result_digest=_obs_audit.stream_result_digest(result),
+            telemetry=(
+                telemetry.snapshot() if telemetry is not None else None
+            ),
+        )
+        result = result._replace(audit=card)
+    return result
 
 
 def pooled_summary(batched: sm.Summary) -> sm.Summary:

@@ -331,6 +331,7 @@ def get_programs(
     pack,
     chunk_steps: int,
     with_metrics: bool,
+    audit: bool = False,
 ):
     """The stream runner's ``get_programs``, shared with the service:
     one compiled ``(init, chunk)`` pair per :func:`program_key` point
@@ -346,13 +347,21 @@ def get_programs(
     executables instead of tracing and invoking XLA — the
     zero-cold-start path.  Every store failure mode (corrupt artifact,
     version/backend drift, unstable fingerprint, plain bug) degrades to
-    the compile below, never to a wrong program."""
+    the compile below, never to a wrong program.
+
+    ``audit=True`` selects the determinism-audit chunk program (a
+    third digest output per chunk, docs/18_audit.md): its key gets a
+    distinct suffix — ``audit=False`` keys are byte-identical to the
+    historical ones — and store hydration is skipped, because stored
+    artifacts are always the unaudited two-output program."""
     from cimba_tpu.serve import store as _pstore
 
     _pstore.maybe_enable_persistent_cache()
     key = program_key(
         spec, with_metrics, mesh=mesh, pack=pack, chunk_steps=chunk_steps,
     )
+    if audit:
+        key = key + ("audit",)
 
     def build():
         import warnings as _warnings
@@ -362,6 +371,8 @@ def get_programs(
         st = getattr(programs, "store", None)
         if st is None and not isinstance(programs, ProgramCache):
             st = _pstore.default_store()
+        if audit:
+            st = None  # store artifacts are unaudited programs
         if st is not None:
             try:
                 hyd = st.hydrate(
@@ -379,7 +390,9 @@ def get_programs(
                 return (hyd[0], hyd[1], spec)
         return (
             ex._init_program(spec, mesh),
-            ex._chunk_program(spec, None, pack, chunk_steps, mesh),
+            ex._chunk_program(
+                spec, None, pack, chunk_steps, mesh, audit=audit
+            ),
             spec,  # pins the fingerprint's function ids while cached
         )
 

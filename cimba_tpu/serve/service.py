@@ -89,7 +89,15 @@ class Request:
     reaches it (initially or between its waves) fails with
     :class:`DeadlineExceeded`; work already running on the device is
     never interrupted — a deadline expiring mid-wave delivers that
-    wave, then fails before the next."""
+    wave, then fails before the next.
+
+    ``expect_digest`` (docs/18_audit.md): the result digest this
+    request is EXPECTED to reproduce (e.g. from a stored run card,
+    :func:`cimba_tpu.obs.audit.stream_result_digest`).  The result is
+    delivered either way, but a mismatch bumps the service's
+    ``digest_mismatches`` counter, marks the request's span tree, and
+    flips ``/healthz`` to degraded — determinism regressions surface
+    in the fleet's monitoring, not just in pytest."""
 
     spec: Any
     params: Any
@@ -103,6 +111,7 @@ class Request:
     priority: int = 0
     deadline: Optional[float] = None
     label: Optional[str] = None
+    expect_digest: Optional[str] = None
 
     def __post_init__(self):
         if self.summary_path is None:
@@ -116,7 +125,7 @@ class _Entry:
         "request", "seq", "priority", "label", "cls", "eff_wave",
         "with_metrics", "next_lo", "acc", "n_waves", "retries", "solo",
         "cancelled", "in_flight", "submit_t", "first_dispatch_t",
-        "deadline_at", "done", "result", "exc",
+        "deadline_at", "done", "result", "exc", "result_digest",
         "trace", "span_root", "span_queue", "span_wave",
     )
 
@@ -144,6 +153,7 @@ class _Entry:
         self.done = threading.Event()
         self.result = None
         self.exc = None
+        self.result_digest = None
         # telemetry span state — all None when the service has no
         # telemetry plane (the zero-allocation hot-submit contract)
         self.trace = None
@@ -186,6 +196,22 @@ class ResultHandle:
         if exc is not None:
             raise exc
         return self._entry.result
+
+    def digest(self, timeout: Optional[float] = None) -> str:
+        """The completed result's bitwise digest
+        (:func:`cimba_tpu.obs.audit.stream_result_digest`) — equal to
+        the digest of the direct ``run_experiment_stream`` call at the
+        same (spec, params, R, seed, wave_size), whoever shared the
+        wave (the bitwise-isolation contract, docs/18_audit.md).
+        Blocks like :meth:`result`; computed once and cached (the
+        dispatcher already computed it when spans or ``expect_digest``
+        were active)."""
+        res = self.result(timeout)
+        if self._entry.result_digest is None:
+            from cimba_tpu.obs import audit as _audit
+
+            self._entry.result_digest = _audit.stream_result_digest(res)
+        return self._entry.result_digest
 
 
 #: outcomes recorded in stats and trace spans
@@ -280,6 +306,7 @@ class Service:
             "submitted": 0, "admitted": 0, "rejected": 0,
             "retries": 0, "batches": 0, "waves": 0,
             "lanes_dispatched": 0, "lanes_padded": 0,
+            "digest_mismatches": 0,
         }
         for o in _OUTCOMES:
             self._counters[o] = 0
@@ -1091,22 +1118,50 @@ class Service:
 
     def _finish_completed(self, entry: _Entry) -> None:
         """Deliver a fully-folded request's StreamResult — the same
-        shape the direct ``run_experiment_stream`` call returns."""
+        shape the direct ``run_experiment_stream`` call returns.
+
+        Digest leg (docs/18_audit.md): when the request carries an
+        ``expect_digest`` or the telemetry plane records spans, the
+        result's bitwise digest is computed here (a host transfer of a
+        few scalars) and recorded on the span tree; an expectation
+        mismatch bumps ``digest_mismatches`` (the ``/healthz`` degraded
+        signal) and marks the tree — the result is still delivered.
+        With neither active, nothing is computed: results stay
+        untouched device arrays (the zero-cost default)."""
         from cimba_tpu.runner.experiment import StreamResult
 
         acc = entry.acc
-        self._finish(
-            entry,
-            result=StreamResult(
-                summary=acc[0],
-                n_failed=acc[1],
-                total_events=acc[2],
-                n_waves=entry.n_waves,
-                n_regrows=0,
-                metrics=acc[3] if entry.with_metrics else None,
-            ),
-            outcome="completed",
+        result = StreamResult(
+            summary=acc[0],
+            n_failed=acc[1],
+            total_events=acc[2],
+            n_waves=entry.n_waves,
+            n_regrows=0,
+            metrics=acc[3] if entry.with_metrics else None,
         )
+        expect = entry.request.expect_digest
+        rec = self._tel.spans if self._tel is not None else None
+        spans_on = rec is not None and entry.trace is not None
+        if expect is not None or spans_on:
+            from cimba_tpu.obs import audit as _audit
+
+            dig = _audit.stream_result_digest(result)
+            entry.result_digest = dig
+            if spans_on:
+                rec.event(
+                    entry.trace, "digest", parent=entry.span_root,
+                    digest=dig,
+                )
+            if expect is not None and expect != dig:
+                with self._lock:
+                    self._counters["digest_mismatches"] += 1
+                if spans_on:
+                    rec.event(
+                        entry.trace, "digest_mismatch",
+                        parent=entry.span_root, expected=expect,
+                        got=dig,
+                    )
+        self._finish(entry, result=result, outcome="completed")
 
     def _batch_failed(self, members, exc: Exception) -> None:
         """Dispatch (or fold) failed.  Every member retries SOLO after

@@ -93,6 +93,9 @@ class SweepResult:
     wall_s: float
     occupancy: dict = field(default_factory=dict)
     metrics: Any = None
+    #: run card (docs/18_audit.md) when the sweep ran with ``audit=``:
+    #: per-cell result digests + the deterministic seed schedule
+    audit: Any = None
 
     @property
     def n_cells(self) -> int:
@@ -232,6 +235,7 @@ def run_sweep(
     on_round: Optional[Callable] = None,
     on_chunk: Optional[Callable] = None,
     telemetry=None,
+    audit=None,
 ) -> SweepResult:
     """Run a scenario grid: ``reps_per_cell`` replications per cell
     (per ROUND when ``stop`` is given), folded into per-cell pooled
@@ -266,10 +270,21 @@ def run_sweep(
     "sweep" trace whose per-round spans carry live-cell/replication
     counts; serve-backed sweeps additionally get the service's own
     request spans per (cell, round).  Host-side only: results are
-    bitwise identical with or without it."""
+    bitwise identical with or without it.
+
+    ``audit`` (docs/18_audit.md): ``None`` defers to ``CIMBA_AUDIT``;
+    when enabled, the result carries a content-addressed run card in
+    ``.audit`` with the full per-cell seed schedule (every
+    ``round_seed(seed, cell, round)`` actually dispatched) and a
+    bitwise result digest per cell — the citable form of the fixed-R
+    "bitwise the direct calls" contract.  Host-side only (the
+    dispatched programs are unchanged); per-chunk digest TRAILS are
+    the stream runner's — sweep waves interleave many cells, so the
+    sweep card pins cell results, not chunk boundaries."""
     import jax
     import jax.numpy as jnp
 
+    from cimba_tpu.obs import audit as _obs_audit
     from cimba_tpu.obs import metrics as _metrics
     from cimba_tpu.runner import experiment as ex
     from cimba_tpu.serve import cache as _pcache
@@ -471,6 +486,9 @@ def run_sweep(
                 res.metrics if with_metrics else None,
             )
 
+    aud = _obs_audit.resolve(audit)
+    seed_log: list = [[] for _ in range(C)] if aud is not None else []
+
     live = np.ones(C, bool)
     n_reps = np.zeros(C, np.int64)
     stop_round = np.full(C, -1, np.int32)
@@ -488,6 +506,9 @@ def run_sweep(
                 (int(c), round_seed(seed, int(c), n_rounds), reps_r)
                 for c in live_cells
             ]
+            if aud is not None:
+                for c, sd, _ in jobs:
+                    seed_log[c].append(int(sd))
             span_round = None
             if rec is not None:
                 span_round = rec.start(
@@ -549,6 +570,47 @@ def run_sweep(
             for k in ("batches", "waves", "lanes_dispatched",
                       "lanes_padded")
         }
+    audit_card = None
+    if aud is not None:
+        from cimba_tpu import config as _config
+
+        cells_blk = [
+            {
+                "cell": grid.cell_label(c),
+                "seeds": seed_log[c],
+                "reps": int(n_reps[c]),
+                "stop_round": int(stop_round[c]),
+                "result_digest": _obs_audit.result_digest(accs[c]),
+            }
+            for c in range(C)
+        ]
+        audit_card = aud.finalize(
+            "sweep",
+            spec=spec,
+            seed_schedule={
+                "seed": int(seed),
+                "rule": "round_seed(seed, cell, round)",
+            },
+            geometry={
+                "grid": grid.name,
+                "n_cells": C,
+                "reps_per_cell": R0,
+                "cell_wave": cell_wave,
+                "max_wave": max_wave,
+                "chunk_steps": chunk_steps,
+                "t_end": t_end,
+                "profile": _config.active_profile(),
+                "with_metrics": with_metrics,
+                "adaptive": stop is not None,
+                "redistribute": bool(redistribute),
+                "n_rounds": n_rounds,
+                "serve_backed": service is not None,
+            },
+            cells=cells_blk,
+            telemetry=(
+                telemetry.snapshot() if telemetry is not None else None
+            ),
+        )
     return SweepResult(
         grid=grid,
         summaries=summaries,
@@ -568,4 +630,5 @@ def run_sweep(
         wall_s=time.perf_counter() - t0,
         occupancy=occ,
         metrics=metrics,
+        audit=audit_card,
     )

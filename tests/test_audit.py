@@ -1,0 +1,414 @@
+"""Determinism audit & provenance plane (docs/18_audit.md).
+
+Contracts pinned here:
+
+* **audit off is strictly zero-cost**: the chunk program built with
+  ``audit=False`` (or defaulted) is jaxpr CHARACTER-IDENTICAL to the
+  historical two-output chunk, under both dtype profiles, and with the
+  ``CIMBA_AUDIT`` env var set (the knob is an explicit argument, never
+  ambient trace state); audited runs return results bitwise equal to
+  unaudited ones.
+* **reproducibility is an equality**: two clean same-seed runs produce
+  identical digest trails and the SAME content-addressed card digest
+  (the clean-subprocess twin is the slow test; tools/ci.sh runs it
+  every cycle); ``tools/audit_diff.py`` exits 0.
+* **divergence localizes**: a flipped seed or perturbed param reports
+  its FIRST divergent (wave, chunk, carry-class) and a nonzero exit.
+* **serve digests**: a served request's ``ResultHandle.digest()``
+  equals the direct call's result digest; an ``expect_digest``
+  mismatch bumps the counter and degrades ``/healthz``.
+* **satellites**: span-log rotation never tears a trace tree, ``/varz``
+  ``build`` equals the run-card env block, ``tools/bench_history.py``
+  collates the round series.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cimba_tpu import config
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import mm1
+from cimba_tpu.obs import audit
+from cimba_tpu.obs import telemetry as tele
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.serve.service import Request, Service
+from cimba_tpu.sweep import SweepGrid, run_sweep
+from cimba_tpu.sweep.adaptive import round_seed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+R, N, WAVE, CHUNK = 16, 100, 8, 32
+
+
+@pytest.fixture(scope="module")
+def spec():
+    s, _ = mm1.build(record=False)
+    return s
+
+
+@pytest.fixture(scope="module")
+def cache():
+    # ONE cache for the whole module: the audited and unaudited
+    # programs live at distinct keys, and every test below reuses the
+    # same compiles
+    return pc.ProgramCache()
+
+
+def _stream(spec, cache, seed, audit_=None, n=N, **kw):
+    return ex.run_experiment_stream(
+        spec, mm1.params(n), R, wave_size=WAVE, chunk_steps=CHUNK,
+        seed=seed, program_cache=cache, audit=audit_, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# zero-cost off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_audit_off_chunk_jaxpr_identical(profile, monkeypatch):
+    """The acceptance pin: ``audit=False`` (and the default) trace the
+    HISTORICAL chunk jaxpr character-for-character — even with the
+    ``CIMBA_AUDIT`` env var set, because the knob is an explicit
+    program argument, not ambient trace state.  ``audit=True`` traces
+    a different program (the digest ops exist)."""
+    with config.profile(profile):
+        s, _ = mm1.build(record=False)
+        sims = jax.vmap(
+            lambda r: cl.init_sim(s, 3, r, mm1.params(10))
+        )(jnp.arange(4))
+        base = str(jax.make_jaxpr(cl.make_chunk(s, max_steps=8))(sims))
+        off = str(
+            jax.make_jaxpr(
+                cl.make_chunk(s, max_steps=8, audit=False)
+            )(sims)
+        )
+        assert off == base
+        monkeypatch.setenv(audit.AUDIT_ENV, "1")
+        off_env = str(
+            jax.make_jaxpr(
+                cl.make_chunk(s, max_steps=8, audit=False)
+            )(sims)
+        )
+        assert off_env == base
+        on = str(
+            jax.make_jaxpr(
+                cl.make_chunk(s, max_steps=8, audit=True)
+            )(sims)
+        )
+        assert on != base
+
+
+def test_audited_results_bitwise_unperturbed(spec, cache):
+    """Audit on never changes what the run computes: the audited run's
+    result digest equals the digest of the unaudited run at the same
+    point."""
+    plain = _stream(spec, cache, seed=7)
+    audited = _stream(spec, cache, seed=7, audit_=True)
+    assert plain.audit is None
+    assert (
+        audit.stream_result_digest(plain)
+        == audited.audit["result_digest"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# trails, cards, localization
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_trails_identical_card_digest_equal(spec, cache,
+                                                      tmp_path):
+    a1, a2 = audit.Audit(out_dir=tmp_path), audit.Audit(out_dir=tmp_path)
+    r1 = _stream(spec, cache, seed=7, audit_=a1)
+    r2 = _stream(spec, cache, seed=7, audit_=a2)
+    t1, t2 = a1.trail_rows(), a2.trail_rows()
+    assert t1 and t1 == t2
+    assert audit.diff_trails(t1, t2) is None
+    # the content-addressed card: same digest, same file, recomputable
+    assert r1.audit["card_digest"] == r2.audit["card_digest"]
+    assert a1.card_path == a2.card_path
+    assert r1.audit["card_digest"][:16] in os.path.basename(a1.card_path)
+    loaded = audit.load_run_card(a1.card_path)
+    assert audit.card_digest(loaded) == loaded["card_digest"]
+    assert loaded["spec"]["spec_fingerprint"]
+    assert loaded["seed_schedule"] == {"seed": 7}
+    assert loaded["geometry"]["R"] == R
+    rep = audit.diff_cards(r1.audit, r2.audit)
+    assert rep["identical"] and rep["result_equal"]
+    # the CLI (stdlib-fast: file-loads the module, no jax) agrees
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "audit_diff.py"),
+         a1.card_path, a1.card_path],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_divergence_localizes_first_wave_chunk_class(spec, cache,
+                                                     tmp_path):
+    a1 = audit.Audit(out_dir=tmp_path)
+    a2 = audit.Audit(out_dir=tmp_path)
+    a3 = audit.Audit(out_dir=tmp_path)
+    r1 = _stream(spec, cache, seed=7, audit_=a1)
+    r2 = _stream(spec, cache, seed=8, audit_=a2)              # seed flip
+    r3 = _stream(spec, cache, seed=7, audit_=a3, n=N + 10)    # param drift
+    for other in (r2, r3):
+        rep = audit.diff_cards(r1.audit, other.audit)
+        assert not rep["identical"]
+        d = rep["first_divergence"]
+        # the divergence exists from the very first chunk boundary and
+        # names the carry classes that differ
+        assert d is not None and d["wave"] == 0 and d["chunk"] == 1
+        assert d["classes"] and all(
+            c in audit.CLASS_NAMES for c in d["classes"]
+        )
+        assert rep["result_equal"] is False
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "audit_diff.py"),
+         a1.card_path, a2.card_path],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FIRST DIVERGENCE at wave 0" in proc.stdout
+
+
+def test_incomparable_cards_exit_2(tmp_path):
+    """Different geometry (wave partition) folds different chunk
+    boundaries — the diff refuses rather than reporting a meaningless
+    divergence."""
+    a = audit.run_card("stream", geometry={"R": 16, "wave_size": 8})
+    b = audit.run_card("stream", geometry={"R": 16, "wave_size": 4})
+    rep = audit.diff_cards(a, b)
+    assert not rep["comparable"] and not rep["identical"]
+    pa, pb = audit.write_run_card(a, tmp_path), audit.write_run_card(
+        b, tmp_path
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "audit_diff.py"), pa, pb],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "incomparable" in proc.stdout
+
+
+def test_mesh_digest_matches_single_device(spec, cache):
+    """A 1-device mesh digests through shard_map + psum with global
+    lane offsets — the trail must equal the unsheltered one (integer
+    sums mod 2^64 combine exactly)."""
+    a_plain, a_mesh = audit.Audit(), audit.Audit()
+    _stream(spec, cache, seed=7, audit_=a_plain)
+    _stream(spec, cache, seed=7, audit_=a_mesh, mesh=ex.make_mesh(1))
+    assert a_plain.trail_rows() == a_mesh.trail_rows()
+
+
+@pytest.mark.slow
+def test_clean_subprocess_twins_identical(tmp_path):
+    """The acceptance claim verbatim: two CLEAN processes at the same
+    seed schedule produce identical trails and the same card digest
+    (tools/ci.sh runs the same twin with audit_diff)."""
+    prog = (
+        "import json, sys\n"
+        "from cimba_tpu.obs import audit\n"
+        "from cimba_tpu.models import mm1\n"
+        "from cimba_tpu.runner import experiment as ex\n"
+        "spec, _ = mm1.build(record=False)\n"
+        "a = audit.Audit(out_dir=sys.argv[1])\n"
+        "res = ex.run_experiment_stream(spec, mm1.params(100), 16,\n"
+        "    wave_size=8, chunk_steps=32, seed=11, audit=a)\n"
+        "print(json.dumps({'card': a.card_path,\n"
+        "    'digest': res.audit['card_digest']}))\n"
+    )
+    outs = []
+    for sub in ("a", "b"):
+        proc = subprocess.run(
+            [sys.executable, "-c", prog, str(tmp_path / sub)],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        outs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    assert outs[0]["digest"] == outs[1]["digest"]
+    ca = audit.load_run_card(outs[0]["card"])
+    cb = audit.load_run_card(outs[1]["card"])
+    assert audit.diff_cards(ca, cb)["identical"]
+
+
+# ---------------------------------------------------------------------------
+# serve digests
+# ---------------------------------------------------------------------------
+
+
+def test_serve_digest_equals_direct_call(spec, cache):
+    direct = _stream(spec, cache, seed=5)
+    want = audit.stream_result_digest(direct)
+    with Service(max_wave=WAVE, cache=cache) as svc:
+        h = svc.submit(Request(
+            spec, mm1.params(N), R, seed=5, wave_size=WAVE,
+            chunk_steps=CHUNK,
+        ))
+        assert h.digest(60.0) == want
+        # served results stay bitwise the direct call's (the digest IS
+        # that statement, but pin the arrays too)
+        res = h.result(0.0)
+        for a, b in zip(jax.tree.leaves(res.summary),
+                        jax.tree.leaves(direct.summary)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_expect_digest_mismatch_counts_and_degrades(spec, cache,
+                                                    tmp_path):
+    direct = _stream(spec, cache, seed=5)
+    want = audit.stream_result_digest(direct)
+    span_path = tmp_path / "spans.jsonl"
+    tel = tele.Telemetry(interval=0, spans=True, span_path=span_path)
+    svc = Service(max_wave=WAVE, cache=cache, telemetry=tel)
+    try:
+        ok = svc.submit(Request(
+            spec, mm1.params(N), R, seed=5, wave_size=WAVE,
+            chunk_steps=CHUNK, expect_digest=want,
+        ))
+        assert ok.result(60.0) is not None
+        assert svc.stats()["digest_mismatches"] == 0
+        assert tel.healthz()["status"] == "ok"
+        bad = svc.submit(Request(
+            spec, mm1.params(N), R, seed=6, wave_size=WAVE,
+            chunk_steps=CHUNK, expect_digest=want, label="bad",
+        ))
+        # the result is still DELIVERED — a mismatch is a monitoring
+        # signal, not a request failure
+        assert bad.result(60.0) is not None
+        assert bad.digest() != want
+        assert svc.stats()["digest_mismatches"] == 1
+        h = tel.healthz()
+        assert h["status"] == "degraded"
+        assert any(
+            c.get("digest_mismatches") for c in h["services"].values()
+        )
+    finally:
+        svc.shutdown()
+        tel.close()
+    lines = [json.loads(l) for l in open(span_path)]
+    names = {l["name"] for l in lines}
+    assert "digest" in names and "digest_mismatch" in names
+
+
+# ---------------------------------------------------------------------------
+# sweep cards
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_audit_card_per_cell_digests(spec, cache):
+    grid = SweepGrid(
+        {"rho": (0.5, 0.9)},
+        lambda rho: (np.float64(1.0 / rho), np.float64(1.0),
+                     np.int32(60)),
+        name="mm1_audit",
+    )
+    res = run_sweep(
+        spec, grid, reps_per_cell=8, cell_wave=8, max_wave=16,
+        chunk_steps=CHUNK, program_cache=cache, seed=3, audit=True,
+    )
+    card = res.audit
+    assert card is not None and card["kind"] == "sweep"
+    assert len(card["cells"]) == 2
+    for c, cell in enumerate(card["cells"]):
+        assert cell["seeds"] == [round_seed(3, c, 0)]
+        direct = ex.run_experiment_stream(
+            spec, grid.cell_row(c), 8, wave_size=8,
+            chunk_steps=CHUNK, seed=round_seed(3, c, 0),
+            program_cache=cache,
+        )
+        assert cell["result_digest"] == audit.stream_result_digest(
+            direct
+        )
+
+
+# ---------------------------------------------------------------------------
+# satellites: span rotation, /varz build, bench history
+# ---------------------------------------------------------------------------
+
+
+def test_span_rotation_never_tears_a_trace(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    rec = tele.SpanRecorder(path=path, max_bytes=600)
+    for i in range(8):
+        t = rec.new_trace()
+        root = rec.start(t, "request", seq=i)
+        child = rec.start(t, "queue", parent=root)
+        rec.end(child, outcome="ok")
+        rec.end_trace(t, "completed")
+    rec.close()
+    assert rec.counters["rotations"] >= 1
+    gens = [p for p in (path, path + ".1") if os.path.exists(p)]
+    assert len(gens) == 2, "rotation should have left two generations"
+    traces_by_file = []
+    for p in gens:
+        lines = [json.loads(l) for l in open(p)]   # every line parses
+        # the live file may be empty right after a trailing rotation
+        traces_by_file.append({l["trace"] for l in lines})
+        # every trace present in a file has its ROOT there too — a
+        # complete tree, not a torn tail
+        for tid in traces_by_file[-1]:
+            assert any(
+                l["trace"] == tid and l.get("parent") is None
+                and l["name"] == "request"
+                for l in lines
+            ), f"trace {tid} torn in {p}"
+    assert not (traces_by_file[0] & traces_by_file[1]), (
+        "a trace's lines leaked across a rotation boundary"
+    )
+
+
+def test_open_trace_blocks_rotation(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    rec = tele.SpanRecorder(path=path, max_bytes=1)
+    t_open = rec.new_trace()
+    rec.start(t_open, "request")
+    for i in range(3):
+        t = rec.new_trace()
+        s = rec.start(t, "request")
+        rec.end(s)
+        rec.end_trace(t, "completed")
+    # the still-open trace pins every generation in place
+    assert rec.counters["rotations"] == 0
+    assert not os.path.exists(path + ".1")
+    rec.end_trace(t_open, "completed")
+    assert rec.counters["rotations"] == 1
+    rec.close()
+
+
+def test_varz_build_matches_run_card_env():
+    tel = tele.Telemetry(interval=0)
+    try:
+        build = tel.varz()["build"]
+    finally:
+        tel.close()
+    assert build == audit.environment()
+    assert build["jax"] == jax.__version__
+    assert build["backend"] == jax.default_backend()
+    assert build["x64"] is True
+    assert "python" in build and "package" in build
+
+
+def test_bench_history_collates_rounds():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_history.py"),
+         "--dir", REPO],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    # the CPU trajectory and the TPU metadata point both print
+    for token in ("130k", "267k", "470k", "723k", "386.4M"):
+        assert token in out, out
+    assert "regression check" in out

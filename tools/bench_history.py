@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Collate BENCH_r*.json rounds into a trend table + regression check.
+
+The perf trajectory lives in per-round driver artifacts
+(``BENCH_r01.json`` ...: ``{"n", "cmd", "rc", "tail", "parsed"}`` with
+``parsed`` = the bench line(s) of that round) plus whatever run cards
+(docs/18_audit.md) a round left behind — but nothing collates them.
+This tool prints the round-by-round series per metric (the CPU
+container points — 130k -> 723k events/s across rounds 2-5 — plus the
+TPU points carried in round metadata as ``last_measured_tpu``) and
+checks the newest round against the previous one for a regression.
+
+Usage::
+
+    python tools/bench_history.py [--dir .] [--cards DIR]
+        [--metric mm1_events_per_sec] [--max-regression 10]
+
+Exit codes: 0 ok, 1 regression beyond ``--max-regression`` percent,
+2 nothing to collate.  Stdlib-only (no jax import) — safe in any CI
+leg.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_rounds(d):
+    """[(round_n, rc, [bench line dicts])] sorted by round."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(d, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: {path}: {e}", file=sys.stderr)
+            continue
+        n = doc.get("n", int(m.group(1)))
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            lines = [parsed]
+        elif isinstance(parsed, list):
+            lines = [x for x in parsed if isinstance(x, dict)]
+        else:
+            lines = []
+        out.append((int(n), doc.get("rc"), lines))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def load_cards(d):
+    """Run cards under ``d`` as [(path, card)] — malformed files are
+    warned about, never fatal."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(d, "runcard_*.json"))):
+        try:
+            card = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: {path}: {e}", file=sys.stderr)
+            continue
+        if isinstance(card, dict):
+            out.append((path, card))
+    return out
+
+
+def _fmt_rate(v):
+    if v is None:
+        return "-"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.0f}k"
+    return f"{v:.0f}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="collate BENCH_r*.json into a trend table"
+    )
+    ap.add_argument("--dir", default=".", help="where BENCH_r*.json live")
+    ap.add_argument(
+        "--cards", default=None,
+        help="also list run cards (runcard_*.json) from this directory",
+    )
+    ap.add_argument(
+        "--metric", default="mm1_events_per_sec",
+        help="the headline metric the regression check tracks",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=10.0,
+        help="max tolerated drop (percent) of the headline metric vs "
+        "the previous round before exit 1",
+    )
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"no BENCH_r*.json under {args.dir}", file=sys.stderr)
+        return 2
+
+    # -- per-metric series ---------------------------------------------------
+    series = {}          # metric -> {round: (value, backend, profile)}
+    tpu_points = {}      # (round, note) -> events/s, from round metadata
+    for n, rc, lines in rounds:
+        for line in lines:
+            metric = line.get("metric")
+            if metric is None:
+                continue
+            det = line.get("detail") or {}
+            series.setdefault(metric, {})[n] = (
+                line.get("value"), det.get("backend"),
+                det.get("profile"),
+            )
+            tpu = line.get("last_measured_tpu")
+            if isinstance(tpu, dict) and "events_per_sec" in tpu:
+                key = (tpu.get("round"), tpu.get("note"))
+                tpu_points[key] = tpu
+
+    all_rounds = [n for n, _, _ in rounds]
+    print("bench history:", ", ".join(
+        f"r{n}(rc={rc})" for n, rc, _ in rounds
+    ))
+    print()
+    width = max((len(m) for m in series), default=10)
+    header = f"{'metric':<{width}} " + " ".join(
+        f"{'r' + str(n):>8}" for n in all_rounds
+    )
+    print(header)
+    print("-" * len(header))
+    for metric in sorted(series):
+        cells = []
+        for n in all_rounds:
+            v = series[metric].get(n)
+            cells.append(f"{_fmt_rate(v[0]) if v else '-':>8}")
+        print(f"{metric:<{width}} " + " ".join(cells))
+    for metric in sorted(series):
+        backends = {
+            n: v[1] for n, v in series[metric].items() if v[1]
+        }
+        if backends:
+            print(f"  {metric} backends: " + ", ".join(
+                f"r{n}={b}" for n, b in sorted(backends.items())
+            ))
+            break
+
+    if tpu_points:
+        print("\nTPU points (round metadata):")
+        for (rnd, note), tpu in sorted(
+            tpu_points.items(), key=lambda kv: (kv[0][0] or 0)
+        ):
+            print(
+                f"  r{rnd}: {_fmt_rate(tpu['events_per_sec'])} ev/s"
+                f" ({tpu.get('path', '?')}, {tpu.get('profile', '?')})"
+                f" — {note}"
+            )
+
+    if args.cards:
+        cards = load_cards(args.cards)
+        print(f"\nrun cards under {args.cards}: {len(cards)}")
+        for path, card in cards:
+            rd = card.get("result_digest")
+            print(
+                f"  {os.path.basename(path)}: kind={card.get('kind')}"
+                f" label={card.get('label')}"
+                f" trail={len(card.get('digest_trail') or [])}"
+                + (f" result={rd[:16]}…" if rd else "")
+            )
+
+    # -- regression check ----------------------------------------------------
+    s = series.get(args.metric, {})
+    have = sorted(n for n, v in s.items() if v[0] is not None)
+    if len(have) < 2:
+        print(
+            f"\nregression check: <2 rounds carry {args.metric} — skipped"
+        )
+        return 0
+    prev_n, last_n = have[-2], have[-1]
+    prev_v, last_v = s[prev_n][0], s[last_n][0]
+    drop_pct = (prev_v - last_v) / prev_v * 100.0
+    verdict = "REGRESSION" if drop_pct > args.max_regression else "ok"
+    print(
+        f"\nregression check [{args.metric}]: r{prev_n} "
+        f"{_fmt_rate(prev_v)} -> r{last_n} {_fmt_rate(last_v)} "
+        f"({-drop_pct:+.1f}%; threshold -{args.max_regression:.0f}%) "
+        f"{verdict}"
+    )
+    return 1 if verdict == "REGRESSION" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -439,6 +439,76 @@ print("telemetry smoke OK: health", health["status"], "| completed 3 |",
       len(lines), "span lines | bitwise vs direct")
 EOF
 
+# determinism-audit smoke (docs/18_audit.md): two independent processes
+# at the same seed must produce identical digest trails AND the same
+# content-addressed run card digest (audit_diff exit 0); a perturbed
+# seed must be caught and localized to its first (wave, chunk,
+# carry-class) with a nonzero exit; and bench.py under
+# CIMBA_BENCH_RUN_CARD must emit a parseable, digest-consistent card
+run_cell "audit smoke" bash -c '
+  set -e
+  tmp=$(mktemp -d)
+  trap "rm -rf \"$tmp\"" EXIT
+  prog="
+import json, os, sys
+os.environ.setdefault(\"JAX_PLATFORMS\", \"cpu\")
+from cimba_tpu.obs import audit
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+seed, out = int(sys.argv[1]), sys.argv[2]
+spec, _ = mm1.build(record=False)
+a = audit.Audit(out_dir=out)
+res = ex.run_experiment_stream(spec, mm1.params(200), 16, wave_size=8,
+                               chunk_steps=64, seed=seed, audit=a)
+print(json.dumps({\"card\": a.card_path,
+                  \"card_digest\": res.audit[\"card_digest\"]}))
+"
+  A=$(python -c "$prog" 7 "$tmp/a" | tail -1)
+  B=$(python -c "$prog" 7 "$tmp/b" | tail -1)
+  C=$(python -c "$prog" 8 "$tmp/c" | tail -1)
+  cardA=$(python -c "import json,sys; print(json.loads(sys.argv[1])[\"card\"])" "$A")
+  cardB=$(python -c "import json,sys; print(json.loads(sys.argv[1])[\"card\"])" "$B")
+  cardC=$(python -c "import json,sys; print(json.loads(sys.argv[1])[\"card\"])" "$C")
+  # clean-subprocess twins: identical trails, same card digest, exit 0
+  python tools/audit_diff.py "$cardA" "$cardB"
+  python -c "
+import json, sys
+a, b = json.loads(sys.argv[1]), json.loads(sys.argv[2])
+assert a[\"card_digest\"] == b[\"card_digest\"], (a, b)
+print(\"twin card digests equal:\", a[\"card_digest\"][:16])
+" "$A" "$B"
+  # a flipped seed is caught AND localized
+  if python tools/audit_diff.py "$cardA" "$cardC" > "$tmp/diff.out"; then
+    echo "audit_diff missed a seed divergence"; exit 1
+  fi
+  python tools/audit_diff.py --json "$cardA" "$cardC" > "$tmp/diff.json" || true
+  python -c "
+import json
+rep = json.load(open(\"$tmp/diff.json\"))
+d = rep[\"first_divergence\"]
+assert d is not None and d[\"wave\"] == 0 and d[\"classes\"], rep
+print(\"localized: wave\", d[\"wave\"], \"chunk\", d[\"chunk\"],
+      \"classes\", d[\"classes\"])
+"
+  # bench.py emits a parseable, digest-consistent run card
+  CIMBA_BENCH_FORCE_CPU=1 CIMBA_BENCH_R=32 CIMBA_BENCH_OBJECTS=200 \
+    CIMBA_BENCH_METRICS=0 CIMBA_BENCH_RUN_CARD="$tmp/cards" \
+    python bench.py > "$tmp/bench.out"
+  python -c "
+import importlib.util, json
+line = json.loads(open(\"$tmp/bench.out\").read().strip().splitlines()[-1])
+assert \"run_card\" in line, line.get(\"run_card_error\", line)
+spec = importlib.util.spec_from_file_location(
+    \"_a\", \"cimba_tpu/obs/audit.py\")
+audit = importlib.util.module_from_spec(spec); spec.loader.exec_module(audit)
+card = audit.load_run_card(line[\"run_card\"])
+assert card[\"kind\"] == \"bench\" and card[\"env\"][\"backend\"] == \"cpu\"
+assert card[\"card_digest\"] == audit.card_digest(card), \"digest drifted\"
+print(\"bench run card OK:\", line[\"run_card\"])
+"
+  echo "audit smoke OK"
+'
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
