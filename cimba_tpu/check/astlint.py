@@ -1,0 +1,565 @@
+"""AST lints over the repo's own source — stdlib ``ast``, no jax.
+
+Five rules, each fossilizing a bug class this repo has actually hit
+(docs/19_static_analysis.md has the rule table with the history):
+
+* **CHK001** — no ``id(...)`` in persistence modules.  ``id()`` keys are
+  meaningless across a process boundary; ``UnstableStoreKey`` only fires
+  at runtime, this fires at check time.  Scope: files declaring
+  ``# cimba-check: persist-path``.
+* **CHK002** — lock discipline.  A class declares its must-hold map
+  (``# cimba-check: must-hold(_lock) attr, attr...``) and every access
+  of a listed attribute outside a lexical ``with self._lock`` block is
+  flagged (the torn-read audit of docs/17, made structural).  Methods
+  whose name ends ``_locked`` or that carry
+  ``# cimba-check: assume-held`` are documented caller-holds-lock.
+  Closures defined inside a method are analyzed as NOT holding the lock
+  (they run whenever they run).
+* **CHK003** — no blind exception swallows: a bare ``except:`` anywhere,
+  or an ``except Exception/BaseException:`` whose body is only
+  ``pass`` — in a dispatcher or sampler thread that silently eats the
+  evidence of the bug that killed it.
+* **CHK004** — no wall-clock or RNG in digest/fingerprint content paths
+  (functions declaring ``# cimba-check: content-path``): a timestamp or
+  random draw inside digested content silently breaks "bitwise
+  reproducible is one string equality" (the PR 9 timestamp-exclusion
+  rule, generalized).
+* **CHK005** — every ``CIMBA_*`` environment read inside the package
+  round-trips through ``config.env_raw`` and its ``ENV_KNOBS`` registry
+  (so trace gates can't dodge the gate registry).  Scope: files under
+  ``cimba_tpu/`` except ``config.py`` itself, plus files declaring
+  ``# cimba-check: env-proxied``.
+
+Suppression: a trailing ``# cimba: noqa(RULE)`` (comma-list accepted) on
+the flagged line suppresses that rule there; suppressed findings are
+still reported in the ``--json`` ``suppressed`` list, never silently
+dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+# relative import: tools/check.py --ast-only file-loads this module
+# under a private package name so the AST front never imports the
+# cimba_tpu package (and therefore never imports jax)
+from . import Finding
+
+__all__ = ["RULES", "check_file", "check_paths", "iter_py_files"]
+
+RULES = {
+    "CHK001": "id() in a persistence path (persist-path files)",
+    "CHK002": "must-hold attribute touched outside its declared lock",
+    "CHK003": "bare except, or Exception/BaseException swallowed by pass",
+    "CHK004": "wall-clock/RNG call inside a digest content path",
+    "CHK005": "CIMBA_* env read bypassing config.env_raw/ENV_KNOBS",
+}
+
+_DIRECTIVE = re.compile(r"#\s*cimba-check:\s*(.+?)\s*$")
+_NOQA = re.compile(r"#\s*cimba:\s*noqa\(([A-Za-z0-9_,\s]+)\)")
+_MUST_HOLD = re.compile(r"must-hold\(([^)]+)\)\s*(.*)$")
+
+#: CHK004 ban list: call segments that mean "this content is no longer
+#: a pure function of the run" (time.monotonic included: monotonic
+#: origins differ per process, which is exactly the non-reproducibility
+#: CHK004 exists to keep out of digests)
+_WALLCLOCK_FIRST = {"time"}
+_BANNED_SEGMENTS = {"random", "secrets", "uuid", "urandom"}
+_DATETIME_TAILS = {"now", "utcnow", "today"}
+
+_SWALLOW_TYPES = {"Exception", "BaseException"}
+
+
+def _noqa_rules(comment: str) -> Set[str]:
+    m = _NOQA.search(comment)
+    if not m:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def _comments_by_line(source: str) -> Dict[int, str]:
+    """Real ``#`` comments per line (via tokenize — a directive quoted
+    inside a docstring is prose, not a directive)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+            io.StringIO(source).readline
+        ):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass  # astlint already reports unparseable files
+    return out
+
+
+class _FileCtx:
+    """Parsed source + directives of one checked file."""
+
+    def __init__(self, path: str, display: str):
+        self.path = path
+        self.display = display
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self.comments = _comments_by_line(self.source)
+        self.persist_path = False
+        self.env_proxied = False
+        self.content_path_lines: Set[int] = set()
+        self.assume_held_lines: Set[int] = set()
+        self.must_hold: List[Tuple[int, Set[str], Set[str]]] = []
+        for i, line in self.comments.items():
+            m = _DIRECTIVE.search(line)
+            if not m:
+                continue
+            body = m.group(1)
+            if body.startswith("persist-path"):
+                self.persist_path = True
+            elif body.startswith("env-proxied"):
+                self.env_proxied = True
+            elif body.startswith("content-path"):
+                self.content_path_lines.add(i)
+            elif body.startswith("assume-held"):
+                self.assume_held_lines.add(i)
+            else:
+                mh = _MUST_HOLD.match(body)
+                if mh:
+                    locks = {
+                        s.strip() for s in mh.group(1).split(",")
+                        if s.strip()
+                    }
+                    attrs = {
+                        s.strip() for s in mh.group(2).split(",")
+                        if s.strip()
+                    }
+                    self.must_hold.append((i, locks, attrs))
+
+    def comment_of(self, lineno: int) -> str:
+        return self.comments.get(lineno, "")
+
+
+class _Findings:
+    """Collects findings, routing noqa'd ones to the suppressed list."""
+
+    def __init__(self, ctx: _FileCtx):
+        self.ctx = ctx
+        self.active: List[Finding] = []
+        self.suppressed: List[Finding] = []
+
+    def add(self, rule: str, lineno: int, message: str) -> None:
+        sup = rule in _noqa_rules(self.ctx.comment_of(lineno))
+        f = Finding(
+            rule=rule, path=self.ctx.display, line=lineno,
+            message=message, suppressed=sup,
+        )
+        (self.suppressed if sup else self.active).append(f)
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` as a string, or None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# CHK001 — id() in persistence paths
+# ---------------------------------------------------------------------------
+
+
+def _chk001(ctx: _FileCtx, out: _Findings) -> None:
+    if not ctx.persist_path:
+        return
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "id"
+        ):
+            out.add(
+                "CHK001", node.lineno,
+                "id() in a persist-path file: object identities are "
+                "meaningless across a process boundary — digest by "
+                "value, or suppress with a justification if only an "
+                "in-process ordinal derived from it is persisted",
+            )
+
+
+# ---------------------------------------------------------------------------
+# CHK002 — lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _enclosing_class(
+    tree: ast.Module, lineno: int,
+) -> Optional[ast.ClassDef]:
+    """The innermost ClassDef whose span contains ``lineno``."""
+    best: Optional[ast.ClassDef] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best
+
+
+def _is_assume_held(ctx: _FileCtx, fn: ast.FunctionDef) -> bool:
+    if fn.name.endswith("_locked"):
+        return True
+    first = min(
+        [fn.lineno] + [d.lineno for d in fn.decorator_list]
+    )
+    return bool(
+        {first, first - 1, fn.lineno} & ctx.assume_held_lines
+    )
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one method body tracking lexical ``with self.<lock>`` depth;
+    flag protected ``self.<attr>`` accesses while it is zero."""
+
+    def __init__(self, locks: Set[str], attrs: Set[str],
+                 out: _Findings, cls: str, method: str):
+        self.locks = locks
+        self.attrs = attrs
+        self.out = out
+        self.cls = cls
+        self.method = method
+        self.held = 0
+
+    def _is_lock(self, expr: ast.AST) -> bool:
+        return (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.locks
+        )
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._is_lock(i.context_expr) for i in node.items)
+        for i in node.items:
+            self.visit(i.context_expr)
+            if i.optional_vars is not None:
+                self.visit(i.optional_vars)
+        if locked:
+            self.held += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self.held -= 1
+
+    def _visit_closure(self, node) -> None:
+        # a nested def/lambda runs whenever it is later called — the
+        # lock held at its definition site proves nothing
+        prev, self.held = self.held, 0
+        self.generic_visit(node)
+        self.held = prev
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_closure(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_closure(node)
+
+    def visit_Lambda(self, node) -> None:
+        self._visit_closure(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            self.held == 0
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.attrs
+        ):
+            self.out.add(
+                "CHK002", node.lineno,
+                f"{self.cls}.{node.attr} touched in {self.method}() "
+                f"outside `with self.{sorted(self.locks)[0]}` (declared "
+                "must-hold)",
+            )
+        self.generic_visit(node)
+
+
+def _chk002(ctx: _FileCtx, out: _Findings) -> None:
+    for lineno, locks, attrs in ctx.must_hold:
+        cls = _enclosing_class(ctx.tree, lineno)
+        if cls is None:
+            out.add(
+                "CHK002", lineno,
+                "must-hold directive outside any class body",
+            )
+            continue
+        for item in cls.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if item.name == "__init__" or _is_assume_held(ctx, item):
+                continue
+            walker = _LockWalker(locks, attrs, out, cls.name, item.name)
+            for stmt in item.body:
+                walker.visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# CHK003 — blind exception swallows
+# ---------------------------------------------------------------------------
+
+
+def _only_pass(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / `...`
+        return False
+    return True
+
+
+def _handler_names(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Tuple):
+        return {
+            n for e in node.elts for n in _handler_names(e)
+        }
+    name = _dotted_name(node)
+    return {name.rsplit(".", 1)[-1]} if name else set()
+
+
+def _chk003(ctx: _FileCtx, out: _Findings) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.add(
+                "CHK003", node.lineno,
+                "bare `except:` — catches SystemExit/KeyboardInterrupt "
+                "and hides the evidence; name the exception",
+            )
+            continue
+        if _handler_names(node.type) & _SWALLOW_TYPES and _only_pass(
+            node.body
+        ):
+            out.add(
+                "CHK003", node.lineno,
+                "except Exception/BaseException swallowed by `pass` — "
+                "in a dispatcher/sampler thread this eats the bug that "
+                "killed it; narrow the type, count it, or re-raise",
+            )
+
+
+# ---------------------------------------------------------------------------
+# CHK004 — wall-clock / RNG in content paths
+# ---------------------------------------------------------------------------
+
+
+def _banned_call(dotted: str) -> Optional[str]:
+    segs = dotted.split(".")
+    if segs[0] in _WALLCLOCK_FIRST and len(segs) > 1:
+        return "wall-clock"
+    if _BANNED_SEGMENTS & set(segs):
+        return "RNG/identifier"
+    if "datetime" in segs and segs[-1] in _DATETIME_TAILS:
+        return "wall-clock"
+    return None
+
+
+def _chk004(ctx: _FileCtx, out: _Findings) -> None:
+    if not ctx.content_path_lines:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        first = min(
+            [node.lineno] + [d.lineno for d in node.decorator_list]
+        )
+        if not (
+            {first, first - 1, node.lineno} & ctx.content_path_lines
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted_name(sub.func)
+            if dotted is None:
+                continue
+            why = _banned_call(dotted)
+            if why is not None:
+                out.add(
+                    "CHK004", sub.lineno,
+                    f"{dotted}() is {why} inside content path "
+                    f"{node.name}() — digested content must be a pure "
+                    "function of the run (timestamps live OUTSIDE the "
+                    "digest, like run cards' created_unix)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# CHK005 — un-proxied CIMBA_* env reads
+# ---------------------------------------------------------------------------
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "CIMBA_..."`` constants."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and node.value.value.startswith("CIMBA_")
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def _os_aliases(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os":
+                    out.add(a.asname or "os")
+    return out
+
+
+def _chk005_applies(ctx: _FileCtx) -> bool:
+    if ctx.env_proxied:
+        return True
+    norm = ctx.path.replace(os.sep, "/")
+    if "/cimba_tpu/" not in norm and not norm.startswith("cimba_tpu/"):
+        return False
+    return not norm.endswith("cimba_tpu/config.py")
+
+
+def _chk005(ctx: _FileCtx, out: _Findings) -> None:
+    if not _chk005_applies(ctx):
+        return
+    consts = _module_consts(ctx.tree)
+    aliases = _os_aliases(ctx.tree) or {"os"}
+
+    def env_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value.startswith("CIMBA_") else None
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        return None
+
+    def is_environ(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in aliases
+        )
+
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Call):
+            dotted_ok = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "setdefault")
+                and is_environ(node.func.value)
+            ) or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "getenv"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in aliases
+            )
+            if dotted_ok and node.args:
+                name = env_name(node.args[0])
+        elif isinstance(node, ast.Subscript) and is_environ(node.value):
+            name = env_name(node.slice)
+        if name is not None:
+            out.add(
+                "CHK005", node.lineno,
+                f"{name} read via os.environ — package code reads "
+                "CIMBA_* knobs through config.env_raw() so the "
+                "ENV_KNOBS registry (and the gate registry behind it) "
+                "can never drift from reality",
+            )
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_CHECKS = (_chk001, _chk002, _chk003, _chk004, _chk005)
+
+
+def check_file(
+    path: str, repo_root: Optional[str] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run every AST rule over one file; returns ``(findings,
+    suppressed)``.  Unparseable files yield one CHKERR finding (the CLI
+    maps any finding to exit 1; a syntax error in checked source is a
+    finding, not a checker crash)."""
+    display = path
+    if repo_root:
+        try:
+            display = os.path.relpath(path, repo_root)
+        except ValueError:
+            pass
+    try:
+        ctx = _FileCtx(path, display)
+    except (SyntaxError, UnicodeDecodeError) as e:
+        return (
+            [Finding("CHKERR", display, getattr(e, "lineno", 0) or 0,
+                     f"unparseable: {e.msg if hasattr(e, 'msg') else e}")],
+            [],
+        )
+    out = _Findings(ctx)
+    for chk in _CHECKS:
+        chk(ctx, out)
+    return out.active, out.suppressed
+
+
+def iter_py_files(paths) -> List[str]:
+    """Expand files/directories into a sorted list of ``.py`` files
+    (``__pycache__`` skipped)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__"
+                ]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            files.append(p)
+    return sorted(set(files))
+
+
+def check_paths(
+    paths, repo_root: Optional[str] = None,
+) -> Tuple[List[Finding], List[Finding], int]:
+    """AST-lint every ``.py`` file under ``paths``; returns
+    ``(findings, suppressed, n_files)``."""
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    files = iter_py_files(paths)
+    for f in files:
+        a, s = check_file(f, repo_root)
+        findings.extend(a)
+        suppressed.extend(s)
+    return findings, suppressed, len(files)

@@ -1,0 +1,225 @@
+"""Program-level lints over traced jaxprs — static w.r.t. execution.
+
+These checks trace and lower real programs (``jax.make_jaxpr`` /
+``jit.lower``) but never compile or execute anything.  Three rules plus
+the gate-registry sweep (:mod:`cimba_tpu.check.gates`):
+
+* **JXL001 — donation coverage.**  Every carry input of a
+  ``make_chunk`` program must be donated/aliased (the PR 3 invariant:
+  chunk n+1 aliases chunk n's buffers — zero inter-chunk copies, flat
+  steady-state memory).  Verified against the lowered StableHLO's
+  ``tf.aliasing_output`` markers: one per carry leaf, exactly.
+* **JXL002 — hot-path purity.**  The chunk program's jaxpr must contain
+  no host round-trips (``pure_callback``/``io_callback``/
+  ``debug_callback``/print, infeed/outfeed) — a callback would
+  serialize the very dispatch loop it observes — and no ``gather``
+  primitives beyond the model's registered budget (shipped models
+  compile to zero gathers; an unexpected gather is usually an advanced
+  indexing slip that Mosaic will refuse and XLA will scatter-gather
+  slowly).
+* **JXL003 — weak-type hygiene.**  No weakly-typed leaf may enter the
+  packed carry: a weak Python scalar re-specializes jit caches and is
+  exactly the dtype-profile memo-leak hazard behind the PR 1
+  ``_DtypeHandle`` bug.  Verified over the init program's abstract
+  output under both dtype profiles.
+
+Run by ``tools/check.py`` (skipped under ``--ast-only``) and tier-1's
+tests/test_check.py.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from cimba_tpu.check import Finding
+
+__all__ = [
+    "BANNED_PRIMITIVES", "GATHER_BUDGET",
+    "donation_findings", "purity_findings", "weak_type_findings",
+    "check_programs", "collect_primitives",
+]
+
+#: primitives that must never appear in a chunk program (host
+#: round-trips serialize the dispatch loop; debug prints don't survive
+#: serialization into the program store)
+BANNED_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "host_local_array_to_global_array",
+})
+
+#: per-model gather budget for JXL002 (primitive name "gather"); every
+#: shipped model compiles to zero — raise a model's budget here ONLY
+#: with a comment justifying the access pattern
+GATHER_BUDGET: Dict[str, int] = {}
+
+_ALIAS_MARKER = re.compile(r"tf\.aliasing_output")
+
+
+def collect_primitives(jaxpr) -> Dict[str, int]:
+    """Primitive-name histogram of a (Closed)Jaxpr, recursing into
+    every sub-jaxpr (while bodies, pjit calls, cond branches)."""
+    import jax
+
+    counts: Dict[str, int] = {}
+
+    def walk(jx):
+        for eq in jx.eqns:
+            counts[eq.primitive.name] = (
+                counts.get(eq.primitive.name, 0) + 1
+            )
+            for v in eq.params.values():
+                for sub in _sub_jaxprs(v):
+                    walk(sub)
+
+    def _sub_jaxprs(v):
+        if isinstance(v, jax.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.core.Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from _sub_jaxprs(x)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return counts
+
+
+def _mm1_wave(profile: str):
+    import jax
+    import jax.numpy as jnp
+
+    from cimba_tpu import config
+    from cimba_tpu.core import loop as cl
+    from cimba_tpu.models import mm1
+
+    with config.profile(profile):
+        spec, _ = mm1.build(record=False)
+        sims = jax.vmap(
+            lambda r: cl.init_sim(spec, 3, r, mm1.params(10))
+        )(jnp.arange(4))
+    return spec, sims
+
+
+def donation_findings(
+    chunk_j, sims, label: str,
+) -> List[Finding]:
+    """JXL001 for one jitted chunk program: every carry leaf aliased in
+    the lowered text."""
+    import jax
+
+    n_leaves = len(jax.tree_util.tree_leaves(sims))
+    text = chunk_j.lower(sims).as_text()
+    n_aliased = len(_ALIAS_MARKER.findall(text))
+    if n_aliased != n_leaves:
+        return [Finding(
+            rule="JXL001", path=f"program:{label}", line=0,
+            message=(
+                f"chunk program donates {n_aliased} of {n_leaves} "
+                "carry leaves — every carry input must alias its "
+                "output (the PR 3 zero-copy invariant; an undonated "
+                "leaf doubles its steady-state memory and copies per "
+                "chunk)"
+            ),
+        )]
+    return []
+
+
+def purity_findings(
+    jaxpr, label: str, gather_budget: int = 0,
+) -> List[Finding]:
+    """JXL002 for one traced program."""
+    counts = collect_primitives(jaxpr)
+    out: List[Finding] = []
+    hit = sorted(set(counts) & BANNED_PRIMITIVES)
+    if hit:
+        out.append(Finding(
+            rule="JXL002", path=f"program:{label}", line=0,
+            message=(
+                f"host round-trip primitive(s) {hit} in a chunk "
+                "program — callbacks/prints serialize the dispatch "
+                "loop and cannot ride the program store"
+            ),
+        ))
+    n_gather = counts.get("gather", 0)
+    if n_gather > gather_budget:
+        out.append(Finding(
+            rule="JXL002", path=f"program:{label}", line=0,
+            message=(
+                f"{n_gather} gather primitive(s) in the chunk program "
+                f"(budget {gather_budget}) — an unexpected gather is "
+                "usually an advanced-indexing slip; register a budget "
+                "in check.jaxprlint.GATHER_BUDGET only with a "
+                "justified access pattern"
+            ),
+        ))
+    return out
+
+
+def weak_type_findings(tree, label: str) -> List[Finding]:
+    """JXL003 over a pytree of (abstract or concrete) carry values."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    out: List[Finding] = []
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
+    weak = [
+        jax.tree_util.keystr(p)
+        for p, leaf in leaves_with_path
+        if shaped_abstractify(leaf).weak_type
+    ]
+    if weak:
+        out.append(Finding(
+            rule="JXL003", path=f"program:{label}", line=0,
+            message=(
+                f"weakly-typed leaves in the packed carry: {weak} — a "
+                "weak Python scalar re-specializes jit caches per "
+                "profile (the PR 1 dtype-memo leak); cast through "
+                "config.TIME/REAL/COUNT at creation"
+            ),
+        ))
+    return out
+
+
+def check_programs(
+    profiles: Tuple[str, ...] = ("f64", "f32"),
+    with_gates: bool = True,
+    gate_model: str = "mm1",
+) -> Tuple[List[Finding], dict]:
+    """The full program-lint battery over the shipped reference model
+    (mm1, the model every historical pin used): donation + purity +
+    weak types per dtype profile, plus the gate-registry sweep.
+    Returns ``(findings, report)``."""
+    import jax
+
+    from cimba_tpu import config
+    from cimba_tpu.runner import experiment as ex
+
+    findings: List[Finding] = []
+    report: dict = {"programs": {}}
+    for profile in profiles:
+        # trace under the SAME profile the Sim was built in — mixing
+        # is exactly the cross-profile hazard JXL003 polices
+        with config.profile(profile):
+            spec, sims = _mm1_wave(profile)
+            label = f"mm1/{profile}"
+            chunk_j = ex._chunk_program(spec, None, False, 8, None)
+            findings.extend(donation_findings(chunk_j, sims, label))
+            jaxpr = jax.make_jaxpr(lambda s: chunk_j(s))(sims)
+        findings.extend(purity_findings(
+            jaxpr, label, GATHER_BUDGET.get("mm1", 0)
+        ))
+        findings.extend(weak_type_findings(sims, label))
+        report["programs"][label] = {
+            "carry_leaves": len(jax.tree_util.tree_leaves(sims)),
+            "checks": ["JXL001", "JXL002", "JXL003"],
+        }
+    if with_gates:
+        from cimba_tpu.check import gates as _gates
+
+        gate_findings, gate_report = _gates.sweep(
+            profiles, model=gate_model,
+        )
+        findings.extend(gate_findings)
+        report["gates"] = gate_report
+    return findings, report

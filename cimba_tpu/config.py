@@ -131,6 +131,107 @@ _PROFILES = {
 
 _ACTIVE_PROFILE = "f64"
 
+# --- CIMBA_* environment knob registry (docs/19_static_analysis.md) ---------
+#
+# Every environment variable the PACKAGE reads is declared here and read
+# through :func:`env_raw` — the round-trip rule CHK005 in tools/check.py
+# enforces statically.  ``trace_gate=True`` marks knobs that change what
+# a traced program looks like; each of those must be claimed by a gate
+# in :mod:`cimba_tpu.check.gates`, whose registry sweep proves the
+# off-state is jaxpr-identical to the baseline (tests/test_check.py has
+# the completeness test).  Operator-tool knobs (CIMBA_BENCH_*, sweep
+# probes, examples) stay outside: they configure host scripts, never
+# library trace state.
+
+ENV_KNOBS = {
+    # trace-time program gates (registry-swept in check/gates.py)
+    "CIMBA_EVENTSET_HIER": dict(
+        default="1", trace_gate=True,
+        doc="hierarchical event-set minima (core/eventset.py)",
+    ),
+    "CIMBA_EVENTSET_BLOCK": dict(
+        default="128", trace_gate=True,
+        doc="event-set block size for the hierarchical minima",
+    ),
+    "CIMBA_XLA_PACK": dict(
+        default="", trace_gate=True,
+        doc="packed XLA while-loop carry (core/carry.py)",
+    ),
+    "CIMBA_AUDIT": dict(
+        default="", trace_gate=True,
+        doc="determinism audit collection (obs/audit.py; the chunk "
+            "program's audit arm is an explicit argument — the env var "
+            "only selects host-side collection, pinned ambient-inert)",
+    ),
+    # kernel-path knobs: Mosaic programs, covered by the dedicated
+    # kernel parity batteries (test_mosaic_aot / test_pallas_run), not
+    # the XLA-path gate sweep (interpret-mode tracing is over tier-1
+    # budget)
+    "CIMBA_KERNEL_PACK": dict(
+        default="0", trace_gate=False,
+        doc="packed carry inside the Pallas mega-kernel",
+    ),
+    "CIMBA_KERNEL_LANE_BLOCK": dict(
+        default="", trace_gate=False,
+        doc="Pallas lane-block grid size (core/pallas_run.py)",
+    ),
+    "CIMBA_KERNEL_VMEM_LIMIT": dict(
+        default="", trace_gate=False,
+        doc="Mosaic scoped-vmem budget override, bytes",
+    ),
+    "CIMBA_KERNEL_DEBUG": dict(
+        default="", trace_gate=False,
+        doc="dump 64-bit-typed jaxpr values before Mosaic lowering",
+    ),
+    # host-side state (no traced-program effect)
+    "CIMBA_PROGRAM_CACHE_CAP": dict(
+        default="64", trace_gate=False,
+        doc="bounded program-cache capacity (serve/cache.py)",
+    ),
+    "CIMBA_PROGRAM_STORE": dict(
+        default="", trace_gate=False,
+        doc="persistent AOT program store root (serve/store.py)",
+    ),
+    "CIMBA_PROGRAM_STORE_XLA_MIN_S": dict(
+        default="0", trace_gate=False,
+        doc="min compile seconds for jax's persistent cache entries",
+    ),
+    # assertion tiers: compile-out is the FEATURE (utils/dbc.py); the
+    # gated-handler invariant battery (test_gated_invariant.py) owns
+    # their correctness, so they are not registry gates
+    "CIMBA_NDEBUG": dict(
+        default="0", trace_gate=False,
+        doc="disable the heavyweight debug assertion tier",
+    ),
+    "CIMBA_NASSERT": dict(
+        default="0", trace_gate=False,
+        doc="disable the release assertion tier too",
+    ),
+}
+
+
+def env_raw(name: str, default=None) -> str:
+    """Read one registered ``CIMBA_*`` environment knob (the CHK005
+    round-trip point: package code reads env through here, never
+    ``os.environ`` directly, so :data:`ENV_KNOBS` can never drift from
+    what the package actually consults).  ``default=None`` uses the
+    registered default; an unregistered name raises — register the knob
+    (and, for a trace gate, its identity gate in check/gates.py)
+    first."""
+    import os
+
+    knob = ENV_KNOBS.get(name)
+    if knob is None:
+        raise KeyError(
+            f"{name} is not a registered CIMBA_* environment knob — add "
+            "it to cimba_tpu.config.ENV_KNOBS (and check/gates.py if it "
+            "gates trace-time program structure); see "
+            "docs/19_static_analysis.md"
+        )
+    if default is None:
+        default = knob["default"]
+    return os.environ.get(name, default)
+
 #: True while tracing inside the Pallas mega-kernel (set by
 #: core.pallas_run).  Data-dependent while-loops in the interpreter become
 #: masked bounded fori-loops under this flag: Mosaic cannot lower a
@@ -164,27 +265,21 @@ XLA_PACK = None
 
 
 def eventset_hier_enabled() -> bool:
-    import os
-
     if EVENTSET_HIER is not None:
         return bool(EVENTSET_HIER)
-    return os.environ.get("CIMBA_EVENTSET_HIER", "1") != "0"
+    return env_raw("CIMBA_EVENTSET_HIER") != "0"
 
 
 def eventset_block() -> int:
-    import os
-
     if EVENTSET_BLOCK is not None:
         return int(EVENTSET_BLOCK)
-    return int(os.environ.get("CIMBA_EVENTSET_BLOCK", "128"))
+    return int(env_raw("CIMBA_EVENTSET_BLOCK"))
 
 
 def xla_pack_enabled() -> bool:
-    import os
-
     if XLA_PACK is not None:
         return bool(XLA_PACK)
-    raw = os.environ.get("CIMBA_XLA_PACK", "").strip()
+    raw = env_raw("CIMBA_XLA_PACK").strip()
     if raw:
         return raw != "0"
     # auto: the wide-carry cost this packs away is the accelerator

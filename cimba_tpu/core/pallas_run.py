@@ -40,8 +40,6 @@ Design:
 
 from __future__ import annotations
 
-import os
-
 from functools import partial
 from typing import Optional
 
@@ -66,7 +64,7 @@ def _vmem_limit_bytes(lane_block=None) -> int:
     double-buffering adds a few MiB — an Lb=8192 block measured 97.3
     MiB offline, 1.3 over the plain budget).  Override with
     ``CIMBA_KERNEL_VMEM_LIMIT``."""
-    raw = os.environ.get("CIMBA_KERNEL_VMEM_LIMIT", "").strip()
+    raw = config.env_raw("CIMBA_KERNEL_VMEM_LIMIT").strip()
     if not raw:
         return (110 if lane_block else 96) * 1024 * 1024
     try:
@@ -138,7 +136,7 @@ def make_kernel_run(
     if packed is None:
         # carry packing (see _pack_plan): opt-in via env until measured
         # faster on hardware, then flip the default
-        packed = os.environ.get("CIMBA_KERNEL_PACK", "0") != "0"
+        packed = config.env_raw("CIMBA_KERNEL_PACK") != "0"
     if lane_block is None:
         # lane blocking: run the chunk as a pallas GRID over lane
         # blocks — VMEM holds ONE block's Sim (so total lanes are no
@@ -149,7 +147,7 @@ def make_kernel_run(
         # independent, so per-block while-loops are trajectory-
         # identical to the monolithic form: each block just exits its
         # loop when its own lanes are done.
-        raw = os.environ.get("CIMBA_KERNEL_LANE_BLOCK", "").strip()
+        raw = config.env_raw("CIMBA_KERNEL_LANE_BLOCK").strip()
         try:
             lane_block = int(raw) if raw else None
         except ValueError as e:
@@ -659,9 +657,7 @@ def _maybe_dump_64bit(closed_jaxpr):
     """CIMBA_KERNEL_DEBUG=1: print every 64-bit-typed value in the chunk
     jaxpr with its source line (Mosaic has no 64-bit types; anything listed
     here will fail to lower)."""
-    import os as _os
-
-    if not _os.environ.get("CIMBA_KERNEL_DEBUG"):
+    if not config.env_raw("CIMBA_KERNEL_DEBUG"):
         return
     seen = set()
 

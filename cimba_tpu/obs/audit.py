@@ -40,6 +40,9 @@ module directly), so every device-facing function imports jax locally.
 
 from __future__ import annotations
 
+# cimba-check: persist-path  (CHK001: run cards are disk artifacts —
+# nothing id()-derived may feed them)
+
 import hashlib
 import json
 import os
@@ -163,6 +166,7 @@ def sim_digest(sims, lane_offset=0):
     return jnp.stack([sums[n] for n in CLASS_NAMES])
 
 
+# cimba-check: content-path
 def format_digests(vec) -> Dict[str, str]:
     """One digest vector as the JSON trail-row payload: hex strings,
     32-bit classes masked to their u32 payload width."""
@@ -184,6 +188,7 @@ def format_digests(vec) -> Dict[str, str]:
 # ---------------------------------------------------------------------------
 
 
+# cimba-check: content-path
 def result_digest(tree) -> str:
     """sha256 hex over a pytree of arrays: structure + per-leaf
     dtype/shape/bytes in flatten order.  Bitwise — two results digest
@@ -202,6 +207,7 @@ def result_digest(tree) -> str:
     return h.hexdigest()
 
 
+# cimba-check: content-path
 def stream_result_digest(res) -> str:
     """The canonical digest of a ``StreamResult``: summary + failure
     count + event total (+ pooled metrics when carried).  ``n_waves``/
@@ -271,7 +277,11 @@ def resolve(audit) -> Optional[Audit]:
     memory, a path string collects + writes, an :class:`Audit` is used
     as-is."""
     if audit is None:
-        v = os.environ.get(AUDIT_ENV, "")
+        # local import: the diff half of this module stays loadable
+        # without the package (tools/audit_diff.py file-loads it)
+        from cimba_tpu import config as _config
+
+        v = _config.env_raw(AUDIT_ENV)
         if v in ("", "0"):
             return None
         return Audit() if v == "1" else Audit(out_dir=v)
@@ -304,6 +314,7 @@ def environment() -> dict:
     return build_info()
 
 
+# cimba-check: content-path
 def spec_block(spec) -> dict:
     """The card's spec identity: name + sha256 of the store's
     VALUE-based structural fingerprint (stable across processes —
@@ -371,6 +382,7 @@ def run_card(
     return card
 
 
+# cimba-check: content-path
 def card_digest(card: dict) -> str:
     """Content digest of a card: sha256 over the canonical JSON of
     everything EXCEPT ``card_digest`` itself and the creation
@@ -431,6 +443,7 @@ def load_run_card(path) -> dict:
 # ---------------------------------------------------------------------------
 
 
+# cimba-check: content-path
 def diff_trails(a_rows: List[dict], b_rows: List[dict]) -> Optional[dict]:
     """First divergent trail row between two digest trails, or ``None``
     when identical.  The report names the (wave, chunk) coordinate and
@@ -477,6 +490,7 @@ _GEOMETRY_KEYS = (
 )
 
 
+# cimba-check: content-path
 def diff_cards(a: dict, b: dict) -> dict:
     """Compare two run cards.  Returns a report dict:
 

@@ -262,7 +262,11 @@ class ExpositionServer:
                             json.dumps({"error": repr(e)}).encode(),
                             "application/json",
                         )
-                    except Exception:
+                    except OSError:
+                        # CHK003 fix: the 500 can only fail because the
+                        # socket is already gone (scraper hung up) —
+                        # anything else must surface, not vanish in a
+                        # handler thread
                         pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

@@ -91,8 +91,11 @@ def build_info() -> dict:
             n_devices=jax.device_count(),
             x64=bool(jax.config.jax_enable_x64),
         )
-    except Exception:
-        pass  # jax-less scrape tooling still gets the python/package half
+    except Exception:  # cimba: noqa(CHK003) — jax-less/deviceless scrape
+        # tooling still gets the python/package half; jax can fail here
+        # with backend-specific errors, not just ImportError, and a
+        # build-info probe must never take down a scrape
+        pass
     _BUILD_INFO = out
     return dict(out)
 
@@ -209,6 +212,8 @@ class Family:
     """One metric family: a name, a kind (counter | gauge | histogram),
     help text, declared label names, and the labeled series under it."""
 
+    # cimba-check: must-hold(_lock) _series
+
     def __init__(self, registry: "Registry", name: str, kind: str,
                  help: str, label_names: Tuple[str, ...]):
         self.name = name
@@ -253,6 +258,8 @@ class Registry:
     silently changing kind would corrupt every scrape).  ``history``
     bounds each series' sample ring; :meth:`tick_history` (called by the
     Telemetry sampler) appends one ``(t, value)`` sample per series."""
+
+    # cimba-check: must-hold(_lock) _families
 
     def __init__(self, history: int = 256):
         self.history = int(history)
@@ -377,6 +384,8 @@ class SpanRecorder:
     a trace boundary with NO other trace open, so a span tree is never
     torn across files (a long soak keeps at most two generations on
     disk; ``counters["rotations"]`` says how often it happened)."""
+
+    # cimba-check: must-hold(_lock) _open, _by_trace, _n, _bytes, _fh, counters, completed
 
     def __init__(self, path=None, cap: int = 4096,
                  max_bytes: Optional[int] = None):
@@ -611,6 +620,8 @@ class Telemetry:
     (ticks and collectors still work, scrapes just happen on demand).
     """
 
+    # cimba-check: must-hold(_lock) _hb, _collectors, _services, _service_collectors, _errors, _thread
+
     def __init__(
         self,
         *,
@@ -692,11 +703,14 @@ class Telemetry:
         :meth:`detach_service`, so a long-lived plane observing a
         churn of short-lived services neither pins them in memory nor
         keeps scraping corpses."""
-        name = name or getattr(service, "name", None) or (
-            f"service{len(self._services)}"
-        )
-        collector = _service_collector(self.registry, name, service)
         with self._lock:
+            # the default-name read of _services happens under the same
+            # lock as the append: two services attaching concurrently
+            # must not mint one label (CHK002)
+            name = name or getattr(service, "name", None) or (
+                f"service{len(self._services)}"
+            )
+            collector = _service_collector(self.registry, name, service)
             self._services.append((name, service))
             self._service_collectors[id(service)] = collector
         self.add_collector(collector)
@@ -713,7 +727,8 @@ class Telemetry:
             try:
                 collector()        # final sample, best-effort
             except Exception:
-                self._errors += 1
+                with self._lock:
+                    self._errors += 1
         with self._lock:
             self._services = [
                 (n, s) for n, s in self._services if s is not service
@@ -773,7 +788,8 @@ class Telemetry:
             try:
                 fn()
             except Exception:
-                self._errors += 1
+                with self._lock:
+                    self._errors += 1
         now = time.monotonic()
         for source, t in hb.items():
             self._hb_gauge.labels(source=source).set(now - t)
@@ -872,13 +888,15 @@ class Telemetry:
             if mism:
                 worse("degraded")
             checks[name] = c
+        with self._lock:
+            thread = self._thread
+            errors = self._errors
         return {
             "status": status,
             "ok": status != "unhealthy",
             "services": checks,
-            "sampler_alive": self._thread is not None
-            and self._thread.is_alive(),
-            "collector_errors": self._errors,
+            "sampler_alive": thread is not None and thread.is_alive(),
+            "collector_errors": errors,
         }
 
     def varz(self) -> dict:

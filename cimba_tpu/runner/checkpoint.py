@@ -21,6 +21,9 @@ the first mismatching leaf named — never as downstream shape garbage.
 
 from __future__ import annotations
 
+# cimba-check: persist-path  (CHK001: checkpoints are disk artifacts —
+# the saved fingerprint must be value-based, never id()-derived)
+
 import json
 import os
 from typing import Any, Optional

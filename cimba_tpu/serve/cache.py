@@ -34,21 +34,21 @@ recycled id cannot hit a stale entry, because the stale entry is gone.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, MutableMapping, Optional
 
-#: environment knob for the default capacity
+#: environment knob for the default capacity; the default value (64 —
+#: generous: entries are per (spec, settings) point, not per wave
+#: shape) lives in ``config.ENV_KNOBS``, the one registry of knob
+#: defaults
 CAP_ENV = "CIMBA_PROGRAM_CACHE_CAP"
-
-#: default capacity when the env var is unset — generous (entries are
-#: per (spec, settings) point, not per wave shape)
-DEFAULT_CAP = 64
 
 
 def default_capacity() -> int:
-    cap = int(os.environ.get(CAP_ENV, DEFAULT_CAP))
+    from cimba_tpu import config
+
+    cap = int(config.env_raw(CAP_ENV))
     if cap <= 0:
         raise ValueError(
             f"{CAP_ENV}={cap}: the program cache capacity must be "
@@ -73,6 +73,8 @@ class ProgramCache(MutableMapping):
       :meth:`stats` (misses are counted in :meth:`get_or_create`, the
       accessor the runner and service use).
     """
+
+    # cimba-check: must-hold(_lock) _od, hits, misses, evictions
 
     def __init__(self, capacity: Optional[int] = None, *, store=None):
         self._cap = default_capacity() if capacity is None else int(capacity)
@@ -212,6 +214,7 @@ def cached(programs: MutableMapping, key, factory):
 # -- key builders (the stream runner's cache contract, factored out) --------
 
 
+# cimba-check: content-path
 def spec_fingerprint(spec) -> tuple:
     """STRUCTURAL identity of a ModelSpec for program keys.
 
@@ -277,6 +280,7 @@ def spec_fingerprint(spec) -> tuple:
     return fp
 
 
+# cimba-check: content-path
 def program_class_key(spec, with_metrics: bool, *, mesh, pack) -> tuple:
     """The Tier-A **compatibility class**: everything a compiled chunk
     program bakes in EXCEPT ``chunk_steps`` — the spec's structural
@@ -310,6 +314,7 @@ def program_class_key(spec, with_metrics: bool, *, mesh, pack) -> tuple:
     )
 
 
+# cimba-check: content-path
 def program_key(
     spec, with_metrics: bool, *, mesh, pack, chunk_steps: int,
 ) -> tuple:

@@ -130,6 +130,8 @@ class AdmissionQueue:
     predicate.
     """
 
+    # cimba-check: must-hold(_lock) _heap, _delayed, _closed, depth_hwm
+
     def __init__(self, capacity: int):
         if capacity <= 0:
             raise ValueError(f"queue capacity must be positive: {capacity}")
@@ -221,6 +223,7 @@ class AdmissionQueue:
             self._push(entry)
             self._ready.notify()
 
+    # cimba-check: assume-held
     def _push(self, entry) -> None:
         heapq.heappush(self._heap, ((-entry.priority, entry.seq), entry))
         self.depth_hwm = max(
@@ -253,6 +256,7 @@ class AdmissionQueue:
 
     # -- the dispatcher side --------------------------------------------------
 
+    # cimba-check: assume-held
     def _mature(self, now: float) -> None:
         """Move backoff-delayed entries whose time has come into the
         ready heap (caller holds the lock)."""

@@ -258,6 +258,8 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n
+
     def __init__(
         self,
         *,
@@ -444,7 +446,11 @@ class Service:
             for entry in self._queue.drain_now():
                 self._finish(entry, exc=Cancelled(entry.label),
                              outcome="cancelled")
-        self._stop = True
+        with self._lock:
+            # CHK002: _stop is read by the dispatcher under the lock —
+            # an unlocked write here could be reordered past the
+            # dispatcher's claim
+            self._stop = True
         self._queue.kick()
         self._thread.join(timeout)
         if self._tel is not None:
@@ -671,6 +677,7 @@ class Service:
         total = sum(n for _, _, n in slots)
         return total, self._wave_shape(total) - total
 
+    # cimba-check: assume-held
     def _class_sample(self) -> tuple:
         """Per-class queue depths over EVERY class ever seen (zeros
         included — a Chrome counter track holds its last value, so a
@@ -781,8 +788,14 @@ class Service:
                 # which is what /healthz judges "stalled" against
                 self._tel.heartbeat(f"serve.{self._tel_name}.dispatch")
             entry = self._queue.pop_ready(timeout=0.25)
+            # one atomic read of the shutdown state per poll (CHK002):
+            # _stop/_closed/_outstanding together decide the exit, and
+            # a torn read could exit with a request still outstanding
+            with self._lock:
+                stopping = self._stop
+                drained = self._closed and self._outstanding == 0
             if entry is None:
-                if self._stop or (self._closed and self._outstanding == 0):
+                if stopping or drained:
                     # a backoff-delayed retry may still sit in the
                     # delay heap (it failed after shutdown's
                     # drain_now): cancel it rather than strand its
@@ -793,7 +806,7 @@ class Service:
                                          outcome="cancelled")
                     return
                 continue
-            if self._stop:
+            if stopping:
                 # non-graceful shutdown: whatever is still being popped
                 # (including a requeued multi-wave remainder) is
                 # cancelled, not run to completion
@@ -903,6 +916,7 @@ class Service:
                     e.first_dispatch_t = time.monotonic()
             total, padded = self._plan_pad(slots)
             self._counters["batches"] += 1
+            batch_no = self._counters["batches"]
             self._counters["waves"] += len(slots)
             self._counters["lanes_dispatched"] += total
             self._counters["lanes_padded"] += padded
@@ -922,7 +936,7 @@ class Service:
                     e.span_queue = None
                 e.span_wave = rec.start(
                     e.trace, "wave", parent=e.span_root,
-                    batch=self._counters["batches"],
+                    batch=batch_no,
                     members=len(members), lanes=total, padded=padded,
                 )
         return slots, members
@@ -1179,6 +1193,8 @@ class Service:
         re-queue, charged or not."""
         permanent = isinstance(exc, (ValueError, TypeError))
         charged = len(members) == 1  # solo failure: blame attributable
+        with self._lock:
+            stopping = self._stop
         for entry in members:
             with self._lock:
                 entry.in_flight = False
@@ -1205,7 +1221,7 @@ class Service:
                 err = RetriesExhausted(entry.retries, entry.label)
                 err.__cause__ = exc
                 self._finish(entry, exc=err, outcome="failed")
-            elif self._stop:
+            elif stopping:
                 # non-graceful shutdown already ran: a retry requeued
                 # into the delay heap now could outlive the dispatcher
                 # and strand its future — cancel instead

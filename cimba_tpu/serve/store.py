@@ -48,6 +48,9 @@ save.
 
 from __future__ import annotations
 
+# cimba-check: persist-path  (CHK001: no id() may feed what this module
+# writes to disk — store keys must be value-based)
+
 import dataclasses
 import hashlib
 import json
@@ -102,6 +105,7 @@ class UnstableStoreKey(Exception):
 # misses — never a wrong program.
 
 
+# cimba-check: content-path
 def _stable_code(code: types.CodeType, seen: dict) -> tuple:
     consts = tuple(
         _stable_code(c, seen) if isinstance(c, types.CodeType)
@@ -115,6 +119,7 @@ def _stable_code(code: types.CodeType, seen: dict) -> tuple:
     )
 
 
+# cimba-check: content-path
 def _stable_callable(fn, seen: dict) -> tuple:
     import functools
 
@@ -130,14 +135,16 @@ def _stable_callable(fn, seen: dict) -> tuple:
             "method", _stable_callable(fn.__func__, seen),
             _stable_obj(fn.__self__, seen),
         )
-    if id(fn) in seen:
+    if id(fn) in seen:  # cimba: noqa(CHK001) — in-process revisit key only
         # revisited callable (a closure cycle, or one function shared
         # by several slots): a back-reference to its first-visit
         # ordinal, NOT a bare marker — (f, g, f) and (f, g, g) must
         # digest differently or two different models could share a
-        # store key and hydrate each other's programs
-        return ("ref", seen[id(fn)])
-    seen[id(fn)] = len(seen)
+        # store key and hydrate each other's programs.  Only the
+        # ORDINAL is digested; the id() is a transient dict key that
+        # never leaves this call (hence the CHK001 suppressions).
+        return ("ref", seen[id(fn)])  # cimba: noqa(CHK001)
+    seen[id(fn)] = len(seen)  # cimba: noqa(CHK001) — ordinal is the value
     code = getattr(fn, "__code__", None)
     if code is None:
         mod = getattr(fn, "__module__", None)
@@ -165,6 +172,7 @@ def _stable_callable(fn, seen: dict) -> tuple:
     )
 
 
+# cimba-check: content-path
 def _stable_obj(v, seen: dict) -> tuple:
     """A deterministic, process-independent digestable view of ``v``.
     Raises :class:`UnstableStoreKey` for anything whose repr would
@@ -206,12 +214,22 @@ def _stable_obj(v, seen: dict) -> tuple:
         )
     try:
         import jax
-
-        if isinstance(v, jax.Array):
+    except ImportError:
+        jax = None  # jax-less tooling digests everything else the same way
+    if jax is not None and isinstance(v, jax.Array):
+        try:
             a = np.asarray(v)
-            return ("jx", str(a.dtype), a.shape, a.tobytes())
-    except Exception:
-        pass
+        except Exception as e:
+            # a donated/deleted buffer or leaked tracer: structured,
+            # degradable failure — callers catch UnstableStoreKey and
+            # record a downgrade (the invalidation-ladder contract),
+            # never a raw RuntimeError out of the serving layer
+            raise UnstableStoreKey(
+                f"jax array in spec structure failed host conversion "
+                f"({type(e).__name__}: {e}) — it has no stable value "
+                "digest"
+            ) from e
+        return ("jx", str(a.dtype), a.shape, a.tobytes())
     if callable(v):
         return _stable_callable(v, seen)
     raise UnstableStoreKey(
@@ -221,6 +239,7 @@ def _stable_obj(v, seen: dict) -> tuple:
     )
 
 
+# cimba-check: content-path
 def stable_spec_fingerprint(spec) -> tuple:
     """The VALUE-based structural identity of a ModelSpec — the
     persistent twin of ``cache.spec_fingerprint`` with every ``id()``
@@ -263,6 +282,7 @@ def stable_spec_fingerprint(spec) -> tuple:
     return fp
 
 
+# cimba-check: content-path
 def callable_digest(fn) -> str:
     """The stable content digest of one callable (sha256 hex) — how
     fold artifacts are keyed to their ``summary_path`` across process
@@ -273,6 +293,7 @@ def callable_digest(fn) -> str:
     ).hexdigest()
 
 
+# cimba-check: content-path
 def _mesh_descriptor(mesh) -> Optional[tuple]:
     if mesh is None:
         return None
@@ -288,6 +309,7 @@ def _mesh_descriptor(mesh) -> Optional[tuple]:
     )
 
 
+# cimba-check: content-path
 def store_key(
     spec, with_metrics: bool, *, mesh, pack, chunk_steps: int,
 ) -> str:
@@ -333,6 +355,7 @@ def _environment() -> dict:
     }
 
 
+# cimba-check: content-path
 def _args_sig_digest(args) -> str:
     """The shape signature of one compiled specialization: pytree
     structure plus per-leaf (dtype, shape, weak_type).  The hydration
@@ -366,8 +389,10 @@ def maybe_enable_persistent_cache(root: Optional[str] = None):
     global _XLA_WIRED
     import jax
 
+    from cimba_tpu import config as _config
+
     if root is None:
-        root = os.environ.get(STORE_ENV, "").strip() or None
+        root = _config.env_raw(STORE_ENV).strip() or None
         if root is None:
             return None
     xdir = os.path.join(os.path.abspath(root), "xla")
@@ -377,7 +402,7 @@ def maybe_enable_persistent_cache(root: Optional[str] = None):
     jax.config.update("jax_compilation_cache_dir", xdir)
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs",
-        float(os.environ.get(XLA_MIN_S_ENV, "0")),
+        float(_config.env_raw(XLA_MIN_S_ENV)),
     )
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _XLA_WIRED = xdir
@@ -404,7 +429,9 @@ def get_store(root: str) -> "ProgramStore":
 def default_store() -> Optional["ProgramStore"]:
     """The process-wide store named by ``CIMBA_PROGRAM_STORE`` (None
     when unset)."""
-    root = os.environ.get(STORE_ENV, "").strip()
+    from cimba_tpu import config as _config
+
+    root = _config.env_raw(STORE_ENV).strip()
     if not root:
         return None
     return get_store(root)
@@ -538,6 +565,8 @@ class ProgramStore:
     checkpoint discipline): a killed save leaves the previous manifest
     intact, and a torn artifact fails its checksum on load instead of
     deserializing garbage."""
+
+    # cimba-check: must-hold(_lock) _stats
 
     def __init__(self, root: str, *, enable_xla_cache: bool = True):
         self.root = os.path.abspath(root)

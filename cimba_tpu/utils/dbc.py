@@ -18,12 +18,11 @@ For Python-time (model construction) invariants use plain ``assert`` /
 
 from __future__ import annotations
 
-import os
-
+from cimba_tpu import config
 from cimba_tpu.core.loop import Sim
 
-_ndebug = bool(int(os.environ.get("CIMBA_NDEBUG", "0")))
-_nassert = bool(int(os.environ.get("CIMBA_NASSERT", "0")))
+_ndebug = bool(int(config.env_raw("CIMBA_NDEBUG")))
+_nassert = bool(int(config.env_raw("CIMBA_NASSERT")))
 
 
 def configure(*, ndebug: bool | None = None, nassert: bool | None = None):

@@ -1,0 +1,23 @@
+"""CHK001 fixture: id() in a persist-path file."""
+
+# cimba-check: persist-path
+
+import hashlib
+
+
+def bad_fingerprint(spec):
+    # an id() flowing into a persisted key — the UnstableStoreKey bug
+    # class, caught statically
+    return hashlib.sha256(repr(id(spec)).encode()).hexdigest()  # expect: CHK001
+
+
+def justified(fn, seen):
+    # ordinal indirection: the id never leaves the process (the
+    # store.py _stable_callable pattern) — suppressed, and counted
+    if id(fn) in seen:  # cimba: noqa(CHK001)  # expect-suppressed: CHK001
+        return seen[id(fn)]  # cimba: noqa(CHK001)  # expect-suppressed: CHK001
+    return None
+
+
+def fine(spec):
+    return hashlib.sha256(repr(spec).encode()).hexdigest()

@@ -1,0 +1,38 @@
+"""CHK002 fixture: must-hold attributes touched outside their lock."""
+
+import threading
+
+
+class Queue:
+    # cimba-check: must-hold(_lock) _items, depth_hwm
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []       # __init__ is exempt (no concurrency yet)
+        self.depth_hwm = 0
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)          # locked: fine
+            self.depth_hwm = max(self.depth_hwm, len(self._items))
+
+    def torn_depth(self):
+        return len(self._items)  # expect: CHK002
+
+    def torn_write(self):
+        self.depth_hwm = 0  # expect: CHK002
+
+    def closure_leak(self):
+        with self._lock:
+            def later():
+                # defined under the lock but runs whenever it runs —
+                # the conservative closure rule
+                return self._items.pop()  # expect: CHK002
+            return later
+
+    # cimba-check: assume-held
+    def _drain(self):
+        self._items.clear()                # documented caller-holds
+
+    def _count_locked(self):
+        return len(self._items)            # _locked suffix convention

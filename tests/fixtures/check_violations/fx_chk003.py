@@ -1,0 +1,43 @@
+"""CHK003 fixture: blind exception swallows."""
+
+
+def bare(work):
+    try:
+        work()
+    except:  # expect: CHK003
+        return None
+
+
+def blind_swallow(work):
+    try:
+        work()
+    except Exception:  # expect: CHK003
+        pass
+
+
+def blind_base(work):
+    try:
+        work()
+    except BaseException:  # expect: CHK003
+        ...
+
+
+def narrow_is_fine(work):
+    try:
+        work()
+    except OSError:
+        pass  # narrowed: the socket is just gone
+
+
+def reraise_is_fine(work):
+    try:
+        work()
+    except BaseException:
+        raise  # cleanup-and-reraise is the atomic-write idiom
+
+
+def counted_is_fine(work, errors):
+    try:
+        work()
+    except Exception:
+        errors["n"] = errors.get("n", 0) + 1

@@ -1,0 +1,31 @@
+"""CHK004 fixture: wall-clock / RNG inside digest content paths."""
+
+import hashlib
+import random
+import time
+from datetime import datetime
+
+
+# cimba-check: content-path
+def stamped_digest(tree):
+    h = hashlib.sha256(repr(tree).encode())
+    h.update(repr(time.time()).encode())  # expect: CHK004
+    return h.hexdigest()
+
+
+# cimba-check: content-path
+def salted_digest(tree):
+    salt = random.random()  # expect: CHK004
+    when = datetime.now()  # expect: CHK004
+    return hashlib.sha256(f"{tree}{salt}{when}".encode()).hexdigest()
+
+
+# cimba-check: content-path
+def clean_digest(tree):
+    return hashlib.sha256(repr(tree).encode()).hexdigest()
+
+
+def undeclared_may_use_clock():
+    # not a content path: run cards stamp created_unix OUTSIDE the
+    # digest exactly like this
+    return time.time()

@@ -1,0 +1,29 @@
+"""CHK005 fixture: CIMBA_* env reads bypassing config.env_raw."""
+
+# cimba-check: env-proxied  (stand-in for a file under cimba_tpu/)
+
+import os as _os
+
+KNOB = "CIMBA_FIXTURE_KNOB"
+
+
+def direct_literal():
+    return _os.environ.get("CIMBA_FIXTURE_KNOB", "0")  # expect: CHK005
+
+
+def via_constant():
+    return _os.environ[KNOB]  # expect: CHK005
+
+
+def via_getenv():
+    return _os.getenv(KNOB, "")  # expect: CHK005
+
+
+def non_cimba_is_fine():
+    return _os.environ.get("JAX_PLATFORMS", "")
+
+
+def proxied_is_fine():
+    from cimba_tpu import config
+
+    return config.env_raw("CIMBA_XLA_PACK")

@@ -65,10 +65,16 @@ def test_unwrapped_ring_before_wrap(obs_off):
 
 
 def test_disabled_recorder_zero_op_jaxpr(obs_off):
-    """The acceptance bar: with the recorder (and registry) disabled,
+    """SENTINEL: with the recorder (and registry) disabled,
     ``make_run``'s jaxpr for models/mm1 is IDENTICAL to one traced with
     every obs hook replaced by the identity — i.e. the dispatch-site
-    instrumentation costs literally zero ops when off."""
+    instrumentation costs literally zero ops when off.
+
+    This hooks-removed baseline is the one arm the gate-registry sweep
+    (cimba_tpu/check/gates.py) cannot auto-generate; the off==default
+    and enable-differs arms for trace/metrics (and every other trace
+    gate, both profiles) now run there via tests/test_check.py and the
+    ci.sh static-analysis cell."""
     ot.disable()
     om.disable()
     spec, _ = mm1.build(record=False)

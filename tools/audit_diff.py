@@ -48,12 +48,31 @@ def _load_audit():
     return audit
 
 
+def _version() -> str:
+    """The cimba_tpu package version WITHOUT importing the package (the
+    stdlib-fast property: this tool never pays the jax import).  Reads
+    ``__version__`` out of the package __init__ beside this tool;
+    installed-wheel usage falls back to importlib.metadata."""
+    init = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "cimba_tpu", "__init__.py",
+    )
+    if os.path.exists(init):
+        with open(init) as f:
+            for line in f:
+                if line.startswith("__version__"):
+                    return line.split("=", 1)[1].strip().strip("\"'")
+    from importlib import metadata
+
+    return metadata.version("cimba-tpu")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="compare two run cards / digest trails"
     )
-    ap.add_argument("a", help="run card (or trail list) JSON")
-    ap.add_argument("b", help="run card (or trail list) JSON")
+    ap.add_argument("a", nargs="?", help="run card (or trail list) JSON")
+    ap.add_argument("b", nargs="?", help="run card (or trail list) JSON")
     ap.add_argument(
         "--json", action="store_true",
         help="emit the full report as JSON instead of text",
@@ -63,7 +82,17 @@ def main(argv=None) -> int:
         help="compare trails even when the cards look incomparable "
         "(different spec fingerprint / geometry)",
     )
+    ap.add_argument(
+        "--version", action="store_true",
+        help="print the cimba_tpu package version (fleet provenance: "
+        "pairs with run cards' env block) and exit",
+    )
     args = ap.parse_args(argv)
+    if args.version:
+        print(_version())
+        return 0
+    if args.a is None or args.b is None:
+        ap.error("two run cards (or trail lists) are required")
 
     audit = _load_audit()
     try:

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""cimba-check: the repo's static verification CLI.
+
+Two fronts (docs/19_static_analysis.md):
+
+* AST lints (CHK001-CHK005) over the package source plus the stdlib
+  operator CLIs — stdlib ``ast`` only; with ``--ast-only`` this tool
+  never imports jax (the sub-second dev loop).
+* Program lints (JXL001-JXL003) over traced jaxprs and the trace-time
+  gate-registry sweep (off == baseline jaxpr identity for every
+  registered gate, both dtype profiles) — static with respect to
+  execution: programs are traced/lowered, never compiled or run.
+
+Usage::
+
+    python tools/check.py                 # full: AST + programs + gates
+    python tools/check.py --ast-only      # fast front, no jax import
+    python tools/check.py --json          # machine-readable report
+    python tools/check.py path/ file.py   # explicit targets (AST front)
+
+Exit codes: 0 clean, 1 findings, 2 checker/usage error.  Per-rule
+suppression: a trailing ``# cimba: noqa(RULE)`` on the flagged line
+(suppressions are reported, never silently dropped).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: the default AST-lint target set: the package, and the stdlib
+#: operator CLIs the checker also governs (CHK003/CHK004 apply there)
+DEFAULT_TARGETS = (
+    "cimba_tpu",
+    os.path.join("tools", "check.py"),
+    os.path.join("tools", "metrics_dump.py"),
+    os.path.join("tools", "audit_diff.py"),
+)
+
+
+def _load_ast_front():
+    """File-load the AST front under a private package name so
+    ``--ast-only`` never imports the cimba_tpu package (whose __init__
+    pulls jax).  Falls back to the package import when the source tree
+    is not beside this tool (installed-wheel usage)."""
+    base = os.path.join(REPO, "cimba_tpu", "check")
+    init = os.path.join(base, "__init__.py")
+    if not os.path.exists(init):
+        from cimba_tpu.check import astlint
+
+        import cimba_tpu.check as pkg
+
+        return pkg, astlint
+    spec = importlib.util.spec_from_file_location(
+        "_cimba_check", init, submodule_search_locations=[base],
+    )
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules["_cimba_check"] = pkg
+    spec.loader.exec_module(pkg)
+    aspec = importlib.util.spec_from_file_location(
+        "_cimba_check.astlint", os.path.join(base, "astlint.py"),
+    )
+    astlint = importlib.util.module_from_spec(aspec)
+    sys.modules["_cimba_check.astlint"] = astlint
+    aspec.loader.exec_module(astlint)
+    return pkg, astlint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static verification: AST lints + jaxpr program "
+        "lints + the trace-gate identity sweep",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to AST-lint (default: the package + "
+        "the stdlib operator CLIs)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON",
+    )
+    ap.add_argument(
+        "--ast-only", action="store_true",
+        help="run only the AST front (no jax import; sub-second)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    ap.add_argument(
+        "--version", action="store_true",
+        help="print the cimba_tpu package version and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.version:
+        from cimba_tpu import __version__
+
+        print(__version__)
+        return 0
+
+    try:
+        pkg, astlint = _load_ast_front()
+    except Exception as e:
+        print(f"check: cannot load the AST front: {e!r}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for rule, desc in sorted(astlint.RULES.items()):
+            print(f"{rule}  {desc}")
+        for rule, desc in (
+            ("JXL001", "chunk-program carry not fully donated/aliased"),
+            ("JXL002", "host callback or over-budget gather in a chunk "
+                       "program"),
+            ("JXL003", "weakly-typed leaf in the packed carry"),
+            ("GATE", "a registered trace gate's off state is not the "
+                     "baseline jaxpr"),
+        ):
+            print(f"{rule}  {desc}")
+        return 0
+
+    # explicit paths scope a targeted AST lint; the program lints and
+    # gate sweep are repo-level (they trace shipped models, not the
+    # given files), so paths imply --ast-only
+    ast_only = args.ast_only or bool(args.paths)
+    targets = args.paths or [
+        os.path.join(REPO, t) for t in DEFAULT_TARGETS
+    ]
+    missing = [t for t in targets if not os.path.exists(t)]
+    if missing:
+        print(f"check: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    try:
+        findings, suppressed, n_files = astlint.check_paths(
+            targets, repo_root=REPO,
+        )
+    except Exception as e:
+        print(f"check: AST front crashed: {e!r}", file=sys.stderr)
+        return 2
+
+    program_report = None
+    if not ast_only:
+        try:
+            from cimba_tpu.check import jaxprlint
+        except Exception as e:
+            print(
+                f"check: program lints need jax ({e!r}); rerun with "
+                "--ast-only for the AST front alone",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            prog_findings, program_report = jaxprlint.check_programs()
+        except Exception as e:
+            print(f"check: program lints crashed: {e!r}", file=sys.stderr)
+            return 2
+        findings = findings + prog_findings
+
+    if args.as_json:
+        print(json.dumps(pkg.findings_to_json(
+            findings, suppressed,
+            checked_files=n_files,
+            program_checks=program_report,
+        ), indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        for f in suppressed:
+            print(f.format())
+        fronts = "AST" if ast_only else "AST + program + gate"
+        print(
+            f"check: {n_files} files, {fronts} fronts: "
+            f"{len(findings)} finding(s), {len(suppressed)} suppressed"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

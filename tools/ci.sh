@@ -47,6 +47,35 @@ fi
 
 # (the golden suite runs inside every `pytest tests/` cell above)
 
+# static analysis (docs/19_static_analysis.md): tools/check.py must run
+# clean on the whole repo — AST lints (CHK001-005), program lints
+# (JXL001-003), and the trace-gate registry sweep on mm1 under both
+# dtype profiles; ruff (critical pyflakes tier repo-wide + import order
+# on the verification plane) runs beside it when the image ships it;
+# and the seeded-violation fixture tree must fire every rule exactly
+# where expected (and nowhere else)
+run_cell "static analysis" bash -c '
+  set -e
+  python tools/check.py
+  if python -m ruff --version >/dev/null 2>&1; then
+    python -m ruff check .
+    python -m ruff check --select I \
+      cimba_tpu/check tools/check.py tools/metrics_dump.py \
+      tools/audit_diff.py
+  elif command -v ruff >/dev/null 2>&1; then
+    ruff check .
+    ruff check --select I cimba_tpu/check tools/check.py \
+      tools/metrics_dump.py tools/audit_diff.py
+  else
+    echo "ruff not installed in this image — ruff cell skipped"
+  fi
+  # the seeded-violation fixture assertion lives ONCE, in
+  # tests/test_check.py (exact marker-set equality via the real CLI);
+  # the cell runs that one definition rather than duplicating it
+  python -m pytest tests/test_check.py -q -p no:cacheprovider \
+    -k "fixture or noqa or json_schema"
+'
+
 # perf smoke: the CPU proxy must clear a floor (catches a 5x stepper or
 # sampler regression; the real perf tracking runs on TPU via bench.py)
 run_cell "perf smoke" python - <<'EOF'

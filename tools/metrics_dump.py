@@ -30,8 +30,6 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-from cimba_tpu.obs.expose import parse_prometheus_text  # noqa: E402
-
 
 def _fetch(url: str, timeout: float):
     """(status_code, body_text) — 503 healthz bodies are still read."""
@@ -48,6 +46,10 @@ def print_families(text: str) -> None:
     group under their parent family's header.  Raises ValueError on
     malformed input — the same minimal parser the round-trip tests
     use, so 'it printed' means 'it parses'."""
+    # imported here, not at module level: the package __init__ pulls
+    # jax, and --version (fleet provenance) must stay light
+    from cimba_tpu.obs.expose import parse_prometheus_text
+
     parsed = parse_prometheus_text(text)
     types, samples = parsed["types"], parsed["samples"]
 
@@ -196,7 +198,28 @@ def main(argv=None) -> int:
         "--timeout", type=float, default=10.0,
         help="per-request HTTP timeout, seconds",
     )
+    ap.add_argument(
+        "--version", action="store_true",
+        help="print the cimba_tpu package version (fleet provenance: "
+        "pairs with the /varz build block) and exit",
+    )
     args = ap.parse_args(argv)
+    if args.version:
+        # the file-side reader stays jax-free (the audit_diff pattern)
+        init = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "cimba_tpu", "__init__.py",
+        )
+        if os.path.exists(init):
+            with open(init) as f:
+                for line in f:
+                    if line.startswith("__version__"):
+                        print(line.split("=", 1)[1].strip().strip("\"'"))
+                        return 0
+        from cimba_tpu import __version__
+
+        print(__version__)
+        return 0
     if bool(args.url) == bool(args.demo):
         ap.error("pass exactly one of --url or --demo")
     if args.demo:
