@@ -6,11 +6,14 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_mixed,mmc,mg1,sweep,tandem,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,jobshop,awacs}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
-docs/15_program_store.md; ``serve_mixed`` is the heterogeneous-traffic
+docs/15_program_store.md; ``serve_fleet`` is the multi-process fleet —
+1 vs 2 vs 4 slice subprocesses behind the front-door router at the
+same offered load, plus a kill-9-mid-load chaos arm,
+docs/20_fleet.md; ``serve_mixed`` is the heterogeneous-traffic
 mix measuring wave-packing occupancy and padding waste,
 docs/14_wave_packing.md; ``sweep`` races fixed-R against adaptive-R
 sequential stopping on the M/G/1 grid, docs/16_sweeps.md; ``tandem``
@@ -1428,6 +1431,176 @@ print(json.dumps({
 """
 
 
+def bench_serve_fleet():
+    """The first MULTI-PROCESS serving numbers (docs/20_fleet.md):
+    spin fleets of 1, 2, and 4 slice subprocesses behind the front-door
+    router, drive the SAME offered open-loop load at each width
+    (identical request stream, arrival schedule, and clients — only the
+    fleet width changes), and report replications/s plus p50/p95/p99
+    request latency per width, then a CHAOS arm: 2 slices with one
+    killed -9 mid-load (``CIMBA_FLEET_CHAOS=kill=N`` on that slice,
+    respawn on), reporting the latency distribution through the
+    failover plus the requeue/transition counts.  Every completed
+    result's digest must equal the direct single-process call's (all
+    requests share one seed, so one direct anchor covers them); the
+    chaos arm must complete 100% of its requests.  Slices hydrate from
+    a warm store built once up front, so per-arm startup is process
+    spawn + deserialize, not recompile.  Knobs:
+    ``CIMBA_BENCH_FLEET_REQ_R`` (replications/request),
+    ``CIMBA_BENCH_FLEET_REQUESTS``, ``CIMBA_BENCH_FLEET_IAT``
+    (inter-arrival seconds).  Under ``CIMBA_BENCH_RUN_CARD`` the line
+    lands as a PR 9 run card like every other battery line."""
+    import tempfile
+
+    from cimba_tpu import serve
+    from cimba_tpu.fleet.manager import FleetManager
+    from cimba_tpu.models import mm1
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.serve import cache as pc
+    from cimba_tpu.serve import store as pstore
+
+    req_r = int(os.environ.get("CIMBA_BENCH_FLEET_REQ_R", "64"))
+    n_requests = int(os.environ.get("CIMBA_BENCH_FLEET_REQUESTS", "24"))
+    iat = float(os.environ.get("CIMBA_BENCH_FLEET_IAT", "0.05"))
+    objs = int(os.environ.get("CIMBA_BENCH_OBJECTS", "50"))
+    chunk = 256
+    seed = 2026
+    models = {
+        "mm1": {"fn": "cimba_tpu.models.mm1:build",
+                "kwargs": {"record": False}},
+    }
+
+    # one warm store for every arm: slices deserialize instead of
+    # compiling, so arm startup measures the fleet, not XLA
+    store_dir = tempfile.mkdtemp(prefix="cimba_fleet_bench_")
+    spec, _ = mm1.build(record=False)
+    st = pstore.get_store(store_dir)
+    st.save_programs(
+        spec, mm1.params(objs), req_r, wave_sizes=(req_r,),
+        chunk_steps=chunk, horizon_modes=("none",),
+    )
+    _heartbeat()
+    # the direct single-process anchor (same seed for every request →
+    # one digest covers the whole stream), hydrated from the store
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(objs), req_r, wave_size=req_r,
+        chunk_steps=chunk, seed=seed,
+        program_cache=pc.ProgramCache(),
+        on_wave=_heartbeat, on_chunk=_heartbeat,
+    )
+    anchor = _audit.stream_result_digest(direct)
+
+    def drive(fm, tag):
+        fspec = fm.spec("mm1")
+        reqs = [
+            serve.Request(
+                fspec, mm1.params(objs), req_r, seed=seed,
+                wave_size=req_r, chunk_steps=chunk,
+                label=f"{tag}{i}",
+            )
+            for i in range(n_requests)
+        ]
+        report = serve.run_load(
+            fm.router, reqs, n_clients=4, inter_arrival_s=iat,
+            result_timeout=600,
+        )
+        _heartbeat()
+        return report
+
+    def arm_detail(report, fm):
+        rs = fm.router.stats()
+        return {
+            "requests": report.n_requests,
+            "completed": report.n_completed,
+            "wall_s": report.wall_s,
+            "replications_per_sec": report.replications_per_sec,
+            "latency": report.latency_percentiles(),
+            "requeues": rs["requeues"],
+            "wire_errors": rs["wire_errors"],
+            "placed_by_slice": {
+                name: s["placed_total"]
+                for name, s in rs["slices"].items()
+            },
+            "errors": dict(report.errors),
+        }
+
+    arms = {}
+    for n_slices in (1, 2, 4):
+        with FleetManager(
+            models, n_slices=n_slices, max_wave=req_r,
+            store=store_dir, warm_chunk_steps=chunk, window=2,
+            poll_interval=0.3,
+        ) as fm:
+            # warm spill: a burst wider than one slice's window forces
+            # the class onto every slice before timing
+            serve.run_load(
+                fm.router,
+                [serve.Request(
+                    fm.spec("mm1"), mm1.params(objs), req_r, seed=seed,
+                    wave_size=req_r, chunk_steps=chunk, label=f"w{i}",
+                ) for i in range(2 * n_slices)],
+                n_clients=4, result_timeout=600,
+            )
+            report = drive(fm, f"n{n_slices}-")
+            assert report.n_completed == n_requests, report.errors
+            for _, res in report.results:
+                assert _audit.stream_result_digest(res) == anchor
+            arms[f"slices_{n_slices}"] = arm_detail(report, fm)
+        _heartbeat()
+
+    # chaos arm: 2 slices, one murdered a third of the way in — the
+    # latency percentiles INCLUDE the failover window, which is the
+    # number an operator actually cares about
+    kill_after = max(n_requests // 3, 2)
+    with FleetManager(
+        models, n_slices=2, max_wave=req_r, store=store_dir,
+        warm_chunk_steps=chunk, window=2, poll_interval=0.3,
+        slice_env={1: {
+            "CIMBA_FLEET_CHAOS": f"seed=7,kill={kill_after}",
+        }},
+    ) as fm:
+        serve.run_load(
+            fm.router,
+            [serve.Request(
+                fm.spec("mm1"), mm1.params(objs), req_r, seed=seed,
+                wave_size=req_r, chunk_steps=chunk, label=f"cw{i}",
+            ) for i in range(4)],
+            n_clients=4, result_timeout=600,
+        )
+        report = drive(fm, "chaos-")
+        assert report.n_completed == n_requests, (
+            "chaos arm lost requests", report.errors,
+        )
+        for _, res in report.results:
+            assert _audit.stream_result_digest(res) == anchor
+        chaos = arm_detail(report, fm)
+        chaos["kill_after"] = kill_after
+        chaos["transitions"] = [
+            {"slice": name, "event": ev, "reason": reason[:120]}
+            for _, name, ev, reason in fm.poller.transitions
+        ]
+    headline = arms["slices_2"]["replications_per_sec"]
+    _line(
+        "serve_fleet_reps_per_sec",
+        headline,
+        None,
+        {
+            "path": "fleet_router_multiprocess",
+            "replications_per_request": req_r,
+            "requests": n_requests,
+            "inter_arrival_s": iat,
+            "objects_per_replication": objs,
+            "chunk_steps": chunk,
+            "arms": arms,
+            "chaos": chaos,
+            "anchor_digest": anchor,
+            "store": store_dir,
+        },
+        unit="reps/s",
+    )
+
+
 def bench_serve_cold():
     """Cold-start time-to-first-result with and without a hydrated AOT
     program store (docs/15_program_store.md), at the ``serve`` arm's
@@ -2100,6 +2273,7 @@ CONFIGS = {
     "mm1_single": bench_mm1_single,
     "serve": bench_serve,
     "serve_cold": bench_serve_cold,
+    "serve_fleet": bench_serve_fleet,
     "serve_mixed": bench_serve_mixed,
     "mmc": bench_mmc,
     "mg1": bench_mg1,

@@ -196,6 +196,24 @@ ENV_KNOBS = {
         default="0", trace_gate=False,
         doc="min compile seconds for jax's persistent cache entries",
     ),
+    # fleet plane (docs/20_fleet.md): host-side process topology and
+    # fault injection — no traced-program effect
+    "CIMBA_FLEET_CHAOS": dict(
+        default="", trace_gate=False,
+        doc="fleet fault injection (fleet/chaos.py): comma-separated "
+            "k=v knobs — seed=<u64>, drop=<k> (drop first-attempt wire "
+            "responses deterministically by fmix64(seed, slice, "
+            "request id)), kill=<n> (SIGKILL the slice after n served "
+            "requests), scrape_delay_ms=<ms> (stall /healthz + "
+            "/metrics responses)",
+    ),
+    "CIMBA_FLEET_DIST": dict(
+        default="", trace_gate=False,
+        doc="opt-in jax.distributed multi-controller init at slice "
+            "startup (fleet/dist.py): coordinator_address,"
+            "num_processes,process_id — off (the default) never "
+            "touches jax.distributed",
+    ),
     # assertion tiers: compile-out is the FEATURE (utils/dbc.py); the
     # gated-handler invariant battery (test_gated_invariant.py) owns
     # their correctness, so they are not registry gates

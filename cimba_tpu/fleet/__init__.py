@@ -1,0 +1,60 @@
+"""cimba_tpu.fleet — the multi-process serving fleet (docs/20_fleet.md).
+
+Cimba's level-1 concurrency — trials fanned over worker threads
+pulling a shared atomic counter — maps at production scale to a fleet
+of dispatcher *processes*: each slice runs one device-owner
+:class:`~cimba_tpu.serve.service.Service` with its own ``/healthz`` +
+``/metrics`` endpoint (PR 8) and hydrates compiled programs from the
+shared ``CIMBA_PROGRAM_STORE`` manifest (PR 6), while the front-door
+:class:`~cimba_tpu.fleet.router.FleetRouter` keeps the single-process
+``submit()/ResultHandle`` surface and adds placement (compatibility-
+class co-location + least-loaded spill), liveness (health-scrape
+failover within one poll interval), and requeue-with-``excluded``
+recovery (the ``serve/sched.py`` pattern lifted from "failing batch
+peer" to "failing host").  Results carry their PR 9 digest end to end.
+
+    from cimba_tpu.fleet import FleetManager
+    models = {"mm1": {"fn": "cimba_tpu.models.mm1:build",
+                      "kwargs": {"record": False}}}
+    with FleetManager(models, n_slices=2) as fm:
+        h = fm.router.submit(serve.Request(fm.spec("mm1"), params, 64))
+        result = h.result()
+
+Zero-cost when unused: importing :mod:`cimba_tpu` never imports this
+package, and importing this package spawns no process or thread —
+only constructing a manager/router does.  Fault injection:
+``CIMBA_FLEET_CHAOS`` (:mod:`cimba_tpu.fleet.chaos`).
+"""
+
+__all__ = [
+    "FleetManager", "FleetRouter", "FleetHandle", "SliceHandle",
+    "HealthPoller", "SliceSpawnError",
+    "FleetError", "FleetRemoteError", "FleetRequeuesExhausted",
+]
+
+_EXPORTS = {
+    "HealthPoller": "cimba_tpu.fleet.health",
+    "FleetManager": "cimba_tpu.fleet.manager",
+    "SliceSpawnError": "cimba_tpu.fleet.manager",
+    "FleetError": "cimba_tpu.fleet.router",
+    "FleetHandle": "cimba_tpu.fleet.router",
+    "FleetRemoteError": "cimba_tpu.fleet.router",
+    "FleetRequeuesExhausted": "cimba_tpu.fleet.router",
+    "FleetRouter": "cimba_tpu.fleet.router",
+    "SliceHandle": "cimba_tpu.fleet.router",
+}
+
+
+def __getattr__(name):
+    # lazy exports (PEP 562): `python -m cimba_tpu.fleet.slice` runs
+    # this __init__ before executing slice as __main__, and an eager
+    # `from .manager import ...` here would pre-import the slice module
+    # runpy is about to execute (the sys.modules double-import warning)
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

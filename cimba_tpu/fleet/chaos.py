@@ -1,0 +1,107 @@
+"""Deterministic fault injection for the fleet (``CIMBA_FLEET_CHAOS``).
+
+Failover code that is only exercised by real outages is failover code
+that has never been tested.  This module turns the three fleet failure
+modes into seeded, reproducible knobs (registered in
+``config.ENV_KNOBS``; docs/20_fleet.md):
+
+* ``drop=<k>`` — a slice drops (closes the connection without
+  replying) the FIRST-attempt wire response of every request whose
+  ``fmix64(seed, slice salt, request id)`` lands in the 1/k bucket:
+  the router sees a transport failure and requeues onto another slice.
+  Only ``attempt == 0`` is ever dropped, so a chaos run still
+  completes 100% of its requests — and, because the decision is a pure
+  function of (seed, slice, request id), two runs of the same request
+  stream drop — and therefore requeue — identically (the determinism
+  pin in tests/test_fleet.py).
+* ``kill=<n>`` — the slice SIGKILLs itself after serving ``n``
+  requests: the mid-load hard-death arm (process exit, in-flight
+  requests lost, health scrape goes unreachable).
+* ``scrape_delay_ms=<ms>`` — ``/healthz`` + ``/metrics`` responses
+  stall: the "alive but unscrapeable" arm that exercises the health
+  poller's timeout path.
+
+``seed=<u64>`` seeds the drop hash.  Unset (the default) injects
+nothing; parsing is strict — a typo'd knob raises at slice startup,
+never silently no-ops.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from cimba_tpu import config as _config
+from cimba_tpu.sweep.adaptive import _GOLDEN, _fmix64
+
+ENV = "CIMBA_FLEET_CHAOS"
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Parsed ``CIMBA_FLEET_CHAOS`` knobs (all off by default)."""
+
+    seed: int = 0
+    drop: int = 0
+    kill: int = 0
+    scrape_delay_ms: int = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop or self.kill or self.scrape_delay_ms)
+
+
+def parse(raw: Optional[str] = None) -> ChaosConfig:
+    """Parse a chaos spec (``raw=None`` reads the env knob): a
+    comma-separated ``k=v`` list, e.g. ``"seed=7,drop=3,kill=20"``."""
+    if raw is None:
+        raw = _config.env_raw(ENV)
+    raw = raw.strip()
+    if not raw:
+        return ChaosConfig()
+    fields = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(
+                f"{ENV}: malformed knob {item!r} (expected k=v; knobs: "
+                "seed, drop, kill, scrape_delay_ms)"
+            )
+        k, v = item.split("=", 1)
+        k = k.strip()
+        if k not in ("seed", "drop", "kill", "scrape_delay_ms"):
+            raise ValueError(
+                f"{ENV}: unknown knob {k!r} (knobs: seed, drop, kill, "
+                "scrape_delay_ms)"
+            )
+        try:
+            fields[k] = int(v)
+        except ValueError as e:
+            raise ValueError(f"{ENV}: {k}={v!r} is not an integer") from e
+    return ChaosConfig(**fields)
+
+
+def slice_salt(name: str) -> int:
+    """A slice's stable u64 chaos salt (sha256 of its name): two slices
+    with the same drop config must not drop the same request ids, or a
+    dropped request would be re-dropped wherever it requeues."""
+    return int.from_bytes(
+        hashlib.sha256(name.encode("utf-8")).digest()[:8], "big"
+    )
+
+
+def should_drop(cfg: ChaosConfig, salt: int, req_id: int,
+                attempt: int) -> bool:
+    """Deterministic drop decision for one (slice, request, attempt):
+    first attempts only (the run still completes after the requeue),
+    hashed with the PR 7 host-side fmix64 idiom."""
+    if cfg.drop <= 0 or attempt != 0:
+        return False
+    h = _fmix64(
+        (int(cfg.seed) + _GOLDEN * (int(req_id) + 1)) & ((1 << 64) - 1)
+    )
+    h = _fmix64((h ^ int(salt)) & ((1 << 64) - 1))
+    return h % cfg.drop == 0

@@ -1,0 +1,49 @@
+"""Optional ``jax.distributed`` multi-controller init (off by default).
+
+ROADMAP item 1's end state is a true multi-host ``Mesh`` with
+cross-host ``psum`` liveness polling; this module is the flag-gated
+first rung: a slice process started with ``CIMBA_FLEET_DIST`` set
+joins a jax.distributed coordination service at startup, so a future
+fleet can build cross-host meshes without changing the slice
+entrypoint.  The knob format is
+``coordinator_address,num_processes,process_id`` (e.g.
+``"10.0.0.1:1234,4,0"``).
+
+Unset (the default — and everywhere in tier-1), this module never
+touches ``jax.distributed``: importing it is free, calling
+:func:`maybe_init_distributed` reads one env knob and returns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cimba_tpu import config as _config
+
+ENV = "CIMBA_FLEET_DIST"
+
+
+def maybe_init_distributed() -> Optional[dict]:
+    """Initialize jax.distributed iff ``CIMBA_FLEET_DIST`` is set.
+    Returns the parsed settings (or None when off).  Malformed settings
+    raise loudly — a half-joined fleet is worse than a dead slice."""
+    raw = _config.env_raw(ENV).strip()
+    if not raw:
+        return None
+    parts = [p.strip() for p in raw.split(",")]
+    if len(parts) != 3:
+        raise ValueError(
+            f"{ENV}={raw!r}: expected "
+            "'coordinator_address,num_processes,process_id'"
+        )
+    addr, num, pid = parts[0], int(parts[1]), int(parts[2])
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=pid,
+    )
+    return {
+        "coordinator_address": addr,
+        "num_processes": num,
+        "process_id": pid,
+    }

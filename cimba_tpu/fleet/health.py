@@ -1,0 +1,198 @@
+"""Health-scrape failover: the fleet's liveness loop.
+
+A poller thread scrapes every slice's ``/healthz`` and ``/metrics``
+(the PR 8 exposition plane, parsed by the one in-repo
+``parse_prometheus_text``) on a fixed interval.  A slice is marked
+**down within one poll interval** of any of: its process no longer
+answering HTTP (kill -9, crash), a 503 ``/healthz`` (dead or stalled
+dispatcher), or a scrape exceeding the timeout (the chaos
+``scrape_delay_ms`` arm).  Marking down is a call into
+:meth:`~cimba_tpu.fleet.router.FleetRouter.mark_down` — the slice's
+queued and in-flight requests requeue onto live slices with the slice
+id appended to their ``excluded`` set (the ``serve/sched.py``
+solo-retry pattern lifted one level) — followed by the ``on_down``
+callback the :class:`~cimba_tpu.fleet.manager.FleetManager` uses to
+respawn a replacement.
+
+Healthy scrapes feed the router's placement: queue depth, outstanding,
+padding waste, and the program-store hit/fallback counters land in
+each handle's ``scraped`` dict (and in :meth:`HealthPoller.reports`),
+which is also what ``tools/metrics_dump.py --fleet`` tabulates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["HealthPoller", "scrape_slice"]
+
+
+def scrape_slice(health_url: str, timeout: float) -> dict:
+    """One scrape of one slice: ``/healthz`` verdict + the placement
+    gauges parsed out of ``/metrics``.  Returns a report dict with
+    ``reachable``/``verdict`` always present; raises nothing (an
+    unreachable endpoint IS the signal)."""
+    from cimba_tpu.obs.expose import parse_prometheus_text
+
+    base = health_url.rstrip("/")
+    out: dict = {
+        "reachable": False,
+        "verdict": "unreachable",
+        "t": time.monotonic(),
+    }
+    try:
+        try:
+            with urllib.request.urlopen(
+                base + "/healthz", timeout=timeout
+            ) as r:
+                body = r.read()
+                status = r.status
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            status = e.code
+        hz = json.loads(body)
+        out["reachable"] = True
+        out["http_status"] = status
+        out["verdict"] = hz.get("status", "unhealthy")
+        with urllib.request.urlopen(
+            base + "/metrics", timeout=timeout
+        ) as r:
+            text = r.read().decode()
+        samples = parse_prometheus_text(text)["samples"]
+
+        def total(name):
+            fam = samples.get(name)
+            if not fam:
+                return None
+            return sum(fam.values())
+
+        for field, metric in (
+            ("queue_depth", "cimba_serve_queue_depth"),
+            ("outstanding", "cimba_serve_outstanding"),
+            ("padding_waste", "cimba_serve_padding_waste_ratio"),
+            ("completed", "cimba_serve_requests_completed_total"),
+            ("store_hits", "cimba_program_store_hits_total"),
+            ("store_fallback_shapes",
+             "cimba_program_store_fallback_shapes_total"),
+        ):
+            v = total(metric)
+            if v is not None:
+                out[field] = v
+    except (OSError, ValueError) as e:
+        # connection refused/reset, timeout, or unparseable body —
+        # all of them mean "treat this slice as gone"
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+class HealthPoller:
+    """The fleet's background scrape loop over a
+    :class:`~cimba_tpu.fleet.router.FleetRouter`'s slices.
+
+    ``interval`` is the poll period — the failover-latency contract is
+    "a dead slice is marked down within one interval (plus the scrape
+    ``timeout``)".  ``on_down(handle, reason)`` runs AFTER the router
+    requeued the slice's in-flight requests (the manager's respawn
+    hook).  ``transitions`` records ``(t, slice, event, reason)`` rows
+    for tests and post-mortems."""
+
+    # cimba-check: must-hold(_lock) transitions, _reports, _down_seen
+
+    def __init__(
+        self,
+        router,
+        *,
+        interval: float = 0.5,
+        timeout: float = 1.0,
+        on_down: Optional[Callable] = None,
+        autostart: bool = True,
+    ):
+        self.router = router
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.on_down = on_down
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.transitions: List[tuple] = []
+        self._reports: Dict[str, dict] = {}
+        self._down_seen: set = set()
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="cimba-fleet-health", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One pass over every registered slice (also callable
+        synchronously from tests).  A slice the ROUTER already marked
+        down passively (connection refused mid-request) is picked up
+        here too — the transition is recorded at the router's flip
+        time and ``on_down`` still fires exactly once per death."""
+        for name, handle in self.router.slices().items():
+            if not handle.up:
+                self._handle_down(
+                    handle,
+                    handle.down_reason or "marked down",
+                    at=handle.down_t,
+                )
+                continue
+            rep = scrape_slice(handle.health_url, self.timeout)
+            with self._lock:
+                self._reports[name] = rep
+            if not rep["reachable"] or rep["verdict"] == "unhealthy":
+                reason = rep.get(
+                    "error", f"healthz {rep['verdict']}"
+                )
+                self.router.mark_down(name, reason)
+                self._handle_down(handle, reason)
+            else:
+                self.router.update_scrape(name, rep)
+
+    def _handle_down(self, handle, reason: str,
+                     at: Optional[float] = None) -> None:
+        """Record one slice's death exactly once and fire ``on_down``."""
+        with self._lock:
+            if handle.name in self._down_seen:
+                return
+            self._down_seen.add(handle.name)
+            self.transitions.append(
+                (at if at is not None else time.monotonic(),
+                 handle.name, "down", reason)
+            )
+        if self.on_down is not None:
+            try:
+                self.on_down(handle, reason)
+            except Exception as e:
+                # a respawn hook bug must not kill the poller (the
+                # fleet would silently stop failing over)
+                with self._lock:
+                    self.transitions.append((
+                        time.monotonic(), handle.name,
+                        "on_down_error", repr(e),
+                    ))
+
+    def reports(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._reports)
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None

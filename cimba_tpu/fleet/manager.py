@@ -1,0 +1,296 @@
+"""Fleet lifecycle: spawn slices, watch them, replace the dead ones.
+
+:class:`FleetManager` is the one-call deployment of the multi-process
+serving fleet (docs/20_fleet.md): it spawns ``n_slices`` slice worker
+processes (``python -m cimba_tpu.fleet.slice``), reads each one's
+ready line, registers them with a :class:`~cimba_tpu.fleet.router.
+FleetRouter` and a :class:`~cimba_tpu.fleet.health.HealthPoller`, and
+— when the poller marks a slice down — reaps the corpse and spawns a
+warm replacement (the new process inherits ``CIMBA_PROGRAM_STORE``, so
+it hydrates compiled programs from the store manifest and serves its
+first request without compiling; PR 6's sub-second slice replacement).
+
+    from cimba_tpu.fleet.manager import FleetManager
+    models = {"mm1": {"fn": "cimba_tpu.models.mm1:build",
+                      "kwargs": {"record": False}}}
+    with FleetManager(models, n_slices=2, store=store_dir) as fm:
+        h = fm.router.submit(serve.Request(fm.spec("mm1"), params, 64))
+        result = h.result()
+
+The manager resolves the SAME model builders the slices run
+(:func:`~cimba_tpu.fleet.slice.load_models`), so ``fm.spec(name)`` is
+the spec object clients put in their Requests and the router's
+registry resolves it by structural fingerprint.  Everything here is
+host-side process plumbing — importing ``cimba_tpu`` (or even this
+module) spawns nothing; only constructing a manager does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from cimba_tpu.fleet.health import HealthPoller
+from cimba_tpu.fleet.router import FleetRouter, SliceHandle
+from cimba_tpu.fleet.slice import load_models
+
+__all__ = ["FleetManager", "SliceSpawnError"]
+
+
+class SliceSpawnError(RuntimeError):
+    """A slice process failed to produce its ready line."""
+
+
+def _read_ready(proc: subprocess.Popen, timeout: float) -> dict:
+    """Read the slice's one-line ready JSON from stdout with a
+    timeout (a thread — readline has no native timeout)."""
+    box: Dict[str, Any] = {}
+
+    def read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout)
+    line = box.get("line", "")
+    if not line:
+        rc = proc.poll()
+        raise SliceSpawnError(
+            f"slice produced no ready line within {timeout}s "
+            f"(exit code {rc}); see its stderr"
+        )
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError as e:
+        raise SliceSpawnError(
+            f"unparseable slice ready line {line!r}"
+        ) from e
+
+
+class FleetManager:
+    """Spawn + supervise a fleet of slice processes behind one router.
+
+    ``models`` is the registry both sides build from (see module
+    docstring); ``store`` (optional) is a program-store root exported
+    to every slice as ``CIMBA_PROGRAM_STORE`` (+ ``warm_chunk_steps``
+    naming the store entry's chunk budget); ``slice_env`` maps the
+    INITIAL slice index to extra env vars (the chaos-injection hook:
+    ``{1: {"CIMBA_FLEET_CHAOS": "seed=7,kill=20"}}``) — replacements
+    spawn with the base env only, so a chaos-killed slice is replaced
+    by a healthy one.  ``respawn=False`` disables replacement (a test
+    watching a hole stay open)."""
+
+    def __init__(
+        self,
+        models: Dict[str, Any],
+        n_slices: int = 2,
+        *,
+        max_wave: int = 4096,
+        max_pending: int = 64,
+        window: int = 4,
+        store: Optional[str] = None,
+        warm_chunk_steps: Optional[int] = None,
+        poll_interval: float = 0.5,
+        scrape_timeout: float = 1.0,
+        respawn: bool = True,
+        slice_env: Optional[Dict[int, Dict[str, str]]] = None,
+        place_seed: int = 0,
+        max_requeues: int = 8,
+        request_timeout: Optional[float] = 600.0,
+        spawn_timeout: float = 180.0,
+        horizon_bucket: Optional[float] = 16.0,
+        name: str = "cimba-fleet",
+    ):
+        if n_slices <= 0:
+            raise ValueError(f"n_slices must be positive: {n_slices}")
+        self.models_json = json.dumps(
+            models if not isinstance(models, str) else json.loads(models)
+        )
+        self._specs = load_models(models)
+        self.store = store
+        self.warm_chunk_steps = warm_chunk_steps
+        self.max_wave = int(max_wave)
+        self.max_pending = int(max_pending)
+        self._horizon_bucket = horizon_bucket
+        self.poll_interval = float(poll_interval)
+        self.respawn = bool(respawn)
+        self.spawn_timeout = float(spawn_timeout)
+        self._closing = False
+        self._n = 0
+        self._lock = threading.Lock()
+        self.router = FleetRouter(
+            models=self._specs, window=window, place_seed=place_seed,
+            max_requeues=max_requeues, request_timeout=request_timeout,
+            horizon_bucket=horizon_bucket, name=name,
+        )
+        procs = []
+        try:
+            for i in range(n_slices):
+                procs.append(self._launch(
+                    extra_env=(slice_env or {}).get(i)
+                ))
+            for proc, sname in procs:
+                self._register(proc, sname)
+        except BaseException:
+            for proc, _ in procs:
+                proc.kill()
+            raise
+        self.poller = HealthPoller(
+            self.router, interval=self.poll_interval,
+            timeout=scrape_timeout, on_down=self._on_down,
+        )
+
+    # -- the spawn leg -------------------------------------------------------
+
+    def spec(self, name: str):
+        """The parent-side spec object for ``name`` — what client
+        Requests must carry so the router resolves them."""
+        return self._specs[name]
+
+    def _launch(self, extra_env: Optional[Dict[str, str]] = None):
+        with self._lock:
+            sname = f"slice{self._n}"
+            self._n += 1
+        cmd = [
+            sys.executable, "-m", "cimba_tpu.fleet.slice",
+            "--name", sname,
+            "--models", self.models_json,
+            "--port", "0",
+            "--health-port", "0",
+            "--max-wave", str(self.max_wave),
+            "--max-pending", str(self.max_pending),
+            # the router's co-location class and the slice's packing
+            # class share one definition — and one RATIO
+            "--horizon-bucket", (
+                "none" if self._horizon_bucket is None
+                else repr(float(self._horizon_bucket))
+            ),
+        ]
+        if self.warm_chunk_steps is not None:
+            cmd += ["--warm-chunk-steps", str(self.warm_chunk_steps)]
+        env = dict(os.environ)
+        if self.store is not None:
+            env["CIMBA_PROGRAM_STORE"] = str(self.store)
+        env.update(extra_env or {})
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=None, text=True,
+            env=env,
+        )
+        return proc, sname
+
+    def _register(self, proc: subprocess.Popen, sname: str) -> SliceHandle:
+        try:
+            info = _read_ready(proc, self.spawn_timeout)
+        except SliceSpawnError:
+            proc.kill()
+            raise
+        handle = SliceHandle(
+            sname, "127.0.0.1", info["port"], info["url"],
+            proc=proc, pid=info.get("pid"),
+        )
+        self.router.add_slice(handle)
+        return handle
+
+    def _spawn(self, extra_env: Optional[Dict[str, str]] = None
+               ) -> SliceHandle:
+        proc, sname = self._launch(extra_env)
+        return self._register(proc, sname)
+
+    def _on_down(self, handle: SliceHandle, reason: str) -> None:
+        """Poller callback: hand the reap + respawn to a worker thread
+        and return immediately — a replacement's startup (process
+        spawn, jax import, store hydrate) takes seconds, and blocking
+        the ONLY polling thread that long would leave a second
+        near-simultaneous death undetected, violating the
+        one-poll-interval contract."""
+        threading.Thread(
+            target=self._replace, args=(handle,),
+            name=f"fleet-respawn-{handle.name}", daemon=True,
+        ).start()
+
+    def _replace(self, handle: SliceHandle) -> None:
+        proc = handle.proc
+        if proc is not None:
+            if proc.poll() is None:
+                # marked down but still running (stalled dispatcher,
+                # unscrapeable): a down slice gets no more placements,
+                # so keeping the process is pure waste
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable zombie; init will collect it
+            if proc.stdout is not None:
+                proc.stdout.close()
+        # forget the corpse entirely — a long kill/respawn churn must
+        # not accumulate dead handles in the router's placement scans
+        self.router.remove_slice(handle.name)
+        if self.respawn and not self._closing:
+            try:
+                h = self._spawn()
+                if self._closing and h.proc is not None:
+                    # shutdown raced the respawn: don't leave an
+                    # orphan (the slice's own parent-gone watchdog is
+                    # the backstop, this is the prompt path)
+                    h.proc.kill()
+            except SliceSpawnError:
+                # the poller's transitions already record the death;
+                # a failed respawn must not kill the worker silently —
+                # surface it where slice logs go
+                import traceback
+
+                traceback.print_exc()
+
+    # -- observability -------------------------------------------------------
+
+    def fleet_manifest(self) -> dict:
+        """The fleet as ``tools/metrics_dump.py --fleet`` consumes it:
+        ``{"slices": [{"name", "url", "up"}]}``."""
+        return {
+            "slices": [
+                {"name": h.name, "url": h.health_url, "up": h.up}
+                for h in self.router.slices().values()
+            ]
+        }
+
+    def stats(self) -> dict:
+        out = self.router.stats()
+        out["health"] = self.poller.reports()
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        self._closing = True
+        self.poller.close()
+        self.router.shutdown(wait=wait, timeout=timeout)
+        for h in self.router.slices().values():
+            proc = h.proc
+            if proc is None:
+                continue
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 10.0
+        for h in self.router.slices().values():
+            proc = h.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            if proc.stdout is not None:
+                proc.stdout.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=False)

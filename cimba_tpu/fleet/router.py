@@ -1,0 +1,844 @@
+"""The fleet front door: one ``submit()`` surface over many slices.
+
+Cimba's level-1 concurrency (trials fanned over worker threads pulling
+a shared atomic counter) maps at fleet scale to dispatcher *processes*,
+not threads: each slice process owns one device-owner
+:class:`~cimba_tpu.serve.service.Service` and the router is the
+placement/liveness/failover layer above them (docs/20_fleet.md).  It
+keeps the single-process serving surface — ``submit(Request)`` returns
+a future with ``result()``/``digest()`` — so ``serve.run_load`` and
+every client written against :class:`~cimba_tpu.serve.Service` drives
+a fleet unchanged.
+
+Placement policy (deterministic — the decisions are a pure function of
+the request stream, the completion order, and the scraped state, with
+every tie broken by host-side fmix64 over request ids, the PR 7
+``round_seed`` idiom):
+
+* **co-location by compatibility class** — requests are classed by the
+  SAME :func:`~cimba_tpu.serve.service.request_class_key` the
+  in-process dispatcher packs by, and a class sticks to the slices
+  already serving it while they have window headroom, so slices keep
+  packing heterogeneous waves instead of every class being sprayed
+  thinly across the fleet;
+* **least-loaded spill** — when the bound slices are full (or a class
+  is new), the request goes to the live slice with the lowest load
+  (router-tracked outstanding + the queue depth last scraped from the
+  slice's ``/metrics``), growing the class's slice set;
+* **bounded in-flight windows** — at most ``window`` requests are in
+  flight per slice (the slice's own admission queue backpressures
+  behind that).
+
+Failover is the ``serve/sched.py`` solo-retry pattern lifted one
+level: any transport failure (connection refused/reset, response
+timeout, a dropped frame) — or the health poller marking the slice
+down — requeues the request with the slice id appended to its
+``excluded`` set, so the retry lands elsewhere; a request that runs
+out of live candidates waits for the manager's replacement slice
+rather than failing early.  Results carry their PR 9 digest end to
+end: the slice computes ``stream_result_digest`` before the bytes
+leave the process, the router recomputes it from the bytes that
+arrived, and a mismatch is treated as a transport fault (requeue),
+never delivered.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from cimba_tpu.fleet import wire
+from cimba_tpu.serve.sched import Cancelled, ServeError, ServiceClosed
+from cimba_tpu.sweep.adaptive import _GOLDEN, _fmix64
+
+__all__ = [
+    "FleetRouter", "FleetHandle", "SliceHandle",
+    "FleetError", "FleetRequeuesExhausted", "FleetRemoteError",
+]
+
+#: remote error types the router reconstructs as their local classes
+#: (permanent — the slice judged the REQUEST, not the transport).
+#: ``QueueFull``/``ServiceClosed`` are deliberately NOT here: they
+#: judge the SLICE's state at one instant (saturated admission queue,
+#: shutting down), so the request requeues toward another slice
+#: instead of failing while idle slices sit by.
+_PERMANENT_REMOTE = (
+    "DeadlineExceeded", "Cancelled", "RetriesExhausted",
+    "ValueError", "TypeError",
+)
+
+
+class FleetError(ServeError):
+    """Base class of fleet-level structured errors."""
+
+
+class FleetRequeuesExhausted(FleetError):
+    """A request kept landing on failing slices past the requeue
+    budget; the last transport reason is in the message."""
+
+    def __init__(self, attempts: int, label: Optional[str],
+                 reason: str):
+        self.attempts = attempts
+        self.label = label
+        self.reason = reason
+        super().__init__(
+            f"request {label!r} requeued {attempts} time(s) without "
+            f"completing (last: {reason})"
+        )
+
+
+class FleetRemoteError(FleetError):
+    """The slice failed the request with a structured serving error the
+    router relays (type name + message preserved)."""
+
+    def __init__(self, type_name: str, message: str,
+                 label: Optional[str] = None):
+        self.type_name = type_name
+        self.label = label
+        super().__init__(f"{type_name}: {message}")
+
+
+class SliceHandle:
+    """One slice process as the router sees it: wire address, health
+    URL, and the router-managed placement state.  Mutable state is
+    owned by the router and guarded by the ROUTER's lock (the handle is
+    a record, not an actor)."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 health_url: str, *, proc=None, pid: Optional[int] = None):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.health_url = health_url.rstrip("/")
+        self.proc = proc
+        self.pid = pid
+        # router-managed (under the router lock)
+        self.up = True
+        self.down_reason: Optional[str] = None
+        self.down_t: Optional[float] = None
+        self.outstanding = 0          # assigned, not yet released
+        self.placed_total = 0
+        self.queue: List["_FleetEntry"] = []   # assigned, not yet sent
+        self.inflight: set = set()             # being wire-called
+        self.scraped: Dict[str, Any] = {}      # health poller's view
+        self.last_scrape_t: Optional[float] = None
+
+    def __repr__(self):
+        state = "up" if self.up else f"down({self.down_reason})"
+        return (
+            f"SliceHandle({self.name!r}, {self.host}:{self.port}, "
+            f"{state}, outstanding={self.outstanding})"
+        )
+
+
+class _FleetEntry:
+    """Router-internal per-request state."""
+
+    __slots__ = (
+        "request", "seq", "label", "cls", "model", "excluded",
+        "attempts", "assigned", "submit_t", "done", "result", "exc",
+        "remote_digest", "n_waves",
+    )
+
+    def __init__(self, request, seq: int, cls, model: str):
+        self.request = request
+        self.seq = seq
+        self.label = request.label
+        self.cls = cls
+        self.model = model
+        self.excluded: set = set()   # slice ids this request must avoid
+        self.attempts = 0
+        self.assigned: Optional[str] = None
+        self.submit_t = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[Exception] = None
+        self.remote_digest: Optional[str] = None
+        self.n_waves = 0
+
+
+class FleetHandle:
+    """The future :meth:`FleetRouter.submit` returns — the
+    :class:`~cimba_tpu.serve.service.ResultHandle` surface."""
+
+    def __init__(self, router: "FleetRouter", entry: _FleetEntry):
+        self._router = router
+        self._entry = entry
+
+    @property
+    def label(self) -> Optional[str]:
+        return self._entry.label
+
+    def done(self) -> bool:
+        return self._entry.done.is_set()
+
+    def cancel(self) -> bool:
+        return self._router._cancel(self._entry)
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._entry.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self._entry.label or self._entry.seq} not "
+                f"done within {timeout}s"
+            )
+        return self._entry.exc
+
+    def result(self, timeout: Optional[float] = None):
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._entry.result
+
+    def digest(self, timeout: Optional[float] = None) -> str:
+        """The result's bitwise digest — verified end to end: the slice
+        computed it before serialization, the router recomputed it from
+        the received bytes, and the two matched (docs/20_fleet.md)."""
+        self.result(timeout)
+        return self._entry.remote_digest
+
+
+class FleetRouter:
+    """Front-door router over a set of slice processes (usually built
+    and wired by :class:`~cimba_tpu.fleet.manager.FleetManager`).
+
+    ``models`` maps model names to the SPEC OBJECTS clients put in
+    their Requests — the router resolves ``request.spec`` to a wire
+    model name by structural fingerprint, so ``dataclasses.replace``
+    twins of a registered spec route too.  ``window`` bounds per-slice
+    in-flight requests; ``place_seed`` seeds the deterministic
+    tie-break; ``max_requeues`` bounds how often one request may be
+    requeued across failing slices before failing loudly."""
+
+    # cimba-check: must-hold(_lock) _slices, _pending, _outstanding, _counters, _decisions, _class_map, _seq, _closed, _stop
+
+    def __init__(
+        self,
+        *,
+        models: Dict[str, Any],
+        window: int = 4,
+        place_seed: int = 0,
+        max_requeues: int = 8,
+        request_timeout: Optional[float] = 600.0,
+        connect_timeout: float = 5.0,
+        horizon_bucket: Optional[float] = 16.0,
+        decision_cap: int = 65536,
+        name: str = "cimba-fleet",
+    ):
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        from cimba_tpu.serve import cache as _pcache
+
+        self.name = name
+        self.window = int(window)
+        self.place_seed = int(place_seed)
+        self.max_requeues = int(max_requeues)
+        self.request_timeout = request_timeout
+        self.connect_timeout = connect_timeout
+        self.horizon_bucket = horizon_bucket
+        self.models = dict(models)
+        self._fp_to_model = {
+            _pcache.spec_fingerprint(spec): mname
+            for mname, spec in self.models.items()
+        }
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._slices: "Dict[str, SliceHandle]" = {}
+        self._pending: List[Tuple[Tuple[int, int], _FleetEntry]] = []
+        self._outstanding = 0
+        self._seq = 0
+        self._closed = False
+        self._stop = False
+        # bounded: a week-long fleet must not leak its decision history
+        # (the determinism pin compares windows far smaller than this)
+        self._decisions: deque = deque(maxlen=int(decision_cap))
+        self._counters = {
+            "submitted": 0, "placed": 0, "requeues": 0,
+            "completed": 0, "failed": 0, "cancelled": 0,
+            "wire_errors": 0, "wire_digest_mismatches": 0,
+            "expect_digest_mismatches": 0, "stale_results": 0,
+        }
+        self._class_map: Dict[tuple, List[str]] = {}
+        self._threads: List[threading.Thread] = []
+        self._placer = threading.Thread(
+            target=self._place_loop, name=f"{name}-placer", daemon=True
+        )
+        self._placer.start()
+
+    # -- topology ------------------------------------------------------------
+
+    def add_slice(self, handle: SliceHandle) -> None:
+        """Register a (live) slice and start its sender threads — one
+        per window slot, so at most ``window`` wire calls are in flight
+        per slice."""
+        with self._lock:
+            if handle.name in self._slices:
+                raise ValueError(
+                    f"slice {handle.name!r} already registered"
+                )
+            self._slices[handle.name] = handle
+            self._cv.notify_all()
+        # prune finished sender threads (dead slices' senders exit):
+        # a long churn of kills/respawns must not grow this unbounded
+        self._threads = [t for t in self._threads if t.is_alive()]
+        for i in range(self.window):
+            t = threading.Thread(
+                target=self._send_loop, args=(handle,),
+                name=f"{self.name}-{handle.name}-s{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def slices(self) -> Dict[str, SliceHandle]:
+        with self._lock:
+            return dict(self._slices)
+
+    def mark_down(self, name: str, reason: str) -> int:
+        """Declare a slice dead (health poller or manager): its queued
+        and in-flight requests requeue onto live slices with the slice
+        id appended to their ``excluded`` set.  Idempotent; returns the
+        number of requests requeued."""
+        with self._lock:
+            h = self._slices.get(name)
+            if h is None or not h.up:
+                return 0
+            h.up = False
+            h.down_reason = reason
+            h.down_t = time.monotonic()
+            victims = list(h.queue) + list(h.inflight)
+            h.queue.clear()
+            n = 0
+            for e in victims:
+                if self._requeue_locked(e, h, f"slice down: {reason}"):
+                    n += 1
+            self._cv.notify_all()
+            return n
+
+    def remove_slice(self, name: str) -> bool:
+        """Forget a DOWN slice entirely (the manager calls this after
+        reaping a corpse it replaced): a week of kill/respawn churn
+        must not accumulate dead handles in every placement scan.  A
+        slice still up is marked down first (its work requeues).
+        Returns True when something was removed."""
+        self.mark_down(name, "removed")
+        with self._lock:
+            h = self._slices.pop(name, None)
+            # prune the name from every class's bound-slice list too —
+            # kill/respawn churn must not grow the sticky sets (or the
+            # `in bound` scan) without bound
+            for names in self._class_map.values():
+                if name in names:
+                    names.remove(name)
+            self._cv.notify_all()   # its sender threads wake and exit
+        return h is not None
+
+    def update_scrape(self, name: str, scraped: Dict[str, Any]) -> None:
+        """The health poller's feed: the latest scraped view of one
+        slice (queue depth, verdict, store counters) — read by the
+        least-loaded placement."""
+        with self._lock:
+            h = self._slices.get(name)
+            if h is not None:
+                h.scraped = dict(scraped)
+                h.last_scrape_t = time.monotonic()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, request, *, block: bool = True,
+               timeout: Optional[float] = None) -> FleetHandle:
+        """Admit a request and return its future.  ``block``/
+        ``timeout`` are accepted for :class:`~cimba_tpu.serve.Service`
+        surface compatibility; the router's pending set is unbounded
+        (each SLICE's admission queue is the bounded one — placement
+        stops feeding a slice past its window)."""
+        from cimba_tpu.obs import metrics as _metrics
+        from cimba_tpu.serve import cache as _pcache
+        from cimba_tpu.serve.service import request_class_key
+
+        R = int(request.n_replications)
+        if R <= 0:
+            raise ValueError(f"n_replications must be positive, got {R}")
+        fp = _pcache.spec_fingerprint(request.spec)
+        model = self._fp_to_model.get(fp)
+        if model is None:
+            raise ValueError(
+                "request.spec is not in this fleet's model registry "
+                f"({sorted(self.models)}) — fleets serve registered "
+                "models; build Requests from FleetManager.spec(name)"
+            )
+        if request.summary_path is not None:
+            from cimba_tpu.runner import experiment as ex
+
+            if request.summary_path is not ex.default_summary_path:
+                raise ValueError(
+                    "fleet requests cannot carry a custom summary_path "
+                    "— functions don't cross the process boundary; "
+                    "slices fold the model's default summary "
+                    "(docs/20_fleet.md)"
+                )
+        with_metrics = _metrics.enabled()
+        if with_metrics:
+            # loud, like the summary_path check: the wire result format
+            # carries no pooled metrics registry, and silently returning
+            # metrics=None where serve.Service returns a registry (or
+            # spuriously mismatching a metrics-on expect_digest) would
+            # be a silent downgrade, not a feature
+            raise ValueError(
+                "fleet requests cannot run with the obs.metrics "
+                "registry enabled — pooled metrics do not cross the "
+                "wire yet (docs/20_fleet.md); disable obs.metrics or "
+                "serve in-process"
+            )
+        cls = request_class_key(
+            request, with_metrics, mesh=None,
+            horizon_bucket=self.horizon_bucket,
+        )
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed(
+                    "fleet router is shut down — no new requests"
+                )
+            self._seq += 1
+            entry = _FleetEntry(request, self._seq, cls, model)
+            self._outstanding += 1
+            self._counters["submitted"] += 1
+            heapq.heappush(
+                self._pending,
+                ((-request.priority, entry.seq), entry),
+            )
+            self._cv.notify_all()
+        return FleetHandle(self, entry)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every admitted request completed; False on
+        timeout."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._lock:
+            while self._outstanding > 0:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining if remaining is not None else 0.5)
+            return True
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop admitting; ``wait=True`` drains, ``wait=False`` cancels
+        everything not yet completed.  Idempotent."""
+        with self._lock:
+            self._closed = True
+        if wait:
+            self.drain(timeout)
+        with self._lock:
+            self._stop = True
+            if not wait:
+                victims = [e for _, e in self._pending]
+                for h in self._slices.values():
+                    victims += list(h.queue) + list(h.inflight)
+                self._pending.clear()
+                for e in victims:
+                    if not e.done.is_set():
+                        if e.assigned is not None:
+                            self._release_locked(e, e.assigned)
+                        self._finish_locked(
+                            e, exc=Cancelled(e.label),
+                            outcome="cancelled",
+                        )
+            self._cv.notify_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=True)
+
+    # -- observability -------------------------------------------------------
+
+    def decision_log(self) -> List[tuple]:
+        """Placement/requeue decisions in order (the most recent
+        ``decision_cap``): ``("place", seq, slice)`` /
+        ``("requeue", seq, slice)`` — the determinism pin's subject
+        (same request stream + same chaos seed -> identical log;
+        tests/test_fleet.py)."""
+        with self._lock:
+            return list(self._decisions)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["pending"] = len(self._pending)
+            out["outstanding"] = self._outstanding
+            out["slices"] = {
+                h.name: {
+                    "up": h.up,
+                    "down_reason": h.down_reason,
+                    "outstanding": h.outstanding,
+                    "placed_total": h.placed_total,
+                    "scraped": dict(h.scraped),
+                }
+                for h in self._slices.values()
+            }
+            out["classes_seen"] = len(self._class_map)
+        return out
+
+    def slice_stats(self, name: str,
+                    timeout: float = 10.0) -> dict:
+        """One slice's live ``Service.stats()`` over the wire (the
+        ``stats`` op) — how a test or operator reads a replacement
+        slice's store hit/fallback counters without scraping."""
+        with self._lock:
+            h = self._slices.get(name)
+            if h is None:
+                raise KeyError(f"unknown slice {name!r}")
+            host, port = h.host, h.port
+        header, _ = wire.call(
+            host, port, {"op": "stats"}, timeout=timeout,
+            connect_timeout=self.connect_timeout,
+        )
+        if not header.get("ok"):
+            raise FleetRemoteError(
+                header.get("error", "Error"),
+                header.get("message", "stats failed"),
+            )
+        return header["stats"]
+
+    # -- internals -----------------------------------------------------------
+
+    def _cancel(self, entry: _FleetEntry) -> bool:
+        with self._lock:
+            if entry.done.is_set() or entry.assigned is not None:
+                return False
+            # remove from pending lazily: the placer drops tombstones
+            self._finish_locked(
+                entry, exc=Cancelled(entry.label), outcome="cancelled"
+            )
+            return True
+
+    # cimba-check: assume-held
+    def _finish_locked(self, entry: _FleetEntry, *, result=None,
+                       exc=None, outcome: str) -> None:
+        if entry.done.is_set():
+            return
+        entry.result = result
+        entry.exc = exc
+        self._counters[outcome] += 1
+        self._outstanding -= 1
+        entry.done.set()
+        self._cv.notify_all()
+
+    # cimba-check: assume-held
+    def _release_locked(self, entry: _FleetEntry,
+                        slice_name: str) -> bool:
+        """Release ``entry``'s assignment to ``slice_name`` — exactly
+        one of the racing paths (sender completion, sender error,
+        mark_down's sweep) wins; the rest see a changed assignment and
+        stand down, so outstanding is decremented once and a request is
+        never requeued twice for one failure."""
+        if entry.assigned != slice_name:
+            return False
+        entry.assigned = None
+        h = self._slices.get(slice_name)
+        if h is not None:
+            h.outstanding -= 1
+            h.inflight.discard(entry)
+            if entry in h.queue:
+                h.queue.remove(entry)
+        return True
+
+    # cimba-check: assume-held
+    def _requeue_locked(self, entry: _FleetEntry, h: SliceHandle,
+                        reason: str) -> bool:
+        if entry.done.is_set():
+            return False
+        if not self._release_locked(entry, h.name):
+            return False
+        entry.excluded.add(h.name)
+        entry.attempts += 1
+        self._counters["requeues"] += 1
+        self._decisions.append(("requeue", entry.seq, h.name))
+        if entry.attempts > self.max_requeues:
+            self._finish_locked(
+                entry,
+                exc=FleetRequeuesExhausted(
+                    entry.attempts, entry.label, reason
+                ),
+                outcome="failed",
+            )
+            return True
+        heapq.heappush(
+            self._pending,
+            ((-entry.request.priority, entry.seq), entry),
+        )
+        self._cv.notify_all()
+        return True
+
+    # cimba-check: assume-held
+    def _load_locked(self, h: SliceHandle) -> float:
+        """A slice's placement load: what the router itself has
+        outstanding there plus the queue depth last scraped from the
+        slice's ``/metrics`` (a slice busy with somebody else's
+        traffic — or its own backlog — reads loaded even when this
+        router hasn't placed there)."""
+        return h.outstanding + float(h.scraped.get("queue_depth", 0))
+
+    # cimba-check: assume-held
+    def _choose_locked(self, entry: _FleetEntry) -> Optional[SliceHandle]:
+        cands = [
+            h for h in self._slices.values()
+            if h.up and h.name not in entry.excluded
+            and h.outstanding < self.window
+        ]
+        if not cands and not any(
+            h.up and h.name not in entry.excluded
+            for h in self._slices.values()
+        ):
+            # retry of last resort: when every LIVE slice is excluded
+            # (trivially a 1-slice fleet after one transient wire
+            # error), re-admit live slices rather than parking a
+            # healthy request forever — exclusion exists to steer away
+            # from dead/suspect slices, and max_requeues still bounds
+            # a genuinely poisoned loop.  Guarded on "no non-excluded
+            # live slice EXISTS" (not merely "none has headroom"): a
+            # busy healthy slice is worth waiting for, and falling back
+            # while a freshly-killed peer is still nominally up would
+            # burn the whole requeue budget on instant
+            # connection-refused bounces before the poller flips it.
+            # Deterministic: a pure function of (entry, topology).
+            cands = [
+                h for h in self._slices.values()
+                if h.up and h.outstanding < self.window
+            ]
+        if not cands:
+            return None
+        bound = self._class_map.get(entry.cls)
+        if bound:
+            stuck = [h for h in cands if h.name in bound]
+            if stuck:
+                cands = stuck
+        lo = min(self._load_locked(h) for h in cands)
+        best = [h for h in cands if self._load_locked(h) == lo]
+        # deterministic tie-break: fmix64 over the request id (the
+        # PR 7 round_seed idiom) — NOT arrival order of a dict
+        idx = _fmix64(
+            (self.place_seed + _GOLDEN * (entry.seq + 1))
+            & ((1 << 64) - 1)
+        ) % len(best)
+        pick = best[idx]
+        names = self._class_map.setdefault(entry.cls, [])
+        if pick.name not in names:
+            names.append(pick.name)
+        return pick
+
+    def _place_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                placed = False
+                kept: List[Tuple[Tuple[int, int], _FleetEntry]] = []
+                # scan in priority order; the first placeable entry
+                # wins (later entries may be placeable when the head is
+                # excluded everywhere — no head-of-line block)
+                while self._pending:
+                    key, entry = heapq.heappop(self._pending)
+                    if entry.done.is_set():
+                        continue            # cancelled tombstone
+                    pick = self._choose_locked(entry)
+                    if pick is None:
+                        kept.append((key, entry))
+                        continue
+                    entry.assigned = pick.name
+                    pick.outstanding += 1
+                    pick.placed_total += 1
+                    pick.queue.append(entry)
+                    self._counters["placed"] += 1
+                    self._decisions.append(
+                        ("place", entry.seq, pick.name)
+                    )
+                    placed = True
+                for item in kept:
+                    heapq.heappush(self._pending, item)
+                if placed:
+                    self._cv.notify_all()
+                    continue
+                self._cv.wait(0.1)
+
+    def _send_loop(self, h: SliceHandle) -> None:
+        while True:
+            with self._lock:
+                while h.up and not h.queue and not self._stop:
+                    self._cv.wait(0.1)
+                if self._stop or not h.up:
+                    return
+                entry = h.queue.pop(0)
+                if entry.done.is_set() or entry.assigned != h.name:
+                    continue
+                h.inflight.add(entry)
+                attempt = entry.attempts
+            try:
+                self._call_slice(h, entry, attempt)
+            except Exception as e:
+                # belt: a sender thread must NEVER die holding a claim
+                # — a stranded in-flight entry blocks its client and
+                # leaks a window slot forever.  Requeue and keep going.
+                with self._lock:
+                    self._counters["wire_errors"] += 1
+                    self._requeue_locked(
+                        entry, h, f"sender error: {e!r}"
+                    )
+
+    def _call_slice(self, h: SliceHandle, entry: _FleetEntry,
+                    attempt: int) -> None:
+        req = entry.request
+        deadline = req.deadline
+        if deadline is not None:
+            # Service semantics preserved: the deadline is relative to
+            # the ROUTER submit, so each attempt forwards the REMAINING
+            # budget — re-sending the full value would silently restart
+            # the clock on every requeue
+            waited = time.monotonic() - entry.submit_t
+            remaining = deadline - waited
+            if remaining <= 0:
+                from cimba_tpu.serve.sched import DeadlineExceeded
+
+                with self._lock:
+                    if self._release_locked(entry, h.name):
+                        self._finish_locked(
+                            entry,
+                            exc=DeadlineExceeded(
+                                deadline, waited, entry.label
+                            ),
+                            outcome="failed",
+                        )
+                return
+            deadline = remaining
+        params_node, blobs_out = wire.encode_tree(req.params)
+        header = {
+            "op": "run",
+            "req_id": entry.seq,
+            "attempt": attempt,
+            "model": entry.model,
+            "params": params_node,
+            "n_replications": int(req.n_replications),
+            "seed": int(req.seed),
+            "t_end": req.t_end,
+            "chunk_steps": int(req.chunk_steps),
+            "wave_size": (
+                None if req.wave_size is None else int(req.wave_size)
+            ),
+            "priority": int(req.priority),
+            "deadline": deadline,
+            "label": req.label,
+        }
+        try:
+            resp, blobs_in = wire.call(
+                h.host, h.port, header, tuple(blobs_out),
+                timeout=self.request_timeout,
+                connect_timeout=self.connect_timeout,
+            )
+        except (OSError, wire.WireError) as e:
+            reason = f"{type(e).__name__}: {e}"
+            if isinstance(e, ConnectionRefusedError):
+                # passive failure detection: refused means NOTHING is
+                # listening — the process is gone.  Marking down now
+                # (instead of waiting for the next scrape) requeues
+                # everything assigned here and keeps the last-resort
+                # fallback from bouncing off the corpse at
+                # connection-refused speed until the budget is gone.
+                # The health poller notices router-marked downs and
+                # still drives the respawn.
+                self.mark_down(h.name, reason)
+            with self._lock:
+                self._counters["wire_errors"] += 1
+                # no-op if mark_down already requeued this entry
+                self._requeue_locked(entry, h, reason)
+            return
+        if resp.get("ok"):
+            self._deliver(h, entry, resp, blobs_in)
+            return
+        # structured remote failure: the slice judged the REQUEST
+        type_name = resp.get("error", "Error")
+        message = resp.get("message", "")
+        if type_name in _PERMANENT_REMOTE:
+            exc = self._remote_exc(type_name, message, resp, entry)
+            with self._lock:
+                if self._release_locked(entry, h.name):
+                    self._finish_locked(entry, exc=exc, outcome="failed")
+        else:
+            # an unclassified slice-side crash: treat like a slice
+            # fault — requeue elsewhere, bounded by max_requeues
+            with self._lock:
+                self._requeue_locked(
+                    entry, h, f"remote {type_name}: {message}"
+                )
+
+    def _remote_exc(self, type_name: str, message: str, resp: dict,
+                    entry: _FleetEntry) -> Exception:
+        if type_name == "DeadlineExceeded":
+            from cimba_tpu.serve.sched import DeadlineExceeded
+
+            args = resp.get("args") or {}
+            return DeadlineExceeded(
+                args.get("deadline_s", entry.request.deadline or 0.0),
+                args.get("waited_s", 0.0),
+                entry.label,
+            )
+        return FleetRemoteError(type_name, message, entry.label)
+
+    def _deliver(self, h: SliceHandle, entry: _FleetEntry, resp: dict,
+                 blobs: List[bytes]) -> None:
+        from cimba_tpu.obs import audit as _audit
+        from cimba_tpu.runner.experiment import StreamResult
+
+        try:
+            tree = wire.decode_tree(resp["result"], blobs)
+            result = StreamResult(
+                summary=tree["summary"],
+                n_failed=tree["n_failed"],
+                total_events=tree["total_events"],
+                n_waves=int(resp.get("n_waves", 0)),
+                n_regrows=int(resp.get("n_regrows", 0)),
+                metrics=None,
+            )
+            local_digest = _audit.stream_result_digest(result)
+        except Exception as e:
+            with self._lock:
+                self._counters["wire_errors"] += 1
+                self._requeue_locked(
+                    entry, h, f"undecodable result: {e!r}"
+                )
+            return
+        claimed = resp.get("digest")
+        if claimed != local_digest:
+            # the end-to-end integrity check (docs/18_audit.md lifted
+            # to the wire): the bytes that arrived are not the bytes
+            # the slice digested — a transport fault, never delivered
+            with self._lock:
+                self._counters["wire_digest_mismatches"] += 1
+                self._requeue_locked(
+                    entry, h,
+                    f"wire digest mismatch ({claimed} != "
+                    f"{local_digest})",
+                )
+            return
+        expect = entry.request.expect_digest
+        with self._lock:
+            if not self._release_locked(entry, h.name):
+                # a twin run already delivered (the slice was marked
+                # down mid-call and the requeue won): identical bytes
+                # either way — count, don't double-deliver
+                self._counters["stale_results"] += 1
+                return
+            if expect is not None and expect != local_digest:
+                self._counters["expect_digest_mismatches"] += 1
+            entry.remote_digest = local_digest
+            self._finish_locked(
+                entry, result=result, outcome="completed"
+            )

@@ -1,0 +1,384 @@
+"""One slice of the fleet: a ``serve.Service`` process behind the wire.
+
+The slice worker entrypoint (``python -m cimba_tpu.fleet.slice``) runs
+exactly one device-owner :class:`~cimba_tpu.serve.service.Service`
+plus one :class:`~cimba_tpu.obs.telemetry.Telemetry` plane with its
+``/healthz`` + ``/metrics`` exposition endpoint, and serves requests
+over the stdlib loopback wire protocol (:mod:`cimba_tpu.fleet.wire`).
+At startup it
+
+1. builds its model registry from ``--models`` (a JSON map of name ->
+   ``{"fn": "module:callable", "kwargs": {...}}`` — specs are built
+   in-process; function objects never cross the wire),
+2. hydrates the shared program cache from the ``CIMBA_PROGRAM_STORE``
+   manifest when the env knob names one (``serve.warm(manifest=...)``
+   per model — the PR 6 zero-cold-start path, so a REPLACEMENT slice
+   serves its first request warm, sub-second after ready, with
+   ``fallback_shapes == 0``; a store miss logs and degrades to
+   compile-on-first-request, never blocks startup),
+3. prints ONE ready line to stdout — ``{"name", "pid", "port",
+   "health_port", "url"}`` — which is the manager's spawn contract,
+
+then serves forever.  Responses carry the result's PR 9
+``stream_result_digest`` computed BEFORE serialization, so the router
+can verify the bytes end to end.  ``CIMBA_FLEET_CHAOS``
+(:mod:`cimba_tpu.fleet.chaos`) injects deterministic faults: dropped
+first-attempt responses, self-SIGKILL after N requests, stalled
+scrapes.
+
+The wire ops:
+
+* ``run`` — submit one experiment request to the Service, wait, reply
+  with the encoded ``StreamResult`` + digest (or a structured error);
+* ``stats`` — the Service's live ``stats()`` snapshot (JSON-safe);
+* ``ping`` — liveness + identity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from cimba_tpu.fleet import chaos as _chaos
+from cimba_tpu.fleet import wire
+
+__all__ = ["load_models", "main"]
+
+
+def load_models(models: Any) -> Dict[str, Any]:
+    """Resolve a model registry — ``{name: {"fn": "module:callable",
+    "kwargs": {...}}}`` (or a JSON string of it) — into ``{name:
+    spec}``.  A builder returning a tuple contributes its first element
+    (the ``mm1.build() -> (spec, refs)`` convention).  Shared by the
+    slice entrypoint and the manager, so the parent-side specs the
+    router registers are built by exactly the code the slices run."""
+    if isinstance(models, str):
+        models = json.loads(models)
+    out: Dict[str, Any] = {}
+    for name, rec in models.items():
+        if isinstance(rec, str):
+            rec = {"fn": rec}
+        target = rec["fn"]
+        mod_name, _, attr = target.partition(":")
+        if not attr:
+            raise ValueError(
+                f"model {name!r}: builder {target!r} must be "
+                "'module:callable'"
+            )
+        fn = getattr(importlib.import_module(mod_name), attr)
+        built = fn(**(rec.get("kwargs") or {}))
+        out[name] = built[0] if isinstance(built, tuple) else built
+    return out
+
+
+def _error_header(e: Exception) -> dict:
+    h = {
+        "ok": False,
+        "error": type(e).__name__,
+        "message": str(e),
+    }
+    args = {}
+    for k in ("deadline_s", "waited_s", "attempts", "capacity"):
+        v = getattr(e, k, None)
+        if v is not None:
+            args[k] = v
+    if args:
+        h["args"] = args
+    return h
+
+
+class _SliceServer:
+    """The slice's wire server + service wiring (instantiable in-process
+    for tests; the CLI main() drives one)."""
+
+    def __init__(
+        self,
+        *,
+        name: str,
+        models: Dict[str, Any],
+        max_wave: int,
+        max_pending: int,
+        port: int = 0,
+        health_port: int = 0,
+        warm_chunk_steps: Optional[int] = None,
+        horizon_bucket: Optional[float] = 16.0,
+        telemetry_interval: float = 0.1,
+    ):
+        from cimba_tpu import config as _config
+        from cimba_tpu import serve
+        from cimba_tpu.obs import expose as _expose
+        from cimba_tpu.obs import telemetry as _tm
+
+        self.name = name
+        self.models = models
+        self.chaos = _chaos.parse()
+        self._chaos_salt = _chaos.slice_salt(name)
+        self._served = 0
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+        self.cache = serve.ProgramCache()
+        self.warm_report: Dict[str, str] = {}
+        store_root = _config.env_raw("CIMBA_PROGRAM_STORE").strip()
+        if store_root:
+            for mname, spec in models.items():
+                try:
+                    serve.warm(
+                        self.cache, spec, None, 0, manifest=store_root,
+                        **(
+                            {}
+                            if warm_chunk_steps is None
+                            else {"chunk_steps": int(warm_chunk_steps)}
+                        ),
+                    )
+                    self.warm_report[mname] = "hydrated"
+                except LookupError as e:
+                    # cold start is a degraded mode, not a startup
+                    # failure: the store may simply not cover this
+                    # model yet — get_programs still second-chances it
+                    self.warm_report[mname] = f"miss: {e}"
+                    print(
+                        f"[{name}] store warm miss for {mname}: {e}",
+                        file=sys.stderr, flush=True,
+                    )
+
+        self.telemetry = _tm.Telemetry(interval=telemetry_interval)
+        self.exposition = _expose.start(
+            self.telemetry, port=health_port,
+            delay_s=self.chaos.scrape_delay_ms / 1000.0,
+        )
+        self.service = serve.Service(
+            max_wave=max_wave, max_pending=max_pending,
+            cache=self.cache, telemetry=self.telemetry, name=name,
+            horizon_bucket=horizon_bucket,
+        )
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    header, blobs = wire.recv_frame(self.request)
+                except (OSError, wire.WireError):
+                    return      # half-open probe / peer gave up
+                try:
+                    outer._dispatch(self.request, header, blobs)
+                except (OSError, wire.WireError):
+                    pass        # peer hung up mid-reply; requeue is
+                    #             the ROUTER's job, nothing to do here
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name=f"{name}-wire",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- ops -----------------------------------------------------------------
+
+    def _dispatch(self, sock, header: dict, blobs) -> None:
+        op = header.get("op")
+        if op == "ping":
+            wire.send_frame(sock, {
+                "ok": True, "name": self.name, "pid": os.getpid(),
+            })
+        elif op == "stats":
+            stats = json.loads(
+                json.dumps(self.service.stats(), default=str)
+            )
+            wire.send_frame(sock, {"ok": True, "stats": stats})
+        elif op == "run":
+            self._run(sock, header, blobs)
+        else:
+            wire.send_frame(sock, {
+                "ok": False, "error": "WireError",
+                "message": f"unknown op {op!r}",
+            })
+
+    def _run(self, sock, header: dict, blobs) -> None:
+        from cimba_tpu import serve
+        from cimba_tpu.obs import audit as _audit
+
+        if _chaos.should_drop(
+            self.chaos, self._chaos_salt,
+            int(header.get("req_id", 0)),
+            int(header.get("attempt", 0)),
+        ):
+            # fault injection: the response is "lost" — close without
+            # replying; the router requeues onto another slice
+            with self._lock:
+                self._dropped += 1
+            print(
+                f"[{self.name}] chaos drop req {header.get('req_id')}",
+                file=sys.stderr, flush=True,
+            )
+            return
+        model = header.get("model")
+        spec = self.models.get(model)
+        if spec is None:
+            wire.send_frame(sock, {
+                "ok": False, "error": "ValueError",
+                "message": f"unknown model {model!r} (this slice "
+                           f"serves {sorted(self.models)})",
+            })
+            return
+        try:
+            params = wire.decode_tree(header["params"], blobs)
+            request = serve.Request(
+                spec,
+                params,
+                int(header["n_replications"]),
+                seed=int(header.get("seed", 0)),
+                t_end=header.get("t_end"),
+                chunk_steps=int(header.get("chunk_steps", 1024)),
+                wave_size=header.get("wave_size"),
+                priority=int(header.get("priority", 0)),
+                deadline=header.get("deadline"),
+                label=header.get("label"),
+            )
+            handle = self.service.submit(request)
+            result = handle.result()
+            digest = handle.digest()
+        except Exception as e:
+            wire.send_frame(sock, _error_header(e))
+            return
+        node, out_blobs = wire.encode_tree({
+            "summary": result.summary,
+            "n_failed": result.n_failed,
+            "total_events": result.total_events,
+        })
+        wire.send_frame(sock, {
+            "ok": True,
+            "req_id": header.get("req_id"),
+            "digest": digest,
+            "n_waves": int(result.n_waves),
+            "n_regrows": int(result.n_regrows),
+            "result": node,
+        }, tuple(out_blobs))
+        kill = False
+        with self._lock:
+            self._served += 1
+            if self.chaos.kill and self._served >= self.chaos.kill:
+                kill = True
+        if kill:
+            # chaos hard-death: the response above made it out, the
+            # PROCESS does not survive it — in-flight peers see resets,
+            # the health scrape goes unreachable, the manager respawns
+            print(
+                f"[{self.name}] chaos kill -9 after {self._served} "
+                "requests", file=sys.stderr, flush=True,
+            )
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def ready_line(self) -> dict:
+        return {
+            "name": self.name,
+            "pid": os.getpid(),
+            "port": self.port,
+            "health_port": self.exposition.port,
+            "url": self.exposition.url,
+            "warm": self.warm_report,
+            "chaos": self.chaos.active,
+        }
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.service.shutdown(wait=False)
+        self.exposition.close()
+        self.telemetry.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cimba fleet slice worker: one serve.Service + "
+        "telemetry endpoint per process, requests over the loopback "
+        "wire protocol (docs/20_fleet.md)",
+    )
+    ap.add_argument("--name", default=f"slice-{os.getpid()}")
+    ap.add_argument(
+        "--models", required=True,
+        help='JSON: {"mm1": {"fn": "cimba_tpu.models.mm1:build", '
+             '"kwargs": {"record": false}}}',
+    )
+    ap.add_argument("--port", type=int, default=0,
+                    help="wire port (0 = ephemeral, reported on stdout)")
+    ap.add_argument("--health-port", type=int, default=0,
+                    help="/healthz + /metrics port (0 = ephemeral)")
+    ap.add_argument("--max-wave", type=int, default=4096)
+    ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument(
+        "--warm-chunk-steps", type=int, default=None,
+        help="chunk_steps of the CIMBA_PROGRAM_STORE entry to hydrate "
+        "at startup (must match what requests will carry)",
+    )
+    ap.add_argument(
+        "--horizon-bucket", default="16.0",
+        help="the Service's horizon-bucket ratio ('none' = pack all "
+        "finite horizons together) — the manager forwards the "
+        "router's value so the co-location class and the slice's "
+        "packing class can never drift",
+    )
+    args = ap.parse_args(argv)
+    horizon_bucket = (
+        None if args.horizon_bucket.lower() == "none"
+        else float(args.horizon_bucket)
+    )
+
+    # optional multi-controller init (ROADMAP item 1's jax.distributed
+    # leg) — strictly opt-in behind CIMBA_FLEET_DIST, never in tier-1
+    from cimba_tpu.fleet import dist as _dist
+
+    _dist.maybe_init_distributed()
+
+    models = load_models(args.models)
+    srv = _SliceServer(
+        name=args.name,
+        models=models,
+        max_wave=args.max_wave,
+        max_pending=args.max_pending,
+        port=args.port,
+        health_port=args.health_port,
+        warm_chunk_steps=args.warm_chunk_steps,
+        horizon_bucket=horizon_bucket,
+    )
+    # the spawn contract: exactly ONE json line on stdout, then quiet
+    # (logs go to stderr) — the manager blocks on this line
+    print(json.dumps(srv.ready_line()), flush=True)
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    parent = os.getppid()
+    while not stop.wait(0.5):
+        if os.getppid() != parent:
+            # orphaned: the manager died (or a respawn raced its
+            # shutdown) — a slice must never outlive its fleet
+            print(f"[{args.name}] parent gone, exiting",
+                  file=sys.stderr, flush=True)
+            break
+    srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""The fleet's loopback wire protocol: length-prefixed JSON + npy.
+
+One frame carries one message: a JSON header plus zero or more binary
+attachments (raw ``.npy`` bodies) the header references by index —
+stdlib + numpy only, no pickle (a slice must never execute bytes a
+router sent it, and vice versa), no new dependencies.  Layout::
+
+    b"CFW1"                      magic + protocol version
+    u32 big-endian               header length
+    <header bytes>               UTF-8 JSON object
+    u32 big-endian               blob count
+    per blob: u64 big-endian     blob length
+              <blob bytes>       numpy .npy serialization
+
+Pytrees cross the wire through :func:`encode_tree` /
+:func:`decode_tree`: JSON literals pass through, containers are tagged
+nodes, arrays become npy blobs, and the few framework NamedTuples a
+result carries (``stats.summary.Summary``) are reconstructed by class
+name from an explicit registry — the decode side never builds a type
+the protocol didn't declare.  Python scalars stay Python scalars, so a
+parameter tuple round-trips bit-exactly (``json`` floats serialize via
+``repr`` and re-parse to the identical double), which is what keeps a
+routed request's trajectories bitwise the direct call's.
+
+See docs/20_fleet.md for the message catalogue (``run`` / ``stats`` /
+``ping``) and the failover semantics built on top.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+from typing import Any, List, Optional, Tuple
+
+MAGIC = b"CFW1"
+
+#: per-frame ceilings — a corrupt length prefix must fail loudly, not
+#: allocate gigabytes (loopback frames are small: results are pooled
+#: summaries, not batched sims)
+MAX_HEADER = 16 << 20
+MAX_BLOB = 256 << 20
+MAX_BLOBS = 4096
+
+
+class WireError(ConnectionError):
+    """Malformed frame or a peer that hung up mid-frame."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes read)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, header: dict,
+               blobs: Tuple[bytes, ...] = ()) -> None:
+    hb = json.dumps(header).encode("utf-8")
+    parts = [MAGIC, struct.pack(">I", len(hb)), hb,
+             struct.pack(">I", len(blobs))]
+    for b in blobs:
+        parts.append(struct.pack(">Q", len(b)))
+        parts.append(b)
+    sock.sendall(b"".join(parts))
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, List[bytes]]:
+    magic = _recv_exact(sock, 4)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r} (expected {MAGIC!r})")
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if hlen > MAX_HEADER:
+        raise WireError(f"header length {hlen} exceeds {MAX_HEADER}")
+    try:
+        header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        # corrupt bytes are a TRANSPORT fault (WireError -> requeue),
+        # never an exception class the caller didn't sign up for
+        raise WireError(f"undecodable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError("frame header is not a JSON object")
+    (nblobs,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if nblobs > MAX_BLOBS:
+        raise WireError(f"blob count {nblobs} exceeds {MAX_BLOBS}")
+    blobs = []
+    for _ in range(nblobs):
+        (blen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        if blen > MAX_BLOB:
+            raise WireError(f"blob length {blen} exceeds {MAX_BLOB}")
+        blobs.append(_recv_exact(sock, blen))
+    return header, blobs
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> (json node, npy blobs)
+# ---------------------------------------------------------------------------
+
+def _nt_classes() -> dict:
+    """NamedTuple classes the protocol may reconstruct by name — an
+    explicit allowlist, resolved lazily so this module stays importable
+    without jax."""
+    from cimba_tpu.stats.summary import Summary
+
+    return {"Summary": Summary}
+
+
+def _to_npy(arr) -> bytes:
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def encode_tree(x: Any) -> Tuple[Any, List[bytes]]:
+    """Encode a pytree of JSON literals / containers / arrays /
+    registered NamedTuples into a JSON-able node plus npy blobs."""
+    import numpy as np
+
+    blobs: List[bytes] = []
+
+    def enc(v):
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, tuple) and hasattr(v, "_fields"):
+            cname = type(v).__name__
+            if cname not in _nt_classes():
+                raise TypeError(
+                    f"NamedTuple {cname!r} is not in the wire "
+                    "protocol's reconstruction registry "
+                    "(fleet.wire._nt_classes)"
+                )
+            return {"__t": "nt", "c": cname, "v": [enc(e) for e in v]}
+        if isinstance(v, tuple):
+            return {"__t": "tuple", "v": [enc(e) for e in v]}
+        if isinstance(v, list):
+            return {"__t": "list", "v": [enc(e) for e in v]}
+        if isinstance(v, dict):
+            keys = list(v.keys())
+            if not all(isinstance(k, str) for k in keys):
+                raise TypeError(
+                    "wire dicts need string keys, got "
+                    f"{[type(k).__name__ for k in keys]}"
+                )
+            return {
+                "__t": "dict", "k": keys,
+                "v": [enc(v[k]) for k in keys],
+            }
+        if isinstance(v, (np.ndarray, np.generic)) or (
+            hasattr(v, "dtype") and hasattr(v, "shape")
+        ):
+            blobs.append(_to_npy(v))
+            return {"__t": "nd", "i": len(blobs) - 1}
+        raise TypeError(
+            f"{type(v).__module__}.{type(v).__qualname__} has no wire "
+            "encoding (JSON literals, tuples/lists/dicts, arrays, and "
+            "registered NamedTuples only)"
+        )
+
+    return enc(x), blobs
+
+
+def decode_tree(node: Any, blobs: List[bytes]) -> Any:
+    """Invert :func:`encode_tree`."""
+    import numpy as np
+
+    def dec(v):
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, dict) and "__t" in v:
+            t = v["__t"]
+            if t == "tuple":
+                return tuple(dec(e) for e in v["v"])
+            if t == "list":
+                return [dec(e) for e in v["v"]]
+            if t == "dict":
+                return {k: dec(e) for k, e in zip(v["k"], v["v"])}
+            if t == "nt":
+                cls = _nt_classes().get(v["c"])
+                if cls is None:
+                    raise WireError(
+                        f"unknown NamedTuple class {v['c']!r} in frame"
+                    )
+                return cls(*(dec(e) for e in v["v"]))
+            if t == "nd":
+                raw = blobs[int(v["i"])]
+                return np.load(io.BytesIO(raw), allow_pickle=False)
+            raise WireError(f"unknown wire node tag {t!r}")
+        raise WireError(f"undecodable wire node {type(v).__name__}")
+
+    return dec(node)
+
+
+def call(
+    host: str,
+    port: int,
+    header: dict,
+    blobs: Tuple[bytes, ...] = (),
+    *,
+    timeout: Optional[float] = None,
+    connect_timeout: float = 5.0,
+) -> Tuple[dict, List[bytes]]:
+    """One request/response round-trip on a fresh loopback connection
+    (the router's client leg).  ``timeout`` bounds the RESPONSE wait —
+    an experiment may legitimately run for a while; ``connect_timeout``
+    bounds only the dial.  Raises ``OSError``/:class:`WireError` on any
+    transport failure — the router's requeue trigger."""
+    with socket.create_connection(
+        (host, port), timeout=connect_timeout
+    ) as sock:
+        sock.settimeout(timeout)
+        send_frame(sock, header, blobs)
+        return recv_frame(sock)

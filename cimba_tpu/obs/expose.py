@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -207,11 +208,15 @@ class ExpositionServer:
     ``.url``).  The server thread and every handler thread are daemons;
     :meth:`close` shuts the listener down.  Binding is loopback by
     default — exposing a fleet means fronting this with real infra, not
-    flipping the default."""
+    flipping the default.  ``delay_s`` stalls every response by that
+    long — the fleet chaos plane's ``scrape_delay_ms`` knob
+    (docs/20_fleet.md), which is how the health poller's timeout path
+    gets exercised deterministically; 0 (the default) adds nothing."""
 
     def __init__(self, telemetry: Telemetry, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, delay_s: float = 0.0):
         self.telemetry = telemetry
+        self.delay_s = float(delay_s)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -227,6 +232,8 @@ class ExpositionServer:
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
+                if outer.delay_s > 0:
+                    time.sleep(outer.delay_s)
                 try:
                     if path == "/metrics":
                         body = render_prometheus(
@@ -296,9 +303,11 @@ class ExpositionServer:
 
 
 def start(telemetry: Telemetry, *, host: str = "127.0.0.1",
-          port: int = 0) -> ExpositionServer:
+          port: int = 0, delay_s: float = 0.0) -> ExpositionServer:
     """Start the exposition server over ``telemetry`` (opt-in: nothing
     anywhere starts one implicitly).  Returns the running server; its
     ``.url`` is what you point a scrape config (or
-    ``tools/metrics_dump.py``) at."""
-    return ExpositionServer(telemetry, host=host, port=port)
+    ``tools/metrics_dump.py``) at.  ``delay_s`` is the chaos-plane
+    scrape stall (see :class:`ExpositionServer`)."""
+    return ExpositionServer(telemetry, host=host, port=port,
+                            delay_s=delay_s)

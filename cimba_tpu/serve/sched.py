@@ -259,10 +259,35 @@ class AdmissionQueue:
     # cimba-check: assume-held
     def _mature(self, now: float) -> None:
         """Move backoff-delayed entries whose time has come into the
-        ready heap (caller holds the lock)."""
+        ready heap (caller holds the lock).
+
+        Deadline override: an entry whose DEADLINE expired while it was
+        serving its backoff delay matures immediately, ready_at or not —
+        the dispatcher then fails it with ``DeadlineExceeded`` (waited
+        time included) at the next dispatch boundary instead of holding
+        the already-dead request through the rest of its backoff and
+        burning a retry on it.  The scan is O(delayed) only when some
+        entry actually carries a deadline; the delay heap is small by
+        construction (failed requests, not the queue)."""
         while self._delayed and self._delayed[0].ready_at <= now:
             d = heapq.heappop(self._delayed)
             self._push(d.entry)
+        if self._delayed and any(
+            getattr(d.entry, "deadline_at", None) is not None
+            for d in self._delayed
+        ):
+            keep = []
+            matured = False
+            for d in self._delayed:
+                dl = getattr(d.entry, "deadline_at", None)
+                if dl is not None and dl <= now:
+                    self._push(d.entry)
+                    matured = True
+                else:
+                    keep.append(d)
+            if matured:
+                self._delayed = keep
+                heapq.heapify(self._delayed)
 
     def pop_ready(self, timeout: Optional[float] = None):
         """Pop the highest-priority ready entry, waiting up to
@@ -288,6 +313,17 @@ class AdmissionQueue:
                     waits.append(
                         max(self._delayed[0].ready_at - now, 0.0)
                     )
+                    # wake for the earliest DEADLINE among delayed
+                    # entries too: a deadline expiring mid-backoff
+                    # matures the entry (see _mature), and an untimed
+                    # pop must not sleep through that
+                    dls = [
+                        dl for d in self._delayed
+                        if (dl := getattr(d.entry, "deadline_at", None))
+                        is not None
+                    ]
+                    if dls:
+                        waits.append(max(min(dls) - now, 0.0))
                 self._ready.wait(min(waits) if waits else None)
 
     def take(self, want: Callable[[Any], bool]) -> List[Any]:

@@ -64,6 +64,7 @@ from cimba_tpu.serve.sched import (
 
 __all__ = [
     "Request", "ResultHandle", "Service",
+    "request_class_key", "horizon_bucket_of",
     "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
     "DeadlineExceeded", "RetriesExhausted", "Backoff",
 ]
@@ -73,6 +74,61 @@ def _default_summary_path():
     from cimba_tpu.runner import experiment as ex
 
     return ex.default_summary_path
+
+
+def horizon_bucket_of(t_end, horizon_bucket) -> object:
+    """Which horizon bucket a ``t_end`` falls into — the Tier-B packing
+    ladder (docs/14_wave_packing.md).  Module-level: the fleet router
+    (docs/20_fleet.md) co-locates requests by the SAME class definition
+    the dispatcher packs by, so the two can never drift.  Truncation is
+    per-lane-exact regardless of who shares the wave; bucketing is
+    purely the LATENCY policy bounding how much longer than its own
+    horizon a request's wave may run."""
+    if t_end is None:
+        return "inf"
+    t = float(t_end)
+    if not t > 0.0:
+        return "nonpos"
+    if horizon_bucket is None:
+        return "finite"
+    import math
+
+    return math.floor(math.log(t) / math.log(horizon_bucket))
+
+
+def request_class_key(request, with_metrics: bool, *, mesh,
+                      horizon_bucket) -> tuple:
+    """What may share a wave — the compatibility CLASS of one
+    :class:`Request`: the compiled-program class (spec structural
+    fingerprint, profile, flags, pack arm, mesh —
+    ``serve.cache.program_class_key``), the params tree signature
+    (slices of both requests' params must concatenate), and the horizon
+    bucket.  Seed, param VALUES, R, priority, the exact ``t_end``, and
+    ``chunk_steps`` are per-lane data (or trajectory-invariant) and do
+    not join the key; ``summary_path`` doesn't either, because each
+    request folds its own slice through its own fold program.  ONE
+    definition serves both the in-process :class:`Service` packer and
+    the fleet router's co-location policy (docs/20_fleet.md)."""
+    import jax
+
+    from cimba_tpu.runner import experiment as ex
+
+    pck = _pcache.program_class_key(
+        request.spec, with_metrics, mesh=mesh, pack=request.pack,
+    )
+    shapes = jax.eval_shape(
+        lambda: ex._slice_params(
+            request.params, request.n_replications, 0, 1
+        )
+    )
+    sig = (
+        jax.tree.structure(shapes),
+        tuple(
+            (tuple(l.shape[1:]), str(l.dtype))
+            for l in jax.tree.leaves(shapes)
+        ),
+    )
+    return (pck, sig, horizon_bucket_of(request.t_end, horizon_bucket))
 
 
 @dataclass
@@ -635,21 +691,10 @@ class Service:
     # -- internals -----------------------------------------------------------
 
     def _horizon_bucket(self, t_end):
-        """Which horizon bucket a request's ``t_end`` falls into — the
-        Tier-B packing ladder (docs/14_wave_packing.md).  Truncation is
-        per-lane-exact regardless of who shares the wave; bucketing is
-        purely the LATENCY policy bounding how much longer than its own
-        horizon a request's wave may run."""
-        if t_end is None:
-            return "inf"
-        t = float(t_end)
-        if not t > 0.0:
-            return "nonpos"
-        if self.horizon_bucket is None:
-            return "finite"
-        import math
-
-        return math.floor(math.log(t) / math.log(self.horizon_bucket))
+        """This service's horizon bucket for ``t_end`` (the shared
+        module-level :func:`horizon_bucket_of` at this service's
+        ratio)."""
+        return horizon_bucket_of(t_end, self.horizon_bucket)
 
     def _wave_shape(self, total: int) -> int:
         """The quantized lane count one wave of ``total`` live lanes
@@ -690,37 +735,13 @@ class Service:
         )
 
     def _class_key(self, request: Request, with_metrics: bool) -> tuple:
-        """What may share a wave — the compatibility CLASS: the
-        compiled-program class (spec structural fingerprint, profile,
-        flags, pack arm, mesh — `serve.cache.program_class_key`), the
-        params tree signature (slices of both requests' params must
-        concatenate), and the horizon bucket.  Seed, param VALUES, R,
-        priority, the exact ``t_end``, and ``chunk_steps`` are per-lane
-        data (or trajectory-invariant) and do not join the key — two
-        sweep points with different params/seeds/horizons pack
-        together; ``summary_path`` no longer joins either, because each
-        request folds its own slice through its own fold program."""
-        import jax
-
-        from cimba_tpu.runner import experiment as ex
-
-        pck = _pcache.program_class_key(
-            request.spec, with_metrics, mesh=self.mesh,
-            pack=request.pack,
+        """This service's compatibility class for ``request`` — the
+        shared module-level :func:`request_class_key` at this service's
+        mesh and horizon-bucket ratio."""
+        return request_class_key(
+            request, with_metrics, mesh=self.mesh,
+            horizon_bucket=self.horizon_bucket,
         )
-        shapes = jax.eval_shape(
-            lambda: ex._slice_params(
-                request.params, request.n_replications, 0, 1
-            )
-        )
-        sig = (
-            jax.tree.structure(shapes),
-            tuple(
-                (tuple(l.shape[1:]), str(l.dtype))
-                for l in jax.tree.leaves(shapes)
-            ),
-        )
-        return (pck, sig, self._horizon_bucket(request.t_end))
 
     def _cancel(self, entry: _Entry) -> bool:
         with self._lock:

@@ -51,11 +51,13 @@ from __future__ import annotations
 # cimba-check: persist-path  (CHK001: no id() may feed what this module
 # writes to disk — store keys must be value-based)
 
+import contextlib
 import dataclasses
 import hashlib
 import json
 import os
 import pickle
+import socket
 import threading
 import time
 import types
@@ -78,11 +80,20 @@ FORMAT = 1
 
 MANIFEST = "manifest.json"
 ARTIFACT_DIR = "artifacts"
+MANIFEST_LOCK = "manifest.lock"
 
 
 class StoreInvalidationWarning(UserWarning):
     """A store entry was rejected (corrupt, truncated, or from a
     different jax/backend/format) and the program will be recompiled."""
+
+
+class StaleStoreLockWarning(UserWarning):
+    """A manifest lockfile outlived its holder (dead pid or past the
+    staleness window) and was broken.  Loud by design: a stale lock
+    means some writer died mid-update — the manifest it left behind is
+    still the previous consistent one (writes are atomic), but whoever
+    operates the store should know a save was lost."""
 
 
 class UnstableStoreKey(Exception):
@@ -564,12 +575,21 @@ class ProgramStore:
     Writes are crash-atomic (mkstemp + fsync + ``os.replace`` — the
     checkpoint discipline): a killed save leaves the previous manifest
     intact, and a torn artifact fails its checksum on load instead of
-    deserializing garbage."""
+    deserializing garbage.  Manifest UPDATES additionally serialize
+    across processes through an ``O_EXCL`` lockfile
+    (:meth:`_manifest_lock`): two processes warming the same store
+    merge their entries instead of losing one side's, and a stale lock
+    (dead writer) is broken with a loud
+    :class:`StaleStoreLockWarning`."""
 
     # cimba-check: must-hold(_lock) _stats
 
-    def __init__(self, root: str, *, enable_xla_cache: bool = True):
+    def __init__(self, root: str, *, enable_xla_cache: bool = True,
+                 lock_timeout_s: float = 60.0,
+                 lock_stale_s: float = 30.0):
         self.root = os.path.abspath(root)
+        self._lock_timeout_s = float(lock_timeout_s)
+        self._lock_stale_s = float(lock_stale_s)
         os.makedirs(os.path.join(self.root, ARTIFACT_DIR), exist_ok=True)
         if enable_xla_cache:
             maybe_enable_persistent_cache(self.root)
@@ -613,6 +633,160 @@ class ProgramStore:
 
     def _manifest_path(self) -> str:
         return os.path.join(self.root, MANIFEST)
+
+    def _manifest_lock_path(self) -> str:
+        return os.path.join(self.root, MANIFEST_LOCK)
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(int(pid), 0)
+        except (ProcessLookupError, ValueError):
+            return False
+        except PermissionError:
+            return True  # alive, just not ours
+        return True
+
+    @contextlib.contextmanager
+    def _manifest_lock(self):
+        """Inter-PROCESS mutual exclusion around manifest
+        read-merge-write (the in-process ``_lock`` covers threads; this
+        covers two processes warming the same store — two
+        ``warm_store`` runs, or a fleet of slices saving autotuned
+        programs — whose unlocked read-modify-write would silently lose
+        one side's entries).
+
+        Mechanics: an ``O_CREAT | O_EXCL`` lockfile beside the manifest
+        holding ``{pid, host, t}``; losers poll.  A lock held by a
+        provably-DEAD pid on this host — or older than
+        ``lock_stale_s`` when the holder's liveness is unknowable
+        (foreign host, unreadable body) — is broken by atomic rename
+        with a LOUD :class:`StaleStoreLockWarning` naming the holder
+        (the atomic manifest write guarantees what's on disk is the
+        previous consistent generation).  A provably-ALIVE same-host
+        holder is never broken, however old: waiting past
+        ``lock_timeout_s`` raises ``TimeoutError`` — better a loud
+        failed save than two writers in the manifest."""
+        path = self._manifest_lock_path()
+        me = {
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "t": time.time(),
+        }
+        deadline = time.monotonic() + self._lock_timeout_s
+        while True:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                try:
+                    age = time.time() - os.stat(path).st_mtime
+                except FileNotFoundError:
+                    continue     # released between open and stat: retry
+                except OSError:
+                    time.sleep(0.02)
+                    continue
+                holder: dict = {}
+                try:
+                    with open(path, "r") as f:
+                        holder = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    # empty/torn body: either a writer between O_EXCL
+                    # and write (age ~0 — wait) or one that CRASHED in
+                    # that window (age grows).  Liveness is unknowable,
+                    # so fall through with an empty holder and let the
+                    # age/timeout ladder decide — a blind retry here
+                    # would spin forever on a permanently-empty lock.
+                    holder = {}
+                same_host = holder.get("host") == me["host"]
+                has_pid = holder.get("pid") is not None
+                dead = (
+                    same_host and has_pid
+                    and not self._pid_alive(holder["pid"])
+                )
+                # a holder whose liveness is PROVABLE (same host, pid
+                # answers kill-0) is never age-broken: a slow-but-alive
+                # writer past lock_stale_s must hit the Timeout path
+                # below, not have its lock stolen mid-write (the
+                # double-writer corruption this lock exists to close).
+                # Age-breaking applies only where liveness is
+                # unknowable: foreign hosts and unreadable pids.
+                alive_here = same_host and has_pid and not dead
+                if dead or (
+                    age > self._lock_stale_s and not alive_here
+                ):
+                    # break by ATOMIC rename, not unlink: two waiters
+                    # may both judge the same lock stale, and a bare
+                    # unlink from the loser could delete the winner's
+                    # freshly-acquired lock — exactly the double-writer
+                    # hole this lockfile exists to close.  rename
+                    # succeeds for exactly one breaker; everyone else
+                    # gets FileNotFoundError and just re-contends.
+                    broken = f"{path}.broken.{os.getpid()}"
+                    try:
+                        os.rename(path, broken)
+                    except OSError:
+                        continue  # someone else broke/released it first
+                    warnings.warn(
+                        f"broke stale program-store manifest lock "
+                        f"{path} (holder pid={holder.get('pid')} "
+                        f"host={holder.get('host')!r}, age {age:.1f}s, "
+                        f"{'dead' if dead else 'past staleness window'})"
+                        " — a writer died mid-update; its save was lost",
+                        StaleStoreLockWarning,
+                    )
+                    try:
+                        os.unlink(broken)
+                    except OSError:
+                        pass
+                    continue
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"program-store manifest lock {path} held by "
+                        f"pid={holder.get('pid')} "
+                        f"host={holder.get('host')!r} for {age:.1f}s — "
+                        f"gave up after {self._lock_timeout_s:.0f}s"
+                    )
+                time.sleep(0.02)
+                continue
+            try:
+                os.write(fd, json.dumps(me).encode("utf-8"))
+            finally:
+                os.close(fd)
+            break
+        try:
+            yield
+        finally:
+            # release only what is still OURS: if another process
+            # judged us stale and stole the lock (we ran past
+            # lock_stale_s), the file now holds THEIR identity and a
+            # blind unlink would hand the manifest to a third writer
+            try:
+                with open(path, "r") as f:
+                    cur = json.load(f)
+                if (
+                    cur.get("pid") == me["pid"]
+                    and cur.get("host") == me["host"]
+                ):
+                    os.unlink(path)
+            except (OSError, json.JSONDecodeError):
+                pass  # already broken/released — nothing of ours left
+
+    def _update_manifest(self, mutate) -> dict:
+        """One atomic cross-process read-merge-write of the manifest:
+        ``mutate(manifest)`` runs with the inter-process lockfile held
+        (which serializes THREADS too — an O_EXCL create fails the same
+        way for a sibling thread as for a foreign process), then the
+        result lands via the crash-atomic write.  Deliberately NOT
+        under ``self._lock``: the file-lock wait can last seconds
+        (another process saving), and holding the thread lock across
+        it would stall ``stats()`` — and with it the telemetry scrape
+        behind ``/healthz`` — long enough to fake a dead slice.
+        Returns the written manifest."""
+        with self._manifest_lock():
+            manifest = self._read_manifest()
+            mutate(manifest)
+            self._write_manifest(manifest)
+        return manifest
 
     def _read_manifest(self) -> dict:
         try:
@@ -810,15 +984,18 @@ class ProgramStore:
                         path=pdig,
                     )
 
-        with self._lock:
-            manifest = self._read_manifest()
-            entry = manifest["entries"].get(key, {})
-            # the merge key carries the summary-path digest too: fold
-            # records for different paths share arg shapes, and a
-            # shape+role key would silently keep only the last path's
-            def mkey(p):
-                return (p["role"], p["shape"], p.get("path"))
+        # the merge key carries the summary-path digest too: fold
+        # records for different paths share arg shapes, and a
+        # shape+role key would silently keep only the last path's
+        def mkey(p):
+            return (p["role"], p["shape"], p.get("path"))
 
+        def merge_entry(manifest):
+            # runs under BOTH the thread lock and the inter-process
+            # manifest lockfile: a second process saving a different
+            # program key concurrently merges instead of clobbering
+            # (the two-subprocess race test in tests/test_store.py)
+            entry = manifest["entries"].get(key, {})
             merged = {mkey(p): p for p in entry.get("programs", [])}
             for p in programs:
                 merged[mkey(p)] = p
@@ -839,7 +1016,9 @@ class ProgramStore:
                 ),
                 "downgrades": downgrades,
             }
-            self._write_manifest(manifest)
+
+        self._update_manifest(merge_entry)
+        with self._lock:
             self._stats["saves"] += 1
         return report
 

@@ -867,3 +867,55 @@ def test_serve_many_client_soak_mixed_mm1_mg1_bitwise():
         assert by_index[len(cases) - 1].metrics is not None
     finally:
         om.disable()
+
+
+def test_deadline_expiring_in_backoff_heap_fails_fast_with_span(
+    tiny, shared_cache,
+):
+    """PR 13 sched edge fix: a request whose deadline expires while it
+    is sitting in the backoff DELAY heap must deliver
+    ``DeadlineExceeded`` (with the waited time) at the next dispatch
+    boundary — not serve out its multi-second backoff first, and never
+    burn another retry on an already-dead request.  The span tree must
+    still close completely with the deadline_exceeded outcome."""
+    from cimba_tpu.obs import telemetry as tm
+
+    spec = tiny
+    tel = tm.Telemetry(interval=0, spans=True, autostart=False)
+    svc = _Flaky(
+        99, max_wave=8, cache=shared_cache, max_retries=10,
+        backoff=serve.Backoff(base=30.0, cap=30.0),  # would park ~30 s
+        telemetry=tel,
+    )
+    try:
+        t0 = time.monotonic()
+        h = svc.submit(
+            _tiny_req(spec, 4, label="poison", deadline=0.3)
+        )
+        with pytest.raises(serve.DeadlineExceeded) as ei:
+            h.result(20)
+        waited_wall = time.monotonic() - t0
+        stats = svc.stats()
+    finally:
+        svc.shutdown()
+        tel.close()
+    # delivered at the next dispatch boundary after expiry (the
+    # dispatcher polls its queue every 0.25 s), nowhere near the 30 s
+    # backoff the entry was serving
+    assert waited_wall < 5.0, waited_wall
+    assert ei.value.deadline_s == 0.3
+    assert ei.value.waited_s >= 0.3
+    assert stats["deadline_exceeded"] == 1
+    # exactly the ONE pre-deadline dispatch attempt was charged — the
+    # matured-by-deadline pass must not have retried first
+    assert svc.attempts == 1
+    assert stats["retries"] == 1
+    # the span tree is complete: one root, outcome deadline_exceeded,
+    # nothing left open (the cancelled-outcome completeness contract)
+    roots = [
+        r for r in tel.spans.completed
+        if r.get("parent") is None and r["name"] == "request"
+    ]
+    assert len(roots) == 1
+    assert roots[0]["outcome"] == "deadline_exceeded"
+    assert tel.spans.open_count() == 0

@@ -424,3 +424,132 @@ def test_persistent_cache_wiring_and_default_store(tmp_path, monkeypatch):
         jax.config.update(
             "jax_persistent_cache_min_entry_size_bytes", prev_size
         )
+
+
+# -- concurrent-writer safety (PR 13) ----------------------------------------
+
+
+_RACE_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from cimba_tpu.serve import store as ps
+
+root, tag, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+st = ps.ProgramStore(root, enable_xla_cache=False)
+for i in range(n):
+    def add(m, i=i):
+        m["entries"][f"{tag}:{i}"] = {"model": tag, "i": i}
+    st._update_manifest(add)
+print("done", tag)
+"""
+
+
+def test_manifest_lock_two_process_race(tmp_path):
+    """Two PROCESSES hammering read-merge-write on one manifest must
+    lose no entries: the O_EXCL lockfile serializes the update window.
+    (Without the lock, interleaved read-modify-write reliably drops one
+    side's entries — the two-warm_store-runs corruption mode.)"""
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "race_store")
+    n = 25
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RACE_CHILD, root, tag, str(n)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for tag in ("alpha", "beta")
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err
+    with open(os.path.join(root, ps.MANIFEST)) as f:
+        manifest = json.load(f)   # valid JSON or the test dies here
+    entries = manifest["entries"]
+    for tag in ("alpha", "beta"):
+        missing = [
+            i for i in range(n) if f"{tag}:{i}" not in entries
+        ]
+        assert not missing, (tag, missing)
+    assert len(entries) == 2 * n
+    # the lockfile does not outlive the writers
+    assert not os.path.exists(os.path.join(root, ps.MANIFEST_LOCK))
+
+
+def test_manifest_stale_lock_broken_loudly(tmp_path):
+    """A lockfile left by a dead writer is broken with a LOUD
+    structured warning naming the holder — a live save must not hang
+    forever on a corpse's lock, and the operator must hear about the
+    lost save."""
+    root = str(tmp_path / "stale_store")
+    st = ps.ProgramStore(
+        root, enable_xla_cache=False, lock_stale_s=3600.0,
+    )
+    lock = st._manifest_lock_path()
+    # a dead pid on THIS host: provably stale regardless of age
+    with open(lock, "w") as f:
+        json.dump({"pid": 2 ** 22 + 11, "host": __import__(
+            "socket").gethostname(), "t": 0}, f)
+    with pytest.warns(ps.StaleStoreLockWarning, match="stale"):
+        st._update_manifest(
+            lambda m: m["entries"].update(ok={"model": "x"})
+        )
+    with open(st._manifest_path()) as f:
+        assert "ok" in json.load(f)["entries"]
+    assert not os.path.exists(lock)
+
+    # a LIVE foreign lock within the staleness window times out loudly
+    # instead of being broken (the not-stale arm)
+    st2 = ps.ProgramStore(
+        root, enable_xla_cache=False, lock_stale_s=3600.0,
+        lock_timeout_s=0.2,
+    )
+    with open(lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": "elsewhere", "t": 0}, f)
+    try:
+        with pytest.raises(TimeoutError, match="manifest lock"):
+            st2._update_manifest(
+                lambda m: m["entries"].update(no={"model": "y"})
+            )
+    finally:
+        os.unlink(lock)
+
+    # a PROVABLY-ALIVE same-host holder is never age-broken, however
+    # old: a slow writer past the staleness window must hit the
+    # Timeout path, not have its lock stolen mid-write (the
+    # double-writer hole the review closed)
+    st3 = ps.ProgramStore(
+        root, enable_xla_cache=False, lock_stale_s=0.0,
+        lock_timeout_s=0.2,
+    )
+    with open(lock, "w") as f:
+        json.dump({"pid": os.getpid(), "host": __import__(
+            "socket").gethostname(), "t": 0}, f)
+    os.utime(lock, (1, 1))   # ancient — age alone would break it
+    try:
+        with pytest.raises(TimeoutError, match="manifest lock"):
+            st3._update_manifest(
+                lambda m: m["entries"].update(no={"model": "z"})
+            )
+        assert os.path.exists(lock)   # the live holder's lock survived
+    finally:
+        os.unlink(lock)
+
+    # an EMPTY lock body (a writer SIGKILLed between O_EXCL-create and
+    # write — the chaos kill knob can do exactly this) must not spin
+    # saves forever: liveness is unknowable, so past the staleness
+    # window it is age-broken like a foreign-host lock
+    st4 = ps.ProgramStore(
+        root, enable_xla_cache=False, lock_stale_s=0.5,
+        lock_timeout_s=30.0,
+    )
+    open(lock, "w").close()
+    os.utime(lock, (1, 1))
+    with pytest.warns(ps.StaleStoreLockWarning):
+        st4._update_manifest(
+            lambda m: m["entries"].update(torn={"model": "w"})
+        )
+    with open(st4._manifest_path()) as f:
+        assert "torn" in json.load(f)["entries"]
+    assert not os.path.exists(lock)

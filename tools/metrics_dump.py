@@ -9,9 +9,19 @@ Prometheus metric families plus the ``/healthz`` verdict:
     python tools/metrics_dump.py --url http://host:9321 --varz
     python tools/metrics_dump.py --demo
 
+Fleet mode (docs/20_fleet.md): several ``--url``s, or ``--fleet`` with
+a fleet manifest file (``{"slices": [{"name", "url"}, ...]}`` — what
+``FleetManager.fleet_manifest()`` emits), prints one PER-SLICE row
+(health verdict, queue depth, outstanding, padding waste, store
+hits/fallbacks) plus a fleet rollup:
+
+    python tools/metrics_dump.py --url http://h:9321 --url http://h:9322
+    python tools/metrics_dump.py --fleet fleet.json
+
 Exit code: 0 when health is ``ok`` or ``degraded`` (degraded prints a
-warning), 1 when ``unhealthy`` or the endpoint is unreachable — so the
-tool slots straight into a shell health check.
+warning), 1 when ``unhealthy`` or the endpoint is unreachable — in
+fleet mode, 1 when ANY slice is unhealthy/unreachable — so the tool
+slots straight into a shell health check.
 
 ``--url`` mode is stdlib-only (urllib + the in-repo Prometheus parser);
 ``--demo`` imports jax and drives three real requests through a tiny
@@ -131,6 +141,61 @@ def dump_url(url: str, timeout: float, varz: bool) -> int:
     return 0 if verdict in ("ok", "degraded") else 1
 
 
+def dump_fleet(slices, timeout: float) -> int:
+    """Per-slice health/metrics table + fleet rollup for ``slices`` =
+    ``[(name, url), ...]``.  Exit 1 when any slice is unreachable or
+    unhealthy (the CI/cron contract)."""
+    # imported here, not at module level: the package __init__ pulls
+    # jax and --version must stay light; scrape_slice itself is
+    # stdlib + the in-repo Prometheus parser
+    from cimba_tpu.fleet.health import scrape_slice
+
+    cols = (
+        ("slice", 18), ("verdict", 12), ("queue", 6), ("outst", 6),
+        ("waste", 6), ("hits", 6), ("fallbk", 7), ("done", 6),
+    )
+    print("  ".join(f"{name:<{w}}" for name, w in cols))
+    print("  ".join("-" * w for _, w in cols))
+    rollup = {"ok": 0, "degraded": 0, "unhealthy": 0, "unreachable": 0}
+    depth_total = 0
+    outst_total = 0
+    bad = 0
+    for name, url in slices:
+        rep = scrape_slice(url, timeout)
+        verdict = rep["verdict"]
+        rollup[verdict] = rollup.get(verdict, 0) + 1
+        if verdict in ("unhealthy", "unreachable"):
+            bad += 1
+        depth_total += int(rep.get("queue_depth", 0))
+        outst_total += int(rep.get("outstanding", 0))
+
+        def fmt(key, pct=False):
+            v = rep.get(key)
+            if v is None:
+                return "-"
+            return f"{v:.1%}" if pct else f"{v:g}"
+
+        row = (
+            name[:18], verdict, fmt("queue_depth"), fmt("outstanding"),
+            fmt("padding_waste", pct=True), fmt("store_hits"),
+            fmt("store_fallback_shapes"), fmt("completed"),
+        )
+        print("  ".join(
+            f"{v:<{w}}" for v, (_, w) in zip(row, cols)
+        ))
+        if rep.get("error"):
+            print(f"    ({rep['error']})")
+    print()
+    print(
+        f"fleet: {len(slices)} slice(s) — "
+        + ", ".join(f"{k} {v}" for k, v in rollup.items() if v)
+        + f"; queued {depth_total}, outstanding {outst_total}"
+    )
+    if bad:
+        print(f"UNHEALTHY: {bad} slice(s) down or unreachable")
+    return 1 if bad else 0
+
+
 def run_demo(varz: bool) -> int:
     """Spin a tiny in-process Service with the full plane attached,
     drive 3 requests, then scrape it over real HTTP (the whole path the
@@ -182,8 +247,15 @@ def main(argv=None) -> int:
         "families + health verdict",
     )
     ap.add_argument(
-        "--url", help="exposition endpoint base, e.g. "
-        "http://127.0.0.1:9321 (obs.expose.start's .url)",
+        "--url", action="append", default=None,
+        help="exposition endpoint base, e.g. "
+        "http://127.0.0.1:9321 (obs.expose.start's .url); repeat for "
+        "a fleet table",
+    )
+    ap.add_argument(
+        "--fleet", metavar="FILE",
+        help="fleet manifest JSON ({'slices': [{'name','url'},...]} — "
+        "FleetManager.fleet_manifest()): per-slice table + rollup",
     )
     ap.add_argument(
         "--demo", action="store_true",
@@ -220,11 +292,40 @@ def main(argv=None) -> int:
 
         print(__version__)
         return 0
-    if bool(args.url) == bool(args.demo):
-        ap.error("pass exactly one of --url or --demo")
+    urls = args.url or []
+    modes = sum((bool(urls), bool(args.fleet), bool(args.demo)))
+    if modes != 1:
+        ap.error("pass exactly one of --url (repeatable), --fleet, "
+                 "or --demo")
     if args.demo:
         return run_demo(args.varz)
-    return dump_url(args.url, args.timeout, args.varz)
+    if args.fleet:
+        try:
+            with open(args.fleet) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"unreadable fleet manifest {args.fleet}: {e}",
+                  file=sys.stderr)
+            return 1
+        slices = [
+            (s.get("name") or s["url"], s["url"])
+            for s in manifest.get("slices", [])
+        ]
+        if not slices:
+            print(f"{args.fleet}: no slices in manifest",
+                  file=sys.stderr)
+            return 1
+        return dump_fleet(slices, args.timeout)
+    if len(urls) > 1:
+        from urllib.parse import urlsplit
+
+        # label rows by host:port — full URLs truncate into
+        # indistinguishable prefixes, defeating the table's purpose
+        return dump_fleet(
+            [(urlsplit(u).netloc or u, u) for u in urls],
+            args.timeout,
+        )
+    return dump_url(urls[0], args.timeout, args.varz)
 
 
 if __name__ == "__main__":
