@@ -6,7 +6,7 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,tune,jobshop,awacs}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
@@ -17,7 +17,10 @@ docs/20_fleet.md; ``serve_mixed`` is the heterogeneous-traffic
 mix measuring wave-packing occupancy and padding waste,
 docs/14_wave_packing.md; ``sweep`` races fixed-R against adaptive-R
 sequential stopping on the M/G/1 grid, docs/16_sweeps.md; ``tandem``
-is the two-station Jackson network over its scenario grid);
+is the two-station Jackson network over its scenario grid; ``tune``
+runs the schedule-autotuner search on mm1 + the step probe and
+reports winner-vs-default speedup with the noise floor alongside,
+docs/21_autotune.md);
 ``--config all`` runs the whole battery, one JSON line each (BASELINE.json
 configs[0..4]).  Only mm1 has a published machine-wide rate, so only mm1
 reports a non-null vs_baseline; the others carry the published reference
@@ -673,28 +676,123 @@ class _dispatch_arm:
         _cfg.XLA_PACK, _cfg.EVENTSET_HIER = self._prev
 
 
+def _arm_repeats():
+    """Best-of-k depth for the interleaved arm batteries (matching the
+    stream/telemetry arms' CPU-vs-accelerator defaults)."""
+    return max(1, int(os.environ.get(
+        "CIMBA_BENCH_ARM_REPEATS", "2" if not _accel() else "1"
+    )))
+
+
+def _measure_dispatch_arms(spec_of, init_one_of, R, warm_args, real_args,
+                           prof):
+    """The packed+hierarchical-vs-flat battery on ONE timing
+    implementation: ``tune.measure.measure_arms`` (docs/21_autotune.md)
+    — each arm's trace+warm is its untimed prepare leg, the timed
+    rounds interleave both arms best-of-k at the same args, and the
+    watchdog heartbeat refreshes per round.  Returns ``(report,
+    {arm: {events, failed, rate, wall_s, compile_s}})``."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.tune import measure as _tm
+
+    fns = {}
+
+    def make(arm):
+        def prepare(arm=arm):
+            with _cfg.profile(prof), _dispatch_arm(arm):
+                spec = spec_of()
+                init_one = init_one_of(spec)
+                run = cl.make_run(spec)
+
+                def experiment(args):
+                    def one(rep):
+                        return run(init_one(rep, args))
+
+                    sims = jax.vmap(one)(jnp.arange(R))
+                    return (
+                        jnp.sum(sims.n_events.astype(jnp.int64)),
+                        jnp.sum((sims.err != 0).astype(jnp.int32)),
+                    )
+
+                fn = jax.jit(experiment)
+                jax.block_until_ready(fn(warm_args))
+                fns[arm] = fn
+
+        def runf(arm=arm):
+            out = fns[arm](real_args)
+            jax.block_until_ready(out)
+            return {"events": int(out[0]), "failed": int(out[1])}
+
+        return _tm.Arm(name=arm, run=runf, prepare=prepare)
+
+    report = _tm.measure_arms(
+        [make("packed_hier"), make("flat")],
+        repeats=_arm_repeats(), noise_twin=False,
+        on_round=lambda r: _heartbeat(),
+    )
+    out = {}
+    for res in report.arms:
+        pay = res.payload or {}
+        out[res.name] = {
+            "events": pay.get("events"),
+            "failed": pay.get("failed"),
+            "rate": res.rate(pay.get("events")),
+            "wall_s": res.best_wall,
+            "compile_s": res.compile_s,
+        }
+    return report, out
+
+
 def _mm1_xla_arms(R, N, prof="f64", stream=True):
-    """Measure the mm1 XLA path in BOTH dispatch arms at the same R x N;
-    returns (best_rate, detail-of-best) with the per-arm numbers under
-    ``detail.dispatch_arms`` — the packed+hierarchical-vs-flat battery
-    the headline now always carries — and (``stream=True``) the
-    chunked/streamed arm at the same R x N under ``detail.stream_arm``
-    (docs/12_streaming.md)."""
-    arms = {}
-    best = None
-    for arm in ("packed_hier", "flat"):
-        rate, detail = _mm1_xla(R, N, prof, arm=arm)
-        arms[arm] = {
-            "events_per_sec": rate,
-            "wall_s": detail["wall_s"],
+    """Measure the mm1 XLA path in BOTH dispatch arms at the same
+    R x N — interleaved best-of-k through
+    ``tune.measure.measure_arms`` (one timing implementation in the
+    repo, docs/21_autotune.md); returns (best_rate, detail-of-best)
+    with the per-arm numbers under ``detail.dispatch_arms`` and
+    (``stream=True``) the chunked/streamed arm at the same R x N under
+    ``detail.stream_arm`` (docs/12_streaming.md)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.models import mm1
+
+    report, measured = _measure_dispatch_arms(
+        lambda: mm1.build(record=False)[0],
+        lambda spec: (
+            lambda rep, n: cl.init_sim(spec, 2026, rep, mm1.params(n))
+        ),
+        R, jnp.int32(1), jnp.int32(N), prof,
+    )
+    arms = {
+        name: {
+            "events_per_sec": m["rate"],
+            "wall_s": m["wall_s"],
             "replications": R,
             "objects_per_replication": N,
-            "failed_replications": detail["failed_replications"],
+            "failed_replications": m["failed"],
+            "repeats_best_of": report.rounds_done,
         }
-        if best is None or rate > best[0]:
-            best = (rate, detail)
-    rate, detail = best
-    detail["dispatch_arms"] = arms
+        for name, m in measured.items()
+    }
+    best_arm = max(
+        (n for n in measured if measured[n]["rate"]),
+        key=lambda n: measured[n]["rate"],
+    )
+    m = measured[best_arm]
+    rate = m["rate"]
+    detail = {
+        "path": "xla_while",
+        "profile": prof,
+        "dispatch_arm": best_arm,
+        "replications": R,
+        "objects_per_replication": N,
+        "total_events": m["events"],
+        "wall_s": m["wall_s"],
+        "failed_replications": m["failed"],
+        "dispatch_arms": arms,
+    }
+    if m["failed"]:
+        with _cfg.profile(prof):
+            spec, _ = mm1.build(record=False)
+            detail["regrow"] = _regrow_pass(spec, mm1.params(N), R)
     if stream and os.environ.get("CIMBA_BENCH_STREAM", "1") != "0":
         try:
             detail["stream_arm"] = _mm1_stream_arm(R, N, prof, rate)
@@ -744,6 +842,8 @@ def _telemetry_overhead_arm(spec, R, wave, chunk, N, cache):
     from cimba_tpu.models import mm1
     from cimba_tpu.runner import experiment as ex
 
+    from cimba_tpu.tune import measure as _tm
+
     repeats = max(1, int(os.environ.get(
         "CIMBA_BENCH_TEL_REPEATS", "2" if not _accel() else "1"
     )))
@@ -754,29 +854,30 @@ def _telemetry_overhead_arm(spec, R, wave, chunk, N, cache):
         interval=interval, spans=True, span_path=span_path,
     )
     tel.start()
-    off_wall = on_wall = None
-    ev_off = ev_on = 0
+
+    def run_arm(telemetry):
+        def run():
+            st = ex.run_experiment_stream(
+                spec, mm1.params(N), R, wave_size=wave,
+                chunk_steps=chunk, seed=2026, program_cache=cache,
+                telemetry=telemetry,
+            )
+            return int(jax.block_until_ready(st.total_events))
+
+        return run
+
     try:
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            st = ex.run_experiment_stream(
-                spec, mm1.params(N), R, wave_size=wave,
-                chunk_steps=chunk, seed=2026, program_cache=cache,
-            )
-            ev_off = int(jax.block_until_ready(st.total_events))
-            dt = time.perf_counter() - t0
-            off_wall = dt if off_wall is None else min(off_wall, dt)
-            _heartbeat()
-            t0 = time.perf_counter()
-            st = ex.run_experiment_stream(
-                spec, mm1.params(N), R, wave_size=wave,
-                chunk_steps=chunk, seed=2026, program_cache=cache,
-                telemetry=tel,
-            )
-            ev_on = int(jax.block_until_ready(st.total_events))
-            dt = time.perf_counter() - t0
-            on_wall = dt if on_wall is None else min(on_wall, dt)
-            _heartbeat()
+        # interleaved best-of-k through tune.measure.measure_arms (the
+        # one timing implementation, docs/21_autotune.md); the caller's
+        # warm cache keeps compiles out of every timed round
+        report = _tm.measure_arms(
+            [
+                _tm.Arm("telemetry_off", run_arm(None)),
+                _tm.Arm("telemetry_on", run_arm(tel)),
+            ],
+            repeats=repeats, noise_twin=False,
+            on_round=lambda r: _heartbeat(),
+        )
     finally:
         tel.close()
         try:
@@ -784,14 +885,17 @@ def _telemetry_overhead_arm(spec, R, wave, chunk, N, cache):
                 span_lines = sum(1 for _ in f)
         finally:
             os.unlink(span_path)
+    off = report.arm("telemetry_off")
+    on = report.arm("telemetry_on")
+    ev_off, ev_on = off.payload, on.payload
     assert ev_on == ev_off, (
         f"telemetry arm changed the event count: {ev_on} != {ev_off} — "
         "telemetry must never perturb programs"
     )
-    rate_off = ev_off / off_wall
-    rate_on = ev_on / on_wall
+    rate_off = ev_off / off.best_wall
+    rate_on = ev_on / on.best_wall
     return {
-        "repeats_best_of": repeats,
+        "repeats_best_of": report.rounds_done,
         "sampler_interval_s": interval,
         "events_per_sec_off": rate_off,
         "events_per_sec_on": rate_on,
@@ -1935,25 +2039,32 @@ def bench_mg1():
             return cl.init_sim(spec, 2026, rep, lane)
 
         # the packed+hierarchical-vs-flat battery runs the sweep too
-        # (same R x N per arm), so the layout cost is measured on a
-        # second model class beside the mm1 headline
-        arms = {}
-        best = None
-        for arm in ("packed_hier", "flat"):
-            with _dispatch_arm(arm):
-                ev, failed, wall = _time_vmapped(
-                    spec, init_one, R, warm, params
-                )
-            arms[arm] = {
-                "events_per_sec": ev / wall,
-                "wall_s": wall,
+        # (same R x N per arm, interleaved best-of-k through
+        # tune.measure.measure_arms — one timing implementation), so
+        # the layout cost is measured on a second model class beside
+        # the mm1 headline
+        report, measured = _measure_dispatch_arms(
+            lambda: spec, lambda s: init_one, R, warm, params, prof,
+        )
+        arms = {
+            name: {
+                "events_per_sec": m["rate"],
+                "wall_s": m["wall_s"],
                 "replications": R,
                 "objects_per_replication": N,
-                "failed_replications": failed,
+                "failed_replications": m["failed"],
+                "repeats_best_of": report.rounds_done,
             }
-            if best is None or ev / wall > best[0]:
-                best = (ev / wall, arm, ev, failed, wall)
-        rate, arm, ev, failed, wall = best
+            for name, m in measured.items()
+        }
+        arm = max(
+            (n for n in measured if measured[n]["rate"]),
+            key=lambda n: measured[n]["rate"],
+        )
+        m = measured[arm]
+        rate, ev, failed, wall = (
+            m["rate"], m["events"], m["failed"], m["wall_s"],
+        )
         detail = {
             "cells": "4cv x 5rho",
             "sweep_grid": {
@@ -2267,6 +2378,118 @@ def bench_awacs():
     _line("awacs_events_per_sec", ev / wall, None, detail)
 
 
+def bench_tune():
+    """The schedule-autotuner battery (docs/21_autotune.md): run the
+    budgeted search over the dispatch-knob arms on TWO workloads — the
+    mm1 headline shape and the mutation-bursty step probe
+    (``cimba_tpu/tune/probe.py``, whose hand-frozen default BENCH_NOTES
+    round 6 proved wrong: the hierarchical event-set loses on
+    re-arm-heavy workloads).  Every arm is bitwise-pinned against the
+    default schedule inside the search; the line reports, per
+    workload, the winner-vs-default speedup WITH the measured
+    self-vs-self noise floor printed alongside (a win below the floor
+    HOLDs the default — honesty over trophies).  With
+    ``CIMBA_PROGRAM_STORE`` set, a winning schedule persists into the
+    store manifest and every serving entry point resolves it from then
+    on (``CIMBA_TUNE=0`` opts out).  Knobs:
+    ``CIMBA_BENCH_TUNE_REPEATS`` (best-of-k depth),
+    ``CIMBA_BENCH_TUNE_BUDGET_S`` (per-workload wall budget —
+    successive halving past it), ``CIMBA_BENCH_TUNE_PROBE_R``."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import tune as _tune
+    from cimba_tpu.serve import store as pstore
+    from cimba_tpu.models import mm1
+    from cimba_tpu.tune import probe as _tprobe
+    from cimba_tpu.tune.space import Schedule
+
+    prof = _bench_profile()
+    R, N = _scale(*((4096, 2000) if _accel() else (256, 500)))
+    repeats = max(1, int(os.environ.get(
+        "CIMBA_BENCH_TUNE_REPEATS", "2" if not _accel() else "1"
+    )))
+    budget = float(os.environ.get("CIMBA_BENCH_TUNE_BUDGET_S", "600"))
+    out_dir = os.environ.get("CIMBA_BENCH_RUN_CARD") or None
+    # the bench arms: the round-6 dispatch knobs plus the chunk grid
+    # (each a distinct compiled program — the full default_space grid
+    # is a hardware-campaign budget, not a battery's)
+    cands = [
+        Schedule(),
+        Schedule(eventset_hier=False),
+        Schedule(pack=True),
+        Schedule(pack=False),
+        Schedule(chunk_steps=256),
+        Schedule(chunk_steps=4096),
+    ]
+
+    def one(name, spec, params, reps, warm_params, t_end=None):
+        _heartbeat()
+        rep = _tune.search_schedule(
+            spec, params, reps,
+            candidates=cands, seed=2026, t_end=t_end,
+            warm_params=warm_params, repeats=repeats, budget_s=budget,
+            out_dir=out_dir, workload_label=name,
+            on_round=lambda r: _heartbeat(),
+        )
+        _heartbeat()
+        saved = None
+        st = pstore.default_store()
+        if st is not None and rep.decision == "tuned":
+            saved = _tune.save_tuned(st, spec, reps, rep) is not None
+        return rep, {
+            "decision": rep.decision,
+            "winner": rep.winner.to_json(),
+            "winner_arm": rep.winner_name,
+            "speedup_frac": rep.speedup_frac,
+            "noise_floor_frac": rep.noise_floor_frac,
+            "bucket": rep.bucket,
+            "all_pinned": all(
+                row["pinned"] is not False for row in rep.arms
+            ),
+            "persisted": saved,
+            "arms": [
+                {
+                    "name": row["name"],
+                    "status": row["status"],
+                    "best_wall_s": row["best_wall_s"],
+                    "rate": row["rate"],
+                    "compile_s": row["compile_s"],
+                    "pinned": row["pinned"],
+                }
+                for row in rep.arms
+            ],
+            "search_wall_s": rep.wall_s,
+        }
+
+    detail = {"profile": prof, "workloads": {}}
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        rep_mm1, detail["workloads"]["mm1"] = one(
+            "mm1", spec, mm1.params(N), R, mm1.params(1),
+        )
+        probe_R = int(os.environ.get("CIMBA_BENCH_TUNE_PROBE_R", "64"))
+        pspec, _ = _tprobe.build()
+        rep_probe, detail["workloads"]["step_probe"] = one(
+            "step_probe", pspec, None, probe_R, None,
+            t_end=float(os.environ.get(
+                "CIMBA_BENCH_TUNE_PROBE_T", str(_tprobe.DEFAULT_T_END)
+            )),
+        )
+    best = max(
+        detail["workloads"].values(), key=lambda w: w["speedup_frac"],
+    )
+    detail["headline"] = (
+        "winner-vs-default speedup on the best workload; HOLD "
+        "decisions report 0 — the floor is printed per workload"
+    )
+    _line(
+        "tune_winner_speedup_frac",
+        best["speedup_frac"],
+        None,
+        detail,
+        unit="frac",
+    )
+
+
 CONFIGS = {
     "mm1": bench_mm1,
     "mm1_stream": bench_mm1_stream,
@@ -2279,6 +2502,7 @@ CONFIGS = {
     "mg1": bench_mg1,
     "sweep": bench_sweep,
     "tandem": bench_tandem,
+    "tune": bench_tune,
     "jobshop": bench_jobshop,
     "awacs": bench_awacs,
 }

@@ -111,6 +111,21 @@ def _noop_state():
     yield
 
 
+@contextlib.contextmanager
+def _tune_state(on: bool):
+    """The tune gate's arms: a resolved :class:`~cimba_tpu.tune.space.
+    Schedule` binds through its ``scope()`` (the config tri-states) —
+    the ON arm applies a schedule whose knob provably changes the
+    traced program (the pack arm OPPOSITE to this backend's default),
+    the OFF arm applies the empty default schedule (which must be the
+    baseline)."""
+    from cimba_tpu.tune.space import Schedule
+
+    sched = Schedule(pack=_pack_default_is_off()) if on else Schedule()
+    with sched.scope():
+        yield
+
+
 # -- the registry -------------------------------------------------------------
 
 
@@ -194,6 +209,21 @@ GATES: Tuple[Gate, ...] = (
         # only selects host-side collection and must never bind into a
         # traced program (the test_audit pin, generalized)
         ambient_env={"CIMBA_AUDIT": "1"},
+    ),
+    Gate(
+        name="tune",
+        env=("CIMBA_TUNE",),
+        program="run",
+        off_ctx=lambda: _tune_state(False),
+        on_ctx=lambda: _tune_state(True),
+        # with no tuned entry in reach (the sweep clears CIMBA_* env,
+        # so no store resolves), the env knob must be ambient-inert in
+        # BOTH states: resolution is a host-side decision that binds
+        # programs only through the Schedule scope / explicit kwargs
+        # (docs/21_autotune.md); CIMBA_TUNE=0 (tuned-resolution off)
+        # must therefore be jaxpr-identical to the default
+        ambient_env={"CIMBA_TUNE": "1"},
+        off_env={"CIMBA_TUNE": "0"},
     ),
 )
 

@@ -163,6 +163,15 @@ ENV_KNOBS = {
             "program's audit arm is an explicit argument — the env var "
             "only selects host-side collection, pinned ambient-inert)",
     ),
+    "CIMBA_TUNE": dict(
+        default="1", trace_gate=True,
+        doc="tuned-schedule resolution (tune/registry.py): =0 opts "
+            "every entry point out of resolving searched dispatch "
+            "schedules from the program store — programs are then "
+            "jaxpr-identical to the hand-frozen defaults (the 'tune' "
+            "gate in check/gates.py pins this); explicit kwargs "
+            "always win either way (docs/21_autotune.md)",
+    ),
     # kernel-path knobs: Mosaic programs, covered by the dedicated
     # kernel parity batteries (test_mosaic_aot / test_pallas_run), not
     # the XLA-path gate sweep (interpret-mode tracing is over tier-1

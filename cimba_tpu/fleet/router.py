@@ -729,7 +729,13 @@ class FleetRouter:
             "n_replications": int(req.n_replications),
             "seed": int(req.seed),
             "t_end": req.t_end,
-            "chunk_steps": int(req.chunk_steps),
+            # None rides the wire: the SLICE's service then resolves
+            # the tuned schedule against its own store at submit time
+            # (docs/21_autotune.md — fleet slices run the searched
+            # schedule with zero router configuration)
+            "chunk_steps": (
+                None if req.chunk_steps is None else int(req.chunk_steps)
+            ),
             "wave_size": (
                 None if req.wave_size is None else int(req.wave_size)
             ),

@@ -242,7 +242,12 @@ class _SliceServer:
                 int(header["n_replications"]),
                 seed=int(header.get("seed", 0)),
                 t_end=header.get("t_end"),
-                chunk_steps=int(header.get("chunk_steps", 1024)),
+                # None = unset: the service resolves the tuned schedule
+                # for this slice's store at submit (docs/21_autotune.md)
+                chunk_steps=(
+                    None if header.get("chunk_steps") is None
+                    else int(header["chunk_steps"])
+                ),
                 wave_size=header.get("wave_size"),
                 priority=int(header.get("priority", 0)),
                 deadline=header.get("deadline"),

@@ -347,11 +347,17 @@ def run_card(
     telemetry: Optional[dict] = None,
     program_key: Optional[str] = None,
     label: Optional[str] = None,
+    schedule: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble one run card (omitted blocks are left out, not nulled)
     and stamp its content digest.  ``spec`` may be a ModelSpec (hashed
-    via :func:`spec_block`) or a pre-built dict."""
+    via :func:`spec_block`) or a pre-built dict.  ``schedule`` is the
+    resolved dispatch-schedule block (docs/21_autotune.md — knobs +
+    resolution source + tuned-entry digest), so every bitwise claim
+    names the schedule it ran under; :func:`diff_cards` treats drift
+    there as environment drift, never divergence (schedules change
+    speed, not results)."""
     card: dict = {
         "format": CARD_FORMAT,
         "kind": str(kind),
@@ -368,6 +374,8 @@ def run_card(
         card["geometry"] = geometry
     if program_key is not None:
         card["program_key"] = program_key
+    if schedule is not None:
+        card["schedule"] = schedule
     if digest_trail is not None:
         card["digest_trail"] = digest_trail
     if result_digest is not None:
@@ -489,6 +497,15 @@ _GEOMETRY_KEYS = (
     "pack", "mesh", "with_metrics",
 )
 
+#: geometry keys that are SCHEDULE knobs with bitwise-invariant results
+#: (docs/21_autotune.md): when both cards carry a ``schedule`` block,
+#: drift here is env drift (a different tuned schedule ran), not
+#: incomparability — chunk boundaries move, so the TRAIL comparison is
+#: skipped, but the result digests must still be equal.  ``wave_size``
+#: stays a hard geometry key: the pooled summary's merge order follows
+#: the wave partition, so cross-wave-size results legitimately differ.
+_SCHEDULE_GEOMETRY_KEYS = ("chunk_steps", "pack")
+
 
 # cimba-check: content-path
 def diff_cards(a: dict, b: dict) -> dict:
@@ -499,8 +516,16 @@ def diff_cards(a: dict, b: dict) -> dict:
       drift) and a digest comparison would be meaningless;
     * ``env_drift`` — environment keys that differ (jax/jaxlib/
       backend/x64/...): reported, but not blocking — cross-environment
-      divergence is exactly what an audit is for;
-    * ``first_divergence`` — :func:`diff_trails` on the digest trails;
+      divergence is exactly what an audit is for.  Dispatch-SCHEDULE
+      drift (docs/21_autotune.md — a different tuned/override schedule
+      ran) reports here too, as ``schedule.<knob>`` entries, never as
+      divergence: schedules change speed, not results;
+    * ``schedule_drift`` — the drifted schedule-block keys by
+      themselves (``[]`` when both cards ran the same schedule or
+      either card predates schedule blocks);
+    * ``first_divergence`` — :func:`diff_trails` on the digest trails
+      (skipped, with ``trail_skipped`` set, when the schedule drift
+      moved the chunk boundaries — the RESULT digests still compare);
     * ``result_equal`` — result-digest equality (None when either card
       carries none);
     * ``identical`` — comparable, no trail divergence, and results not
@@ -515,25 +540,46 @@ def diff_cards(a: dict, b: dict) -> dict:
         reasons.append(
             f"kind differs ({a.get('kind')!r} vs {b.get('kind')!r})"
         )
+    sa, sb = a.get("schedule"), b.get("schedule")
+    schedule_drift: List[str] = []
+    if isinstance(sa, dict) and isinstance(sb, dict):
+        ka = dict(sa.get("knobs") or {}, source=sa.get("source"))
+        kb = dict(sb.get("knobs") or {}, source=sb.get("source"))
+        schedule_drift = sorted(
+            k for k in set(ka) | set(kb) if ka.get(k) != kb.get(k)
+        )
     ga, gb = a.get("geometry") or {}, b.get("geometry") or {}
-    geo_drift = [
+    geo_drift_all = [
         k for k in _GEOMETRY_KEYS
         if k in ga and k in gb and ga[k] != gb[k]
     ]
+    # schedule-owned, bitwise-invariant geometry keys: with schedule
+    # blocks on both cards they are env-class drift (the schedule
+    # changed), not incomparability — results must still match
+    sched_geo = [
+        k for k in geo_drift_all
+        if k in _SCHEDULE_GEOMETRY_KEYS
+        and isinstance(sa, dict) and isinstance(sb, dict)
+    ]
+    geo_drift = [k for k in geo_drift_all if k not in sched_geo]
     if geo_drift:
         reasons.append("geometry differs: " + ", ".join(geo_drift))
     ea, eb = a.get("env") or {}, b.get("env") or {}
     env_drift = sorted(
         k for k in set(ea) | set(eb) if ea.get(k) != eb.get(k)
     )
+    env_drift += [f"schedule.{k}" for k in schedule_drift]
     seeds_differ = (
         a.get("seed_schedule") is not None
         and b.get("seed_schedule") is not None
         and a["seed_schedule"] != b["seed_schedule"]
     )
-    divergence = diff_trails(
-        a.get("digest_trail") or [], b.get("digest_trail") or []
-    )
+    trail_skipped = bool(sched_geo)
+    divergence = None
+    if not trail_skipped:
+        divergence = diff_trails(
+            a.get("digest_trail") or [], b.get("digest_trail") or []
+        )
     ra, rb = a.get("result_digest"), b.get("result_digest")
     result_equal = None if (ra is None or rb is None) else (ra == rb)
     comparable = not reasons
@@ -541,8 +587,10 @@ def diff_cards(a: dict, b: dict) -> dict:
         "comparable": comparable,
         "reasons": reasons,
         "env_drift": env_drift,
+        "schedule_drift": schedule_drift,
         "seeds_differ": seeds_differ,
         "first_divergence": divergence,
+        "trail_skipped": trail_skipped,
         "result_equal": result_equal,
         "trail_len": (
             len(a.get("digest_trail") or []),

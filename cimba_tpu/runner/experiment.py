@@ -542,7 +542,7 @@ def run_experiment_stream(
     mesh: Optional[Mesh] = None,
     t_end: Optional[float] = None,
     pack: Optional[bool] = None,
-    chunk_steps: int = 1024,
+    chunk_steps: Optional[int] = None,
     poll_every: int = 4,
     summary_path=default_summary_path,
     max_regrows: int = 0,
@@ -551,6 +551,7 @@ def run_experiment_stream(
     telemetry=None,
     program_cache: Optional[dict] = None,
     audit=None,
+    schedule=None,
 ) -> StreamResult:
     """Pooled statistics for R replications with R beyond the
     per-dispatch lane budget: stream waves of ``wave_size`` lanes
@@ -633,7 +634,71 @@ def run_experiment_stream(
     clean same-seed runs produce identical trails and the same card
     digest; ``tools/audit_diff.py`` localizes any divergence to its
     first (wave, chunk, carry-class).
+
+    ``schedule`` / tuned resolution (docs/21_autotune.md): the
+    dispatch knobs left unset here — ``pack``, ``chunk_steps``
+    (default 1024), ``wave_size``, and the trace-time event-set
+    layout — resolve through :func:`cimba_tpu.tune.registry.
+    resolve_entry` at program-build time: an explicit
+    ``schedule=``:class:`~cimba_tpu.tune.space.Schedule` binds exactly
+    that schedule (the search harness's arm dispatch); otherwise, with
+    ``CIMBA_TUNE`` on (the default) and a program store in reach
+    (``program_cache.store`` / ``CIMBA_PROGRAM_STORE``), a searched
+    winner for this (spec, backend, workload bucket) fills the unset
+    knobs.  Explicit kwargs ALWAYS win, ``CIMBA_TUNE=0`` restores the
+    hand-frozen defaults bitwise, and the resolution source
+    (tuned/default/override) is recorded in the run card's
+    ``schedule`` block when auditing.
     """
+    from cimba_tpu.serve import cache as _pcache_r
+    from cimba_tpu.tune import registry as _tune_reg
+
+    store = None
+    if isinstance(program_cache, _pcache_r.ProgramCache):
+        # respect an explicitly opted-out cache (store=False)
+        store = program_cache._store
+    rs = _tune_reg.resolve_entry(
+        spec, n_replications, schedule=schedule, pack=pack,
+        chunk_steps=chunk_steps, wave_size=wave_size, store=store,
+    )
+    with rs.scope():
+        return _stream_impl(
+            spec, params, n_replications,
+            wave_size=rs.wave_size, seed=seed, mesh=mesh, t_end=t_end,
+            pack=rs.pack, chunk_steps=rs.chunk_steps,
+            poll_every=poll_every, summary_path=summary_path,
+            max_regrows=max_regrows, on_wave=on_wave,
+            on_chunk=on_chunk, telemetry=telemetry,
+            program_cache=program_cache, audit=audit,
+            sched_block=rs.block(),
+        )
+
+
+def _stream_impl(
+    spec: ModelSpec,
+    params: Any,
+    n_replications: int,
+    *,
+    wave_size: Optional[int] = None,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    t_end: Optional[float] = None,
+    pack: Optional[bool] = None,
+    chunk_steps: int = 1024,
+    poll_every: int = 4,
+    summary_path=default_summary_path,
+    max_regrows: int = 0,
+    on_wave=None,
+    on_chunk=None,
+    telemetry=None,
+    program_cache: Optional[dict] = None,
+    audit=None,
+    sched_block: Optional[dict] = None,
+) -> StreamResult:
+    """The stream runner's body (see :func:`run_experiment_stream`,
+    which resolves the schedule and enters its trace-time scope before
+    delegating here — program keys and traces below must see the
+    bound knobs)."""
     import dataclasses
 
     import numpy as np
@@ -807,6 +872,7 @@ def run_experiment_stream(
             },
             program_key=pkey,
             result_digest=_obs_audit.stream_result_digest(result),
+            schedule=sched_block,
             telemetry=(
                 telemetry.snapshot() if telemetry is not None else None
             ),

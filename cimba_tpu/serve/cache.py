@@ -593,14 +593,30 @@ def warm(
         cache._store = st
     mesh = stream_kwargs.pop("mesh", None)
     pack = stream_kwargs.pop("pack", None)
-    chunk_steps = stream_kwargs.pop("chunk_steps", 1024)
+    chunk_steps = stream_kwargs.pop("chunk_steps", None)
     summary_path = stream_kwargs.pop("summary_path", None)
+    n_replications = stream_kwargs.pop("n_replications", None)
     if stream_kwargs:
         raise TypeError(
             "serve.warm(manifest=...): unsupported kwargs in AOT mode: "
             f"{sorted(stream_kwargs)} (only mesh/pack/chunk_steps/"
-            "summary_path select a program)"
+            "summary_path/n_replications select a program)"
         )
+    if chunk_steps is None or pack is None:
+        # tuned-schedule resolution at program-build time
+        # (docs/21_autotune.md): the hydrated program key must be the
+        # one the service will actually dispatch, and the service
+        # resolves at the REQUEST's workload bucket — pass
+        # ``n_replications=`` when requests will carry a different R
+        # than ``wave_size`` (the bucket is pow2(R), not pow2(wave)),
+        # or explicit kwargs to pin the knobs outright
+        from cimba_tpu.tune import registry as _tune_reg
+
+        rs = _tune_reg.resolve_entry(
+            spec, int(n_replications or wave_size or 0), pack=pack,
+            chunk_steps=chunk_steps, store=st,
+        )
+        chunk_steps, pack = rs.chunk_steps, rs.pack
     if summary_path is None:
         summary_path = ex.default_summary_path
     with_metrics = _metrics.enabled()

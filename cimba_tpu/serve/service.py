@@ -153,7 +153,17 @@ class Request:
     delivered either way, but a mismatch bumps the service's
     ``digest_mismatches`` counter, marks the request's span tree, and
     flips ``/healthz`` to degraded — determinism regressions surface
-    in the fleet's monitoring, not just in pytest."""
+    in the fleet's monitoring, not just in pytest.
+
+    ``chunk_steps=None`` / ``pack=None`` (the defaults) resolve
+    through the tuned-schedule registry at submit time
+    (docs/21_autotune.md): with ``CIMBA_TUNE`` on and the service's
+    program store carrying a searched winner for this (spec, backend,
+    workload bucket), the winner's argument knobs fill in; otherwise
+    the historical defaults (``chunk_steps=1024``, backend-auto pack)
+    run unchanged.  Explicit values always win, and the resolution
+    source (tuned/default/override) surfaces per class in
+    ``Service.stats()["schedule"]`` and ``/varz``."""
 
     spec: Any
     params: Any
@@ -161,7 +171,7 @@ class Request:
     seed: int = 0
     t_end: Optional[float] = None
     pack: Optional[bool] = None
-    chunk_steps: int = 1024
+    chunk_steps: Optional[int] = None
     wave_size: Optional[int] = None
     summary_path: Optional[Callable] = None
     priority: int = 0
@@ -314,7 +324,7 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
-    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules
 
     def __init__(
         self,
@@ -370,6 +380,11 @@ class Service:
             self._counters[o] = 0
         self._occupancy: dict = {}       # requests-per-batch -> count
         self._class_ids: dict = {}       # class key -> short label
+        # tuned-schedule resolution accounting (docs/21_autotune.md)
+        self._sched_sources = {
+            "tuned": 0, "default": 0, "override": 0, "off": 0,
+        }
+        self._schedules: dict = {}       # class label -> resolved block
         self._ttfw_sum = 0.0
         self._ttfw_max = 0.0
         self._ttfw_n = 0
@@ -403,6 +418,38 @@ class Service:
             R, self.max_wave if request.wave_size is None
             else int(request.wave_size),
         )
+        # tuned-schedule resolution (docs/21_autotune.md): the ARGUMENT
+        # knobs left unset resolve against the service's program store
+        # at submit time, BEFORE the compatibility class binds — the
+        # class must describe the program that will actually dispatch.
+        # ``wave_size`` is passed as the already-effective value (a
+        # Request's None has always meant "the service's max_wave", an
+        # explicit policy, not an unset knob — a tuned wave_size never
+        # applies here and never claims the 'tuned' source).  Trace-
+        # time knobs — event-set layout — are process-level on the
+        # serve path: set the CIMBA_EVENTSET_* env/config state the
+        # tuner recommends; the dispatcher never flips globals under
+        # concurrent traffic.  Explicit values always win.
+        import dataclasses as _dc
+
+        from cimba_tpu.tune import registry as _tune_reg
+
+        _store = (
+            self.cache._store
+            if isinstance(self.cache, _pcache.ProgramCache) else None
+        )
+        rs = _tune_reg.resolve_entry(
+            request.spec, R, pack=request.pack,
+            chunk_steps=request.chunk_steps, wave_size=eff_wave,
+            store=_store,
+        )
+        if (request.chunk_steps, request.pack) != (
+            rs.chunk_steps, rs.pack
+        ):
+            # normalize a COPY — the caller's Request is never mutated
+            request = _dc.replace(
+                request, chunk_steps=rs.chunk_steps, pack=rs.pack,
+            )
         if eff_wave <= 0:
             raise ValueError(
                 f"wave_size must be positive, got {request.wave_size}"
@@ -430,9 +477,13 @@ class Service:
                 )
             self._counters["submitted"] += 1
             self._seq += 1
-            self._class_ids.setdefault(
+            label = self._class_ids.setdefault(
                 cls, f"class{len(self._class_ids)}"
             )
+            self._sched_sources[rs.source] = (
+                self._sched_sources.get(rs.source, 0) + 1
+            )
+            self._schedules[label] = rs.block()
             entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
             self._outstanding += 1
@@ -577,6 +628,14 @@ class Service:
                     self._ttfw_sum / self._ttfw_n if self._ttfw_n else 0.0
                 ),
                 "max_s": self._ttfw_max,
+            }
+            # which dispatch schedule each class runs, and where it
+            # came from (docs/21_autotune.md) — ``/varz`` carries this
+            # dict verbatim, so "is the fleet on the searched
+            # schedule?" is one scrape away
+            out["schedule"] = {
+                "sources": dict(self._sched_sources),
+                "by_class": dict(self._schedules),
             }
         if hasattr(self.cache, "stats"):
             out["program_cache"] = self.cache.stats()

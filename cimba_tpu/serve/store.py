@@ -569,8 +569,16 @@ class ProgramStore:
     Layout::
 
         <root>/manifest.json     entries: key -> {env, programs, meta}
+                                 tuned:   key -> {env, schedule, meta}
         <root>/artifacts/*.bin   pickled (payload, in_tree, out_tree)
         <root>/xla/              mechanism (a)'s compilation cache
+
+    The ``tuned`` section is the schedule-autotuner registry
+    (docs/21_autotune.md, written/read via
+    :mod:`cimba_tpu.tune.registry`): searched dispatch-schedule
+    winners keyed by (value-based spec fingerprint, backend, device
+    kind, workload bucket), invalidated by environment drift exactly
+    like artifacts.
 
     Writes are crash-atomic (mkstemp + fsync + ``os.replace`` — the
     checkpoint discipline): a killed save leaves the previous manifest
@@ -605,6 +613,13 @@ class ProgramStore:
             "downgrades": 0,
             "fallback_shapes": 0,
             "artifact_dispatches": 0,
+            # the tuned-schedule registry (docs/21_autotune.md): the
+            # manifest's "tuned" section rides the same lock + atomic
+            # write + env invalidation ladder as the artifacts
+            "tuned_saves": 0,
+            "tuned_hits": 0,
+            "tuned_misses": 0,
+            "tuned_invalidated": 0,
         }
 
     # -- observability -------------------------------------------------------

@@ -223,7 +223,7 @@ def run_sweep(
     max_wave: int = 4096,
     t_end: Optional[float] = None,
     pack: Optional[bool] = None,
-    chunk_steps: int = 1024,
+    chunk_steps: Optional[int] = None,
     poll_every: int = 4,
     mesh=None,
     summary_path=None,
@@ -272,6 +272,14 @@ def run_sweep(
     request spans per (cell, round).  Host-side only: results are
     bitwise identical with or without it.
 
+    ``chunk_steps=None`` / ``pack=None`` (the defaults) resolve
+    through the tuned-schedule registry for the per-cell workload
+    bucket at program-build time (docs/21_autotune.md) — explicit
+    kwargs always win, ``CIMBA_TUNE=0`` restores the hand-frozen
+    defaults bitwise, and the resolved block lands in the sweep run
+    card's ``schedule`` section; serve-backed sweeps defer resolution
+    to the service's own submit path.
+
     ``audit`` (docs/18_audit.md): ``None`` defers to ``CIMBA_AUDIT``;
     when enabled, the result carries a content-addressed run card in
     ``.audit`` with the full per-cell seed schedule (every
@@ -293,6 +301,28 @@ def run_sweep(
     R0 = int(reps_per_cell)
     if R0 <= 0:
         raise ValueError(f"reps_per_cell must be positive, got {R0}")
+    # tuned-schedule resolution at program-build time
+    # (docs/21_autotune.md): the ARGUMENT knobs left unset resolve
+    # against the program store for the per-cell workload bucket (a
+    # cell runs as an R0-sized stream).  Explicit kwargs always win;
+    # CIMBA_TUNE=0 restores the hand-frozen defaults bitwise.  Serve-
+    # backed sweeps leave resolution to the service's own submit path.
+    from cimba_tpu.tune import registry as _tune_reg
+
+    if service is None:
+        _store = (
+            program_cache._store
+            if hasattr(program_cache, "_store") else None
+        )
+        rs = _tune_reg.resolve_entry(
+            spec, R0, pack=pack, chunk_steps=chunk_steps, store=_store,
+        )
+        pack, chunk_steps = rs.pack, rs.chunk_steps
+        sched_block = rs.block()
+    else:
+        # serve-backed: chunk_steps=None flows into each Request and
+        # the service resolves it at submit (one resolution authority)
+        sched_block = None
     if stop is not None and max_rounds <= 0:
         raise ValueError(f"max_rounds must be positive, got {max_rounds}")
     cell_wave = R0 if cell_wave is None else int(cell_wave)
@@ -607,6 +637,7 @@ def run_sweep(
                 "serve_backed": service is not None,
             },
             cells=cells_blk,
+            schedule=sched_block,
             telemetry=(
                 telemetry.snapshot() if telemetry is not None else None
             ),

@@ -1,0 +1,328 @@
+"""The schedule space: typed axes over the existing dispatch knobs.
+
+A :class:`Schedule` is a record of the knobs one run binds; a
+:class:`ScheduleSpace` is the declarative grid of candidate schedules
+the search measures.  Nothing here is new mechanism — every field maps
+onto a knob the runner/serve/sweep entry points already accept
+(``pack=``, ``chunk_steps=``, ``wave_size=``) or a trace-time tri-state
+``cimba_tpu.config`` already exposes (``EVENTSET_HIER`` /
+``EVENTSET_BLOCK`` / ``XLA_PACK`` — the ``bench.py _dispatch_arm``
+idiom, made a first-class object).  Schedules never change results,
+only speed:
+
+* ``eventset_hier`` / ``eventset_block`` — bitwise the flat scan's
+  pick (tests/test_eventset_hier.py);
+* ``pack`` — trajectory-identical carry layout (tests/test_xla_pack.py);
+* ``chunk_steps`` — chunked trajectories ARE the monolithic ones
+  bitwise, and folds happen per wave, not per chunk (docs/12);
+* ``wave_size`` — per-lane trajectories and the exact counters are
+  identical; the pooled Pébay summary may differ in merge-ORDER
+  rounding (docs/12), which is why the search pins each candidate
+  against a default-knob twin at the candidate's OWN geometry
+  (:mod:`cimba_tpu.tune.search`);
+* ``lane_block`` — the Pallas kernel grid (``CIMBA_KERNEL_LANE_BLOCK``),
+  only meaningful where the kernel path is live.
+
+Validity predicates prune instead of measuring: the hierarchical
+event-set is structurally inert whenever the model's event capacity is
+not a >= 2x multiple of the block size (the PR 2 inertness contract) —
+for such a model every ``eventset_hier``/``eventset_block`` setting
+traces the SAME program, so :meth:`ScheduleSpace.candidates`
+canonicalizes those axes away rather than timing identical arms.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+from typing import Optional, Tuple
+
+__all__ = ["Schedule", "ScheduleSpace", "default_space"]
+
+#: schema version of the persisted schedule record — bump on field
+#: changes so stale tuned entries invalidate loudly instead of
+#: resolving garbage knobs
+SCHEDULE_FORMAT = 1
+
+#: the knob fields, in canonical order (the JSON/digest field set)
+_FIELDS = (
+    "eventset_hier", "eventset_block", "pack",
+    "chunk_steps", "wave_size", "lane_block",
+)
+
+#: schedule fields that change the *geometry* of a run (wave partition
+#: / chunk boundaries) rather than the traced step program — the
+#: search pins these against a default-knob twin at the same geometry,
+#: and ``tools/audit_diff.py`` treats drift in the bitwise-invariant
+#: ones as env drift, not divergence
+GEOMETRY_FIELDS = ("chunk_steps", "wave_size")
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """One point of the schedule space.  ``None`` per field means
+    "leave that knob at its ambient default" — so ``Schedule()`` IS
+    the default schedule, and a resolved/tuned schedule only ever
+    overrides the knobs it was actually searched over.
+
+    Delivery is two-channel, matching how the knobs already bind:
+
+    * :meth:`stream_kwargs` — the argument knobs (``pack``,
+      ``chunk_steps``, ``wave_size``) as kwargs for
+      ``run_experiment_stream`` / ``Request`` / ``run_sweep``;
+    * :meth:`scope` — the trace-time knobs (event-set layout, the
+      ambient pack default, the kernel lane block) as a context
+      manager over the ``cimba_tpu.config`` tri-states, restoring the
+      previous state on exit (the ``_dispatch_arm`` idiom).
+    """
+
+    eventset_hier: Optional[bool] = None
+    eventset_block: Optional[int] = None
+    pack: Optional[bool] = None
+    chunk_steps: Optional[int] = None
+    wave_size: Optional[int] = None
+    lane_block: Optional[int] = None
+
+    def knobs(self) -> dict:
+        """The non-default fields only (what this schedule binds)."""
+        out = {}
+        for f in _FIELDS:
+            v = getattr(self, f)
+            if v is not None:
+                out[f] = v
+        return out
+
+    def is_default(self) -> bool:
+        return not self.knobs()
+
+    def stream_kwargs(self) -> dict:
+        """The argument-knob subset: kwargs every stream-shaped entry
+        point accepts (only knobs this schedule binds appear)."""
+        out = {}
+        if self.pack is not None:
+            out["pack"] = bool(self.pack)
+        if self.chunk_steps is not None:
+            out["chunk_steps"] = int(self.chunk_steps)
+        if self.wave_size is not None:
+            out["wave_size"] = int(self.wave_size)
+        return out
+
+    @contextlib.contextmanager
+    def scope(self):
+        """Bind the trace-time knobs for the duration: the
+        ``config.EVENTSET_HIER`` / ``EVENTSET_BLOCK`` / ``XLA_PACK``
+        tri-states (set only for the fields this schedule carries)
+        plus ``CIMBA_KERNEL_LANE_BLOCK`` for the kernel grid.  Restores
+        the previous state on exit.  Like the dtype profile, these bind
+        at TRACE time: programs already compiled keep their layout, and
+        the serve/stream program keys resolve the state at key-build
+        time so a scope switch misses the cache rather than replaying a
+        stale arm (docs/11_dispatch_cost.md)."""
+        import os
+
+        from cimba_tpu import config
+
+        prev = (config.EVENTSET_HIER, config.EVENTSET_BLOCK,
+                config.XLA_PACK)
+        # the lane-block knob has no config tri-state — its documented
+        # binding point IS the env var (core/pallas_run.py reads it via
+        # env_raw), so this scope writes/restores the var itself; the
+        # suppressions below mark the one sanctioned non-env_raw site
+        prev_lane = os.environ.get("CIMBA_KERNEL_LANE_BLOCK")  # cimba: noqa(CHK005) — save/restore, not a knob read
+        try:
+            if self.eventset_hier is not None:
+                config.EVENTSET_HIER = bool(self.eventset_hier)
+            if self.eventset_block is not None:
+                config.EVENTSET_BLOCK = int(self.eventset_block)
+            if self.pack is not None:
+                config.XLA_PACK = bool(self.pack)
+            if self.lane_block is not None:
+                os.environ["CIMBA_KERNEL_LANE_BLOCK"] = str(  # cimba: noqa(CHK005) — the binding site
+                    int(self.lane_block)
+                )
+            yield self
+        finally:
+            (config.EVENTSET_HIER, config.EVENTSET_BLOCK,
+             config.XLA_PACK) = prev
+            if self.lane_block is not None:
+                if prev_lane is None:
+                    os.environ.pop("CIMBA_KERNEL_LANE_BLOCK", None)
+                else:
+                    os.environ["CIMBA_KERNEL_LANE_BLOCK"] = prev_lane  # cimba: noqa(CHK005) — restore
+
+    def canonical(self, spec=None) -> "Schedule":
+        """The structurally-effective form of this schedule for
+        ``spec``: knobs that cannot change the traced program collapse
+        to their default, so two candidates that would trace identical
+        programs compare equal and the search never times both
+        (prune, don't measure — docs/21_autotune.md).  Rules:
+
+        * a knob explicitly set to what the ambient default already
+          resolves to (hier=True under the default-on env, pack
+          matching this backend's auto, the default block size, the
+          entry points' ``chunk_steps=1024``) is the default arm;
+        * ``eventset_block`` is dead when the hierarchy resolves off;
+        * the PR 2 inertness contract: the hierarchy is structurally
+          inert unless ``event_cap`` is a >= 2x multiple of the block
+          size — below that, both event-set knobs are dead for this
+          ``spec``.
+        """
+        from cimba_tpu import config
+
+        hier, block = self.eventset_hier, self.eventset_block
+        pack, chunk = self.pack, self.chunk_steps
+        if pack is not None and bool(pack) == config.xla_pack_enabled():
+            pack = None
+        if chunk is not None and int(chunk) == 1024:
+            chunk = None
+        if hier is not None and (
+            bool(hier) == config.eventset_hier_enabled()
+        ):
+            hier = None
+        if block is not None and (
+            int(block) == config.eventset_block()
+        ):
+            block = None
+        eff_hier = (
+            bool(hier) if hier is not None
+            else config.eventset_hier_enabled()
+        )
+        if not eff_hier:
+            block = None
+        if spec is not None:
+            cap = int(getattr(spec, "event_cap", 0) or 0)
+            eff_block = (
+                int(block) if block is not None
+                else config.eventset_block()
+            )
+            # the hierarchy only materializes summary rows when the
+            # cap holds at least two full blocks (core/eventset.py) —
+            # below that every hier/block setting traces the flat
+            # program
+            if cap < 2 * eff_block:
+                hier, block = None, None
+        return dataclasses.replace(
+            self, eventset_hier=hier, eventset_block=block,
+            pack=pack, chunk_steps=chunk,
+        )
+
+    # -- persistence ---------------------------------------------------------
+
+    def to_json(self) -> dict:
+        out = {"format": SCHEDULE_FORMAT}
+        out.update({f: getattr(self, f) for f in _FIELDS})
+        return out
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Schedule":
+        if doc.get("format") != SCHEDULE_FORMAT:
+            raise ValueError(
+                f"schedule record format {doc.get('format')!r} != "
+                f"{SCHEDULE_FORMAT} — stale tuned entry (re-run the "
+                "search)"
+            )
+        kw = {}
+        for f in _FIELDS:
+            v = doc.get(f)
+            if v is not None:
+                if f in ("eventset_hier", "pack"):
+                    v = bool(v)
+                else:
+                    v = int(v)
+            kw[f] = v
+        return cls(**kw)
+
+    # cimba-check: content-path
+    def digest(self) -> str:
+        """sha256 hex of the canonical JSON — how run cards and the
+        store manifest cite one schedule by value."""
+        return hashlib.sha256(
+            json.dumps(self.to_json(), sort_keys=True).encode("utf-8")
+        ).hexdigest()
+
+    def label(self) -> str:
+        """A short human arm name: ``default`` or the bound knobs."""
+        k = self.knobs()
+        if not k:
+            return "default"
+        return ",".join(f"{n}={v}" for n, v in sorted(k.items()))
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleSpace:
+    """The declarative candidate grid: per-knob value tuples (empty =
+    the knob is not searched and stays default everywhere).  Axis
+    values of ``None`` inside a tuple mean "the default arm of that
+    knob" — every space implicitly contains the all-default schedule
+    even when no axis lists ``None``."""
+
+    eventset_hier: Tuple = ()
+    eventset_block: Tuple = ()
+    pack: Tuple = ()
+    chunk_steps: Tuple = ()
+    wave_size: Tuple = ()
+    lane_block: Tuple = ()
+
+    def axes(self) -> dict:
+        """The non-empty axes, name -> value tuple."""
+        out = {}
+        for f in _FIELDS:
+            vals = tuple(getattr(self, f))
+            if vals:
+                out[f] = vals
+        return out
+
+    def candidates(self, spec=None) -> list:
+        """Every valid, structurally-distinct :class:`Schedule` of the
+        grid, default first.  Each axis is augmented with the default
+        arm (``None``), the cartesian product is canonicalized against
+        ``spec`` (inert knob settings collapse — prune, don't
+        measure), and duplicates are dropped keeping first-seen
+        order."""
+        import itertools
+
+        axes = self.axes()
+        names = list(axes)
+        pools = [
+            (None,) + tuple(v for v in axes[n] if v is not None)
+            for n in names
+        ]
+        seen = set()
+        out = []
+        # the default schedule always leads: it is the incumbent every
+        # candidate is pinned and raced against
+        for values in itertools.product(*pools) if names else [()]:
+            sched = Schedule(**dict(zip(names, values)))
+            canon = sched.canonical(spec)
+            key = tuple(
+                getattr(canon, f) for f in _FIELDS
+            )
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(canon)
+        if not out or not out[0].is_default():
+            out.insert(0, Schedule())
+        return out
+
+
+def default_space(spec=None, *, kernel: bool = False) -> ScheduleSpace:
+    """The stock search space over the dispatch knobs of
+    docs/11_dispatch_cost.md: hierarchical event-set on/off with a
+    pow2 block grid, packed carry on/off, and a small ``chunk_steps``
+    grid around the entry points' default.  ``wave_size`` is not
+    searched by default (its pooled summary is merge-order-sensitive —
+    opt in explicitly when counts-exact statistics are what you
+    serve); ``lane_block`` joins only with ``kernel=True`` (the Pallas
+    path).  Axes that are structurally inert for ``spec`` cost nothing:
+    :meth:`ScheduleSpace.candidates` collapses them."""
+    space = ScheduleSpace(
+        eventset_hier=(True, False),
+        eventset_block=(64, 128, 256),
+        pack=(True, False),
+        chunk_steps=(256, 1024, 4096),
+        lane_block=(8, 16, 32) if kernel else (),
+    )
+    return space

@@ -19,6 +19,13 @@ def via_getenv():
     return _os.getenv(KNOB, "")  # expect: CHK005
 
 
+def unregistered_tune_knob():
+    # a CIMBA_TUNE* knob nobody registered in config.ENV_KNOBS: the
+    # static rule fires here, and config.env_raw raises KeyError at
+    # runtime (tests/test_tune.py pins the runtime half)
+    return _os.environ.get("CIMBA_TUNE_EXPERIMENTAL")  # expect: CHK005
+
+
 def non_cimba_is_fine():
     return _os.environ.get("JAX_PLATFORMS", "")
 

@@ -110,8 +110,32 @@ def main(argv=None) -> int:
             print(f"incomparable: {r}")
         if rep["env_drift"]:
             ea, eb = a.get("env") or {}, b.get("env") or {}
+
+            def _sched_view(card, k):
+                s = card.get("schedule") or {}
+                if k == "source":
+                    return s.get("source")
+                return (s.get("knobs") or {}).get(k)
+
             for k in rep["env_drift"]:
-                print(f"env drift: {k}: {ea.get(k)!r} vs {eb.get(k)!r}")
+                if k.startswith("schedule."):
+                    # a different dispatch schedule ran (docs/21):
+                    # env-class drift, never divergence
+                    knob = k.split(".", 1)[1]
+                    print(
+                        f"env drift: {k}: "
+                        f"{_sched_view(a, knob)!r} vs "
+                        f"{_sched_view(b, knob)!r}"
+                    )
+                else:
+                    print(
+                        f"env drift: {k}: {ea.get(k)!r} vs {eb.get(k)!r}"
+                    )
+        if rep.get("trail_skipped"):
+            print(
+                "trail comparison skipped: the schedule drift moved "
+                "the chunk boundaries (result digests still compared)"
+            )
         if rep["seeds_differ"]:
             print(
                 f"seed schedule differs: {a.get('seed_schedule')} vs "

@@ -12,8 +12,13 @@ checks the newest round against the previous one for a regression.
 
 Usage::
 
-    python tools/bench_history.py [--dir .] [--cards DIR]
+    python tools/bench_history.py [--dir .] [--cards DIR] [--tune DIR]
         [--metric mm1_events_per_sec] [--max-regression 10]
+
+``--tune DIR`` additionally collates the autotuner's TuneReport JSONs
+(``tunereport_*.json``, docs/21_autotune.md) into a per-(spec
+fingerprint, backend, workload-bucket) winner table beside the BENCH
+rounds, flagging groups whose winning schedule CHURNS across rounds.
 
 Exit codes: 0 ok, 1 regression beyond ``--max-regression`` percent,
 2 nothing to collate.  Stdlib-only (no jax import) — safe in any CI
@@ -53,19 +58,93 @@ def load_rounds(d):
     return out
 
 
-def load_cards(d):
-    """Run cards under ``d`` as [(path, card)] — malformed files are
-    warned about, never fatal."""
+def _load_json_dir(d, pattern):
+    """Every ``pattern`` JSON object under ``d`` as [(path, doc)] —
+    malformed files are warned about, never fatal (the one loader run
+    cards and TuneReports share)."""
     out = []
-    for path in sorted(glob.glob(os.path.join(d, "runcard_*.json"))):
+    for path in sorted(glob.glob(os.path.join(d, pattern))):
         try:
-            card = json.load(open(path))
+            with open(path) as f:
+                doc = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             print(f"warning: {path}: {e}", file=sys.stderr)
             continue
-        if isinstance(card, dict):
-            out.append((path, card))
+        if isinstance(doc, dict):
+            out.append((path, doc))
     return out
+
+
+def load_cards(d):
+    """Run cards under ``d`` as [(path, card)]."""
+    return _load_json_dir(d, "runcard_*.json")
+
+
+def load_tune_reports(d):
+    """TuneReports under ``d`` as [(path, doc)] sorted by creation
+    time."""
+    out = _load_json_dir(d, "tunereport_*.json")
+    out.sort(key=lambda pd: pd[1].get("created_unix") or 0)
+    return out
+
+
+def _winner_str(doc):
+    w = doc.get("winner") or {}
+    knobs = {
+        k: v for k, v in w.items()
+        if k != "format" and v is not None
+    }
+    if doc.get("decision") != "tuned" or not knobs:
+        return "default (hold)" if doc.get("decision") == "hold" \
+            else "default"
+    return ",".join(f"{k}={v}" for k, v in sorted(knobs.items()))
+
+
+def print_tune_table(reports):
+    """Per-(spec fingerprint, backend, device, bucket, workload)
+    winner table across rounds, flagging winner CHURN — a fingerprint
+    whose winning schedule flip-flops between reports is either a
+    noisy machine or a workload on a knob boundary, and either way an
+    operator should look before trusting the tuned entry."""
+    groups = {}      # key -> [(created, winner_str, speedup, floor, path)]
+    for path, doc in reports:
+        wl = doc.get("workload") or {}
+        key = (
+            doc.get("spec_name"),
+            (doc.get("spec_fingerprint") or "?")[:12],
+            doc.get("backend"), doc.get("device_kind"),
+            doc.get("bucket"), wl.get("label"),
+        )
+        groups.setdefault(key, []).append((
+            doc.get("created_unix") or 0, _winner_str(doc),
+            doc.get("speedup_frac"), doc.get("noise_floor_frac"),
+            os.path.basename(path),
+        ))
+    print(f"\ntune reports: {len(reports)} "
+          f"({len(groups)} fingerprint/workload groups)")
+    churn = 0
+    for key in sorted(groups, key=str):
+        name, fp, backend, dev, bucket, label = key
+        rows = groups[key]
+        winners = [w for _, w, _, _, _ in rows]
+        flip = len(set(winners)) > 1
+        churn += flip
+        head = (
+            f"  {name} [{fp}] {backend}/{dev} bucket={bucket}"
+            + (f" ({label})" if label else "")
+            + ("  ** WINNER CHURN **" if flip else "")
+        )
+        print(head)
+        for _, w, sp, fl, base in rows:
+            sp_s = "-" if sp is None else f"{sp * 100:+.1f}%"
+            fl_s = "-" if fl is None else f"{fl * 100:.1f}%"
+            print(
+                f"    {base}: winner {w} (speedup {sp_s}, "
+                f"noise floor {fl_s})"
+            )
+    if churn:
+        print(f"  {churn} group(s) show winner churn across rounds")
+    return churn
 
 
 def _fmt_rate(v):
@@ -86,6 +165,12 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--cards", default=None,
         help="also list run cards (runcard_*.json) from this directory",
+    )
+    ap.add_argument(
+        "--tune", default=None,
+        help="also collate autotuner TuneReports (tunereport_*.json) "
+        "from this directory: per-fingerprint winner table + "
+        "winner-churn flags (docs/21_autotune.md)",
     )
     ap.add_argument(
         "--metric", default="mm1_events_per_sec",
@@ -158,6 +243,9 @@ def main(argv=None) -> int:
                 f" ({tpu.get('path', '?')}, {tpu.get('profile', '?')})"
                 f" — {note}"
             )
+
+    if args.tune:
+        print_tune_table(load_tune_reports(args.tune))
 
     if args.cards:
         cards = load_cards(args.cards)

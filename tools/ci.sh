@@ -644,6 +644,76 @@ print("fleet smoke OK:", report.n_completed, "completed,",
       "replacement burst %.2fs," % burst_s, "store", sstats)
 EOF
 
+# tune smoke (docs/21_autotune.md): search 3 schedule arms on the tiny
+# probe model (every arm bitwise-pinned against the default inside the
+# search), persist the winner into a temp program store, then a CLEAN
+# subprocess resolves it — tuned-entry store hit, zero re-measurement
+# (fresh process counters show lookup only) — and its result is
+# bitwise the default schedule's; CIMBA_TUNE=0 in the same subprocess
+# restores the default resolution
+run_cell "tune smoke" bash -c '
+  set -e
+  tunestore=$(mktemp -d)
+  trap "rm -rf \"$tunestore\"" EXIT
+  CIMBA_TUNE_SMOKE_STORE="$tunestore" python - <<PYEOF
+import dataclasses, os
+from cimba_tpu import tune
+from cimba_tpu.tune import probe
+from cimba_tpu.tune.space import Schedule
+from cimba_tpu.serve import store as pstore
+
+spec, _ = probe.build(event_cap=8, per_resume=1, hold=0.5)
+rep = tune.search_schedule(
+    spec, None, 8, t_end=4.0, seed=7, repeats=2,
+    candidates=[Schedule(), Schedule(pack=True), Schedule(chunk_steps=8)],
+    workload_label="ci-tiny",
+)
+assert all(r["pinned"] is not False for r in rep.arms), rep.arms
+assert rep.noise_floor_frac is not None
+if rep.decision != "tuned":
+    # a quiet machine may legitimately HOLD; the smoke exercises the
+    # persistence+resolution pipeline, so adopt the chunk arm
+    rep = dataclasses.replace(
+        rep, decision="tuned", winner=Schedule(chunk_steps=8),
+        winner_name="chunk_steps=8")
+st = pstore.get_store(os.environ["CIMBA_TUNE_SMOKE_STORE"])
+assert tune.save_tuned(st, spec, 8, rep) is not None
+print("tune search OK:", rep.decision, rep.winner_name,
+      "floor %.1f%%" % (100 * rep.noise_floor_frac),
+      "arms", [r["name"] for r in rep.arms])
+PYEOF
+  env CIMBA_PROGRAM_STORE="$tunestore" python - <<PYEOF
+import os
+from cimba_tpu.obs import audit
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import store as pstore
+from cimba_tpu.tune import probe
+
+spec, _ = probe.build(event_cap=8, per_resume=1, hold=0.5)
+tuned = ex.run_experiment_stream(spec, None, 8, seed=3, t_end=4.0,
+                                 audit=True)
+st = pstore.default_store().stats()
+assert st["tuned_hits"] >= 1 and st["tuned_misses"] == 0, st
+assert st["tuned_saves"] == 0, st   # resolution only — no re-search
+blk = tuned.audit["schedule"]
+assert blk["source"] == "tuned" and blk["tune_entry"], blk
+default = ex.run_experiment_stream(spec, None, 8, seed=3, t_end=4.0,
+                                   chunk_steps=1024, audit=True)
+assert (audit.stream_result_digest(tuned)
+        == audit.stream_result_digest(default))
+os.environ["CIMBA_TUNE"] = "0"
+off = ex.run_experiment_stream(spec, None, 8, seed=3, t_end=4.0,
+                               audit=True)
+assert off.audit["schedule"]["source"] == "off"
+assert (audit.stream_result_digest(off)
+        == audit.stream_result_digest(default))
+print("tune resolution OK: clean subprocess served the persisted "
+      "winner (store hit, no re-search), bitwise vs default;",
+      "knobs", blk["knobs"])
+PYEOF
+  echo "tune smoke OK"
+'
+
 # sampler smoke: bulk draws must clear a floor (the reference ships speed
 # comparisons in its random test battery, `test/test_random.c:193-245`;
 # this is the regression tripwire, not a benchmark)
