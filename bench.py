@@ -1466,6 +1466,237 @@ def bench_serve_mixed():
     )
 
 
+def bench_serve_refill():
+    """Continuous wave refill vs the frozen-wave dispatcher at the SAME
+    offered open-loop mixed-horizon load (docs/22_refill.md), measured
+    through ``tune.measure.measure_arms`` (refill-off is the baseline
+    arm; its self-twin gives the noise floor).  The acceptance story:
+    refill-on steady-state mean lane occupancy >= 1.5x refill-off with
+    p99 submit->deliver latency no worse, ZERO program-cache misses
+    during the timed refill rounds (boundary splices dispatch cached
+    programs), and every completed request's digest bitwise-equal to
+    its direct solo run (``obs.audit.stream_result_digest``) — lane
+    recycling is invisible to results.  Reports per-arm occupancy
+    series (mean + histogram from periodic stats polls), per-template
+    p50/p95/p99, and the refill counters as the run card's ``refill``
+    block."""
+    import threading as _threading
+
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.tune import measure as _tm
+
+    accel = _accel()
+    wave = int(os.environ.get(
+        "CIMBA_BENCH_REFILL_WAVE", str(4096 if accel else 16)
+    ))
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = int(os.environ.get(
+        "CIMBA_BENCH_REFILL_CHUNK", str(256 if accel else 32)
+    ))
+    req_r = max(int(os.environ.get(
+        "CIMBA_BENCH_REFILL_REQ_R", str(max(wave // 4, 1))
+    )), 1)
+    n_requests = int(os.environ.get("CIMBA_BENCH_REFILL_REQS", "32"))
+    clients = int(os.environ.get("CIMBA_BENCH_SERVE_CLIENTS", "4"))
+    iat = float(os.environ.get("CIMBA_BENCH_REFILL_IAT", "0.002"))
+    repeats = int(os.environ.get("CIMBA_BENCH_REFILL_REPEATS", "2"))
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = serve.ProgramCache()
+
+        def templates(n_objects, R):
+            # one compatibility class (same params signature, all
+            # run-to-completion = one horizon bucket), three WORKLOAD
+            # lengths 4x/20x apart via n_objects — mm1 is finite-
+            # population, so n_objects IS the trajectory length.  The
+            # mixed-horizon decay shape: short lanes die at ~5% of a
+            # long wave-mate's life.
+            def req(seed, n, r=R):
+                return serve.Request(
+                    spec, mm1.params(n), r, seed=seed,
+                    wave_size=r, chunk_steps=chunk,
+                )
+
+            return [
+                serve.RequestTemplate("long", req(11, 40 * n_objects)),
+                serve.RequestTemplate(
+                    "mid", req(22, 10 * n_objects), 2.0
+                ),
+                serve.RequestTemplate(
+                    "short", req(33, 2 * n_objects), 3.0
+                ),
+            ]
+
+        def load_round(refill, n_reqs, timed):
+            """One full open-loop round at the offered load; returns
+            (report, stats, occupancy polls)."""
+            svc = serve.Service(
+                max_wave=wave, cache=cache, refill=refill,
+                refill_every=2, horizon_bucket=None,
+                on_chunk=_heartbeat,
+            )
+            polls: list = []
+            stop = _threading.Event()
+
+            def poller():
+                while not stop.wait(0.05):
+                    occ = svc.stats()["lane_occupancy"]
+                    if occ["lanes_in_wave"]:
+                        polls.append(occ["occupancy_now"])
+
+            th = _threading.Thread(target=poller, daemon=True)
+            if timed:
+                th.start()
+            try:
+                report = serve.run_mixed_load(
+                    svc, templates(N, req_r), n_reqs,
+                    n_clients=clients, inter_arrival_s=iat,
+                )
+                stats = svc.stats()
+            finally:
+                stop.set()
+                if timed:
+                    th.join()
+                svc.shutdown()
+            return report, stats, polls
+
+        payloads: dict = {}
+        # misses snapshot taken at the FIRST timed run (after every
+        # prepare leg): on_round fires AFTER a round completes, so a
+        # round-indexed snapshot would silently exclude round 1 — the
+        # round most likely to compile
+        misses_at_first_run: dict = {}
+
+        def make_arm(name, refill):
+            def prepare():
+                # warm every program this arm dispatches — incl. the
+                # refill/liveness pair and at least one boundary splice
+                load_round(refill, min(6, n_requests), timed=False)
+
+            def run():
+                misses_at_first_run.setdefault(
+                    "misses", cache.stats()["misses"]
+                )
+                payloads[name] = load_round(refill, n_requests, True)
+                return payloads[name]
+
+            return _tm.Arm(name=name, run=run, prepare=prepare)
+
+        arms = [
+            make_arm("refill_off", False), make_arm("refill_on", True),
+        ]
+        mreport = _tm.measure_arms(
+            arms, repeats=repeats, baseline=0, on_round=_heartbeat,
+        )
+        # zero compiles during the timed rounds (acceptance): the
+        # prepare legs warmed every program — boundary splices must
+        # dispatch, never compile.  Snapshot BEFORE the direct digest
+        # runs below, which warm nothing new but keep this honest.
+        compiled_in_timed = (
+            cache.stats()["misses"] - misses_at_first_run["misses"]
+            if misses_at_first_run else None
+        )
+        assert compiled_in_timed == 0, (
+            "programs compiled during the timed refill rounds",
+            compiled_in_timed, cache.stats(),
+        )
+        # per-template digest anchors vs direct solo runs — every
+        # completed request bitwise its solo twin, refilled or not
+        direct_digest = {}
+        for t in templates(N, req_r):
+            r = t.request
+            direct_digest[t.name] = _audit.stream_result_digest(
+                ex.run_experiment_stream(
+                    r.spec, r.params, r.n_replications,
+                    wave_size=r.wave_size, chunk_steps=r.chunk_steps,
+                    seed=r.seed, t_end=r.t_end, program_cache=cache,
+                    on_wave=_heartbeat, on_chunk=_heartbeat,
+                )
+            )  # noqa: t_end is None for every template (natural end)
+        digest_checked = digest_equal = 0
+        arm_detail = {}
+        for name, (report, stats, polls) in payloads.items():
+            for i, res in report.results:
+                digest_checked += 1
+                digest_equal += (
+                    _audit.stream_result_digest(res)
+                    == direct_digest[report.template_names[i]]
+                )
+            hist: dict = {}
+            for f in polls:
+                b = round(min(max(f, 0.0), 1.0) * 10) / 10
+                hist[f"{b:.1f}"] = hist.get(f"{b:.1f}", 0) + 1
+            total_ev = sum(
+                int(res.total_events) for _, res in report.results
+            )
+            arm_detail[name] = {
+                "completed": report.n_completed,
+                "errors": dict(report.errors),
+                "wall_s": report.wall_s,
+                "events_per_sec": (
+                    total_ev / report.wall_s if report.wall_s else 0.0
+                ),
+                "latency": report.latency_percentiles(),
+                "latency_per_template": report.per_template(),
+                "occupancy_mean": stats["lane_occupancy"][
+                    "occupancy_mean"
+                ],
+                "occupancy_poll_mean": (
+                    sum(polls) / len(polls) if polls else None
+                ),
+                "occupancy_hist": dict(sorted(hist.items())),
+                "refill": stats["refill"],
+                "mid_wave_deliveries": stats["refill"][
+                    "mid_wave_deliveries"
+                ],
+            }
+    on_d = arm_detail.get("refill_on", {})
+    off_d = arm_detail.get("refill_off", {})
+    occ_ratio = (
+        on_d.get("occupancy_mean", 0.0)
+        / off_d["occupancy_mean"]
+        if off_d.get("occupancy_mean") else None
+    )
+    rate = on_d.get("events_per_sec", 0.0)
+    assert digest_checked and digest_equal == digest_checked, (
+        "refilled results drifted from their solo digests",
+        digest_equal, digest_checked,
+    )
+    _line(
+        "serve_refill_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "path": "serve_continuous_refill",
+            "profile": prof,
+            "requests": n_requests,
+            "clients": clients,
+            "inter_arrival_s": iat,
+            "objects_per_replication": N,
+            "replications_per_request": req_r,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "measure": mreport.to_json(),
+            "refill": {
+                "arms": arm_detail,
+                "occupancy_ratio_on_vs_off": occ_ratio,
+                "p99_on_s": on_d.get("latency", {}).get("p99_s"),
+                "p99_off_s": off_d.get("latency", {}).get("p99_s"),
+                "compiles_in_timed_rounds": compiled_in_timed,
+                "digest_anchors": {
+                    "checked": digest_checked, "equal": digest_equal,
+                },
+            },
+            "program_cache": cache.stats(),
+        },
+    )
+
+
 #: the serve_cold child: one fresh process per trial per arm, timing
 #: import / programs-ready / first-result legs of a single serve-shaped
 #: request.  The hydrated arm warms from the AOT store manifest (NO
@@ -2498,6 +2729,7 @@ CONFIGS = {
     "serve_cold": bench_serve_cold,
     "serve_fleet": bench_serve_fleet,
     "serve_mixed": bench_serve_mixed,
+    "serve_refill": bench_serve_refill,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
     "sweep": bench_sweep,

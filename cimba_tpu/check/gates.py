@@ -225,6 +225,19 @@ GATES: Tuple[Gate, ...] = (
         ambient_env={"CIMBA_TUNE": "1"},
         off_env={"CIMBA_TUNE": "0"},
     ),
+    Gate(
+        name="refill",
+        env=("CIMBA_REFILL",),
+        program="chunk",
+        # continuous wave refill (docs/22_refill.md) is a HOST-side
+        # dispatch policy: the knob selects lane recycling in the
+        # serve dispatcher and must never bind into a traced chunk
+        # program — the refilled wave runs the SAME chunk program as
+        # the refill-off one (the splice is a separate program).  No
+        # ON arm: there is no chunk-program state to flip.
+        ambient_env={"CIMBA_REFILL": "1"},
+        off_env={"CIMBA_REFILL": "0"},
+    ),
 )
 
 

@@ -172,6 +172,17 @@ ENV_KNOBS = {
             "gate in check/gates.py pins this); explicit kwargs "
             "always win either way (docs/21_autotune.md)",
     ),
+    "CIMBA_REFILL": dict(
+        default="", trace_gate=True,
+        doc="continuous wave refill (docs/22_refill.md): =1 makes "
+            "Service(refill=None) recycle dead lanes at chunk "
+            "boundaries — retire a finished request's lanes early and "
+            "splice queued compatible requests into them.  Purely a "
+            "HOST-side dispatch policy: the chunk program is untouched "
+            "(the 'refill' gate in check/gates.py pins ambient "
+            "inertness), and the refill/liveness programs are separate "
+            "compiles keyed by the same compatibility class",
+    ),
     # kernel-path knobs: Mosaic programs, covered by the dedicated
     # kernel parity batteries (test_mosaic_aot / test_pallas_run), not
     # the XLA-path gate sweep (interpret-mode tracing is over tier-1

@@ -2411,6 +2411,70 @@ def make_chunk(
     return chunk
 
 
+def make_refill(spec: ModelSpec):
+    """Build ``refill(sims, mask, reps, seeds, t_stops, params) ->
+    sims``: re-initialize EXACTLY the masked lanes of a batched Sim
+    through the same per-lane init path the wave was born from
+    (:func:`init_sim` with per-lane seed/horizon columns,
+    docs/14_wave_packing.md) and splice the fresh rows into the live
+    carry — the lane-recycling primitive behind continuous wave refill
+    (docs/22_refill.md).
+
+    Unmasked lanes pass through BIT-IDENTICALLY (a per-leaf masked
+    select; leaves are never re-laid-out), so a mid-wave splice cannot
+    perturb its wave-mates — and a refilled lane starts from exactly
+    the state its solo run would start from, which is what makes a
+    refilled request's result bitwise its solo run's (trajectories are
+    lane-local under vmap; chunk phase is trajectory-invariant).  Works
+    on either carry layout: the batched Sim BETWEEN chunks is always
+    the plain per-leaf pytree (packing lives inside the while-loop
+    carry), so one refill program serves ``pack=True`` and
+    ``pack=False`` chunk programs alike, under both dtype profiles.
+
+    The wave must carry the per-lane ``t_stop`` leaf (refill waves
+    always do — lane death and reclamation are horizon-driven); a
+    ``t_stop=-inf`` row retires a lane into reclaimable dead capacity
+    (the pad-lane encoding), which is also how cancellation and
+    deadline expiry free lanes mid-wave.  Not jitted here — callers
+    jit with the Sim DONATED (``runner.experiment._refill_program``),
+    so a boundary splice allocates nothing beyond the fresh rows."""
+
+    def refill(sims: Sim, mask, reps, seeds, t_stops, params):
+        if sims.t_stop is None:
+            raise ValueError(
+                "make_refill: the wave carries no per-lane t_stop "
+                "leaf — refill needs horizon-carrying waves (the "
+                "serving layer always materializes the column on the "
+                "refill path; see docs/22_refill.md)"
+            )
+        fresh = jax.vmap(
+            lambda r, s, t, p: init_sim(spec, s, r, p, t_stop=t)
+        )(reps, seeds, t_stops, params)
+
+        def sel(a, b):
+            m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+            return jnp.where(m, a, b)
+
+        return jax.tree.map(sel, fresh, sims)
+
+    return refill
+
+
+def make_lanes_live(spec: ModelSpec, t_end: Optional[float] = None):
+    """Build ``live(sims) -> bool[L]`` over a batched Sim: each lane's
+    :func:`make_cond` liveness — the per-lane readback the refill
+    driver (and the live lane-occupancy gauge, docs/22_refill.md)
+    polls at chunk boundaries to learn which lanes died this chunk.
+    Read-only and tiny; callers jit WITHOUT donation so the readback
+    never races the next chunk's buffer donation."""
+    cond = make_cond(spec, t_end)
+
+    def live(sims: Sim):
+        return jax.vmap(cond)(sims)
+
+    return live
+
+
 def drive_chunks(
     chunk,
     sims: Sim,
@@ -2422,6 +2486,7 @@ def drive_chunks(
     max_chunks: Optional[int] = None,
     n0: int = 0,
     on_digest=None,
+    on_boundary=None,
 ) -> Sim:
     """Host loop over a jitted, donated ``chunk(sims) -> (sims,
     any_live)``: re-dispatch until every lane is done.
@@ -2450,6 +2515,17 @@ def drive_chunks(
     array so the drive loop stays asynchronous.  Over-dispatched no-op
     chunks after completion still append (their digests repeat the
     settled state — deterministic, so trails stay comparable).
+
+    ``on_boundary(n, sims)`` fires after each chunk with the CURRENT
+    batched Sim, before it is donated into the next dispatch — the
+    refill hook (docs/22_refill.md): the hook may inspect per-lane
+    liveness (:func:`make_lanes_live`) and return a REPLACEMENT Sim
+    (typically the jitted, donated refill program's output with dead
+    lanes re-seeded); returning ``None`` leaves the wave untouched.
+    When the hook splices (returns non-None), the queued liveness
+    flags are discarded: they describe the pre-splice wave, and a
+    stale ``any_live=False`` from before a refill revived lanes must
+    not retire the wave under the fresh work.
     """
     from collections import deque
 
@@ -2464,6 +2540,12 @@ def drive_chunks(
             on_digest(n, out[2])
         if on_chunk is not None:
             on_chunk(n)
+        if on_boundary is not None:
+            respliced = on_boundary(n, sims)
+            if respliced is not None:
+                sims = respliced
+                pending.clear()
+                continue
         if (
             on_state is not None
             and on_state_every > 0

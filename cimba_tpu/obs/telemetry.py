@@ -1016,6 +1016,35 @@ def _service_collector(registry: Registry, name: str, service):
             "padded lanes / all dispatched lanes",
             labels=("service",),
         ).labels(**lab).set(occ.get("padding_waste_frac", 0.0))
+        # the LIVE per-chunk occupancy view (docs/22_refill.md): how
+        # full the in-flight wave is right now / on average over the
+        # recent boundary window — decay (and refill) in real time,
+        # not the pack-time snapshot
+        registry.gauge(
+            P + "serve_lane_occupancy_now",
+            "live lanes / wave lanes at the latest chunk boundary",
+            labels=("service",),
+        ).labels(**lab).set(occ.get("occupancy_now", 0.0))
+        registry.gauge(
+            P + "serve_lane_occupancy_mean",
+            "mean live-lane occupancy over recent chunk boundaries",
+            labels=("service",),
+        ).labels(**lab).set(occ.get("occupancy_mean", 0.0))
+        ref = st.get("refill")
+        if ref:
+            registry.gauge(
+                P + "serve_refill_enabled",
+                "continuous wave refill active (docs/22_refill.md)",
+                labels=("service",),
+            ).labels(**lab).set(1.0 if ref.get("enabled") else 0.0)
+            for k in ("refill_boundaries", "refill_admissions",
+                      "refill_retirements", "lanes_refilled",
+                      "lanes_reclaimed", "mid_wave_deliveries"):
+                if k in ref:
+                    registry.counter(
+                        P + f"serve_{k}_total",
+                        k.replace("_", " "), labels=("service",),
+                    ).labels(**lab).set_total(ref[k])
         registry.gauge(
             P + "serve_classes_seen", "distinct compatibility classes",
             labels=("service",),

@@ -402,6 +402,55 @@ def _init_program(spec: ModelSpec, mesh: Optional[Mesh]):
     return jax.jit(init)
 
 
+def _refill_program(spec: ModelSpec, mesh: Optional[Mesh]):
+    """One compiled refill program: ``refill(sims, mask, reps, seeds,
+    t_stops, params) -> sims`` (:func:`cimba_tpu.core.loop.
+    make_refill`), jitted with the batched Sim DONATED so a boundary
+    splice aliases the wave's buffers instead of copying them — the
+    same zero-copy contract the chunk program rides
+    (docs/12_streaming.md).  Under a mesh every operand is lane-data
+    sharded over ``REP_AXIS``, so the splice never reshards the wave
+    the chunk program runs on.  Compiled once per wave shape alongside
+    ``(init, chunk)`` — after warmup a refill is a cached dispatch,
+    never a compile (docs/22_refill.md)."""
+    from cimba_tpu.core.loop import make_refill
+
+    refill = make_refill(spec)
+    if mesh is not None:
+        refill = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(REP_AXIS), P(REP_AXIS), P(REP_AXIS), P(REP_AXIS),
+                P(REP_AXIS), P(REP_AXIS),
+            ),
+            out_specs=P(REP_AXIS),
+            check_vma=False,
+        )(refill)
+    return jax.jit(refill, donate_argnums=(0,))
+
+
+def _live_program(spec: ModelSpec, mesh: Optional[Mesh]):
+    """One compiled per-lane liveness readback: ``live(sims) ->
+    bool[L]`` (:func:`cimba_tpu.core.loop.make_lanes_live`) — NOT
+    donated (it reads the wave the next chunk will consume).  The
+    refill driver polls it at chunk boundaries to learn which lanes
+    died this chunk; the serving layer's live lane-occupancy gauge
+    rides the same program (docs/22_refill.md)."""
+    from cimba_tpu.core.loop import make_lanes_live
+
+    live = make_lanes_live(spec)
+    if mesh is not None:
+        live = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(REP_AXIS),),
+            out_specs=P(REP_AXIS),
+            check_vma=False,
+        )(live)
+    return jax.jit(live)
+
+
 def _tel_hooks(telemetry, kind: str, on_wave, on_chunk):
     """Generalize the ``on_wave``/``on_chunk`` progress hooks into
     telemetry ticks (docs/17_telemetry.md): with a

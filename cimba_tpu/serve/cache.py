@@ -404,6 +404,43 @@ def get_programs(
     return _get_or_create(programs, key, build)[:2]
 
 
+def get_refill_programs(
+    programs: MutableMapping,
+    spec,
+    *,
+    mesh,
+    pack,
+    with_metrics: bool,
+):
+    """The refill plane's compiled pair for one compatibility class:
+    ``(refill_j, live_j)`` — the donated lane-splice program and the
+    per-lane liveness readback (docs/22_refill.md).  Keyed by the SAME
+    compatibility class the chunk program keys by (the Sim pytree a
+    splice must reproduce is the class's — profile, metrics/trace
+    leaves, event-set layout), so a refill can never splice rows laid
+    out for a different program.  No store hydration: both programs
+    are small host compiles (the chunk program dominates cold start),
+    though ``CIMBA_PROGRAM_STORE`` still softens them to disk hits via
+    jax's persistent compilation cache."""
+    from cimba_tpu.serve import store as _pstore
+
+    _pstore.maybe_enable_persistent_cache()
+    key = ("refill",) + program_class_key(
+        spec, with_metrics, mesh=mesh, pack=pack,
+    )
+
+    def build():
+        from cimba_tpu.runner import experiment as ex
+
+        return (
+            ex._refill_program(spec, mesh),
+            ex._live_program(spec, mesh),
+            spec,  # pins the fingerprint's function ids while cached
+        )
+
+    return _get_or_create(programs, key, build)[:2]
+
+
 def get_fold(programs: MutableMapping, with_metrics: bool, summary_path):
     """The jitted wave-fold program shared by the stream runner and the
     service's per-request accumulators: merge the wave's pooled Pébay

@@ -283,6 +283,56 @@ class ResultHandle:
 #: outcomes recorded in stats and trace spans
 _OUTCOMES = ("completed", "failed", "cancelled", "deadline_exceeded")
 
+#: refill-plane counters (docs/22_refill.md) — grouped in
+#: ``stats()["refill"]`` and mirrored as ``cimba_serve_refill_*``
+#: telemetry families
+_REFILL_COUNTERS = (
+    "refill_boundaries", "refill_admissions", "refill_retirements",
+    "lanes_refilled", "lanes_reclaimed", "mid_wave_deliveries",
+)
+
+
+class _RefillSlot:
+    """One request slot's lane ownership inside a refill-driven wave:
+    the request entry, its replication window ``[lo, lo+n)``, and the
+    wave lane indices it owns (ascending — lane order IS replication
+    order, so the retirement fold gathers rows in exactly the order
+    the direct call's contiguous wave slice has them)."""
+
+    __slots__ = ("entry", "lo", "n", "lanes", "folded")
+
+    def __init__(self, entry, lo, n):
+        self.entry = entry
+        self.lo = lo
+        self.n = n
+        self.lanes = []
+        self.folded = False
+
+
+class _RefillWave:
+    """One refill-driven wave's bookkeeping: the ownership table
+    (slots), the reclaimable free-lane pool (pad lanes at birth, plus
+    every retired/killed slot's lanes), and the compiled programs the
+    boundary controller dispatches (docs/22_refill.md)."""
+
+    __slots__ = (
+        "cls", "slots", "free", "L", "batch_no", "no_admit",
+        "init_j", "chunk_j", "refill_j", "live_j", "pad_row",
+    )
+
+    def __init__(self, cls, no_admit):
+        self.cls = cls
+        self.slots = []
+        self.free = []
+        self.L = 0
+        self.batch_no = 0
+        self.no_admit = no_admit
+        self.init_j = None
+        self.chunk_j = None
+        self.refill_j = None
+        self.live_j = None
+        self.pad_row = None
+
 
 class Service:
     """A thread-based experiment service over one device (or mesh).
@@ -314,6 +364,23 @@ class Service:
       (truncation stays exact either way; this is purely a latency
       policy).
 
+    * ``refill`` (default None → the ``CIMBA_REFILL`` env knob, unset
+      = off): continuous wave refill (docs/22_refill.md) — at every
+      chunk boundary the dispatcher retires lanes whose owning request
+      completed (folding and delivering THAT request immediately, not
+      at whole-wave retirement), reclaims the lanes of cancelled /
+      deadline-expired requests, and splices queued compatible
+      requests into the freed lanes through a jitted, donated refill
+      program — steady-state lane occupancy stays near-flat under
+      mixed-horizon open-loop traffic instead of decaying over each
+      wave's life, with zero recompiles after warmup.  Results stay
+      bitwise the direct call's (lanes are independent; the splice is
+      a masked per-lane re-init through the same init path).  Off,
+      requests dispatch exactly as before — compiled chunk programs,
+      packing, and results are identical; the only addition is the
+      per-boundary liveness readback feeding the live occupancy gauge
+      (service-local, never the shared program cache).
+
     ``telemetry`` (default None) attaches a
     :class:`cimba_tpu.obs.telemetry.Telemetry` plane: the background
     sampler scrapes :meth:`stats` into the time-series registry, the
@@ -324,7 +391,7 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
-    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples
 
     def __init__(
         self,
@@ -341,14 +408,40 @@ class Service:
         pad_waves: bool = True,
         horizon_bucket: Optional[float] = 16.0,
         telemetry=None,
+        refill: Optional[bool] = None,
+        refill_every: Optional[int] = None,
         name: str = "cimba-serve",
     ):
+        from cimba_tpu import config as _config
+
         if max_wave <= 0:
             raise ValueError(f"max_wave must be positive: {max_wave}")
         self.max_wave = int(max_wave)
         self.name = name
         self.mesh = mesh
         self.poll_every = poll_every
+        # continuous wave refill (docs/22_refill.md): None defers to
+        # the CIMBA_REFILL env knob (unset = off — the historical
+        # dispatch path plus only the occupancy readback).  A
+        # host-side dispatch policy only: compiled
+        # chunk programs are identical either way (the 'refill' trace
+        # gate pins this), and the refill/liveness programs live at
+        # their own cache keys.
+        self.refill = (
+            _config.env_raw("CIMBA_REFILL") == "1" if refill is None
+            else bool(refill)
+        )
+        # boundary-controller cadence: the controller's per-lane
+        # liveness readback is a HOST SYNC (it must act on concrete
+        # lane deaths), so running it every chunk would serialize the
+        # async dispatch pipeline drive_chunks builds.  Every
+        # ``refill_every`` chunks (default: poll_every — the same
+        # depth the liveness poll already pipelines at) keeps the
+        # pipeline full between control points; retirement/admission
+        # latency is bounded by refill_every chunks.
+        self.refill_every = max(
+            int(poll_every if refill_every is None else refill_every), 1
+        )
         self.max_retries = int(max_retries)
         self.backoff = backoff
         self.cache = cache if cache is not None else _pcache.ProgramCache()
@@ -378,6 +471,20 @@ class Service:
         }
         for o in _OUTCOMES:
             self._counters[o] = 0
+        for o in _REFILL_COUNTERS:
+            self._counters[o] = 0
+        # per-chunk live-lane occupancy samples: (live, lanes_in_wave)
+        # pairs appended at every chunk boundary — ``live`` is a host
+        # int on the refill path (the boundary controller already
+        # synced it) and a DEVICE [L] bool vector on the plain path
+        # (the readback dispatch stays asynchronous; stats() converts
+        # at scrape time).  This is what keeps
+        # ``stats()["lane_occupancy"]`` live over a wave's life instead
+        # of frozen at pack time (docs/22_refill.md).
+        self._occ_samples = deque(maxlen=256)
+        # plain-path liveness-readback programs, per compatibility
+        # class (dispatcher-thread only — see _run_batch)
+        self._live_cache: dict = {}
         self._occupancy: dict = {}       # requests-per-batch -> count
         self._class_ids: dict = {}       # class key -> short label
         # tuned-schedule resolution accounting (docs/21_autotune.md)
@@ -622,6 +729,10 @@ class Service:
                     padded / (live + padded) if live + padded else 0.0
                 ),
             }
+            out["refill"] = {"enabled": self.refill}
+            for k in _REFILL_COUNTERS:
+                out["refill"][k] = self._counters[k]
+            occ_samples = list(self._occ_samples)
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
                 "mean_s": (
@@ -637,6 +748,28 @@ class Service:
                 "sources": dict(self._sched_sources),
                 "by_class": dict(self._schedules),
             }
+        # the live-occupancy view is computed OUTSIDE the lock: the
+        # plain dispatch path stores device vectors (the readback stays
+        # asynchronous), and forcing them to host must never stall a
+        # concurrent submit/dispatch on the service lock
+        import numpy as _np
+
+        vals = []
+        for v, tot in occ_samples:
+            if not isinstance(v, int):
+                v = int(_np.asarray(v).sum())
+            vals.append((v, tot))
+        fracs = [lv / t for lv, t in vals if t]
+        last_live, last_tot = vals[-1] if vals else (0, 0)
+        out["lane_occupancy"].update({
+            "lanes_live_now": last_live,
+            "lanes_in_wave": last_tot,
+            "occupancy_now": last_live / last_tot if last_tot else 0.0,
+            "occupancy_mean": (
+                sum(fracs) / len(fracs) if fracs else 0.0
+            ),
+            "occupancy_samples": len(vals),
+        })
         if hasattr(self.cache, "stats"):
             out["program_cache"] = self.cache.stats()
             # the persistent AOT store's hit/miss/downgrade counters,
@@ -804,8 +937,20 @@ class Service:
 
     def _cancel(self, entry: _Entry) -> bool:
         with self._lock:
-            if entry.done.is_set() or entry.in_flight:
+            if entry.done.is_set():
                 return False
+            if entry.in_flight:
+                if not self.refill:
+                    return False
+                # refill mode: an in-flight request's lanes are freed
+                # at the NEXT chunk boundary (flipped to t_stop=-inf —
+                # reclaimable capacity), where the boundary controller
+                # finishes it with Cancelled exactly once.  Best
+                # effort: if every lane happens to die at that same
+                # boundary, completion wins and the result is
+                # delivered (docs/22_refill.md).
+                entry.cancelled = True
+                return True
             entry.cancelled = True
         # finish now (snappy futures); the dispatcher drops the
         # tombstone when it reaches it in the queue
@@ -897,10 +1042,19 @@ class Service:
             with self._lock:
                 if entry.done.is_set():  # cancelled tombstone
                     continue
-                # CLAIM under the service lock: from here cancel()
-                # returns False — an entry is either cancelled while
-                # truly undispatched, or it runs; never both
-                entry.in_flight = True
+                cancelled_flag = entry.cancelled
+                if not cancelled_flag:
+                    # CLAIM under the service lock: from here cancel()
+                    # returns False — an entry is either cancelled
+                    # while truly undispatched, or it runs; never both
+                    entry.in_flight = True
+            if cancelled_flag:
+                # a mid-wave cancel whose entry was requeued before the
+                # flag was honored (refill remainder race): finish it
+                # instead of running a whole slot for a dead request
+                self._finish(entry, exc=Cancelled(entry.label),
+                             outcome="cancelled")
+                continue
             now = time.monotonic()
             if entry.deadline_at is not None and now > entry.deadline_at:
                 self._finish(
@@ -911,6 +1065,16 @@ class Service:
                     ),
                     outcome="deadline_exceeded",
                 )
+                continue
+            if self.refill:
+                # continuous wave refill (docs/22_refill.md): the wave
+                # is driven chunk-by-chunk with a boundary controller
+                # that retires finished requests' lanes early and
+                # splices queued compatible requests into them —
+                # failure containment lives inside (_batch_failed on
+                # the still-active members; delivered results stay
+                # delivered)
+                self._serve_refill_wave(entry)
                 continue
             slots, members = self._pack(entry)
             try:
@@ -1148,9 +1312,571 @@ class Service:
                 if user_hook is not None:
                     user_hook(n)
 
+        # per-chunk live-lane readback (docs/22_refill.md): a tiny
+        # non-donated vmapped-cond dispatch per boundary feeds the live
+        # ``lane_occupancy`` gauge — the device vector is stored as-is
+        # (no host sync on the dispatch path; stats() converts at
+        # scrape time), so /varz and the fleet health scraper see a
+        # long wave's occupancy DECAY in real time instead of the
+        # pack-time snapshot.  SERVICE-local cache, not the shared
+        # ProgramCache: the readback is an observability detail of
+        # this dispatcher, and it must not perturb the shared cache's
+        # size/miss accounting ("a warmed service adds no program
+        # entries" is a pinned contract); dispatcher-thread only, and
+        # each entry pins its spec (the class key embeds function ids)
+        ent = self._live_cache.get(lead.cls)
+        if ent is None:
+            from cimba_tpu.runner import experiment as ex
+
+            ent = (ex._live_program(req.spec, self.mesh), req.spec)
+            self._live_cache[lead.cls] = ent
+        live_j = ent[0]
+        wave_lanes = total + pad
+        every = self.refill_every
+
+        def on_boundary(c, s, _live=live_j, _L=wave_lanes):
+            if c % every:
+                return None
+            self._note_occupancy(_live(s), _L)
+            return None
+
         return drive_chunks(
             chunk_j, sims, poll_every=self.poll_every,
-            on_chunk=on_chunk,
+            on_chunk=on_chunk, on_boundary=on_boundary,
+        )
+
+    def _note_occupancy(self, live, lanes: int) -> None:
+        """Append one per-chunk occupancy sample — ``live`` is either a
+        host int (refill boundaries, already synced) or a device [L]
+        bool vector (the plain path's asynchronous readback)."""
+        with self._lock:
+            self._occ_samples.append((live, lanes))
+
+    # -- continuous wave refill (docs/22_refill.md) --------------------------
+
+    def _serve_refill_wave(self, lead: _Entry) -> None:
+        """Drive ONE refill-managed wave to retirement: pack the lead
+        (plus queued compatible requests, one whole slot each), then
+        re-dispatch the shared chunk program with a boundary controller
+        that (a) retires each request's lanes the chunk they die —
+        folding THAT request through its own fold program and
+        delivering its ResultHandle immediately, not at whole-wave
+        retirement — (b) frees the lanes of cancelled / deadline-
+        expired requests (flipped to ``t_stop=-inf`` pad capacity),
+        and (c) splices queued compatible requests' (seed, t_stop,
+        params) rows into freed lanes through the donated refill
+        program — all without recompiling anything after warmup."""
+        from cimba_tpu.core.loop import drive_chunks
+        from cimba_tpu.obs import metrics as _metrics
+
+        req = lead.request
+        wave = None
+        try:
+            cls_now = _pcache.program_class_key(
+                req.spec, _metrics.enabled(), mesh=self.mesh,
+                pack=req.pack,
+            )
+            if cls_now != lead.cls[0]:
+                raise ValueError(
+                    "serve: a trace-time global (dtype profile, "
+                    "obs.metrics/obs.trace state, eventset layout, or "
+                    "the pack default) changed between this request's "
+                    "submit and its dispatch — the compatibility key "
+                    "binds at submit time; resubmit after settling "
+                    "the globals"
+                )
+            wave = self._pack_refill(lead)
+            sims = self._init_refill_wave(wave)
+            on_chunk = self._on_chunk
+            tel = self._tel
+            if tel is not None:
+                user_hook = self._on_chunk
+                src = f"serve.{self._tel_name}.chunk"
+                rec = tel.spans
+
+                def on_chunk(n):
+                    tel.tick(src)
+                    if rec is not None and lead.span_wave is not None:
+                        rec.event(lead.trace, "chunk",
+                                  parent=lead.span_wave, n=n)
+                    if user_hook is not None:
+                        user_hook(n)
+
+            every = self.refill_every
+
+            def on_boundary(n, s):
+                if n % every:
+                    return None
+                return self._refill_boundary(wave, n, s)
+
+            sims = drive_chunks(
+                wave.chunk_j, sims, poll_every=self.poll_every,
+                on_chunk=on_chunk, on_boundary=on_boundary,
+            )
+            # final pass: every lane is dead — fold and deliver
+            # whatever retired during the last (unpolled) chunks
+            self._refill_boundary(wave, -1, sims, final=True)
+        except Exception as e:
+            members, seen = [], set()
+            if wave is not None:
+                for s in wave.slots:
+                    e2 = s.entry
+                    if s.folded or e2.done.is_set() or id(e2) in seen:
+                        continue
+                    seen.add(id(e2))
+                    members.append(e2)
+            else:
+                members = [lead]
+            if not members:
+                # every member already delivered/finished before the
+                # failure: nothing to fail — surface the error without
+                # killing the dispatcher thread (a dead dispatcher
+                # hangs every outstanding future)
+                import warnings
+
+                warnings.warn(
+                    "serve refill: late wave error after every member "
+                    f"delivered ({type(e).__name__}: {e})",
+                    RuntimeWarning,
+                )
+                return
+            self._batch_failed(members, e)
+
+    def _refill_slot_size(self, entry: _Entry) -> int:
+        """The entry's next WHOLE slot — ``min(eff_wave, R - next_lo)``,
+        the same partition the direct ``run_experiment_stream`` call
+        walks, so per-request folds stay bitwise the direct call's.
+        Refill admits one slot per request at a time: a request's
+        slots always fold in ``lo`` order (the accumulator's merge
+        order is part of the bitwise contract)."""
+        return min(
+            entry.eff_wave,
+            entry.request.n_replications - entry.next_lo,
+        )
+
+    def _claim_compatible(self, cls, budget: int, now: float, *,
+                          strict_priority: bool) -> list:
+        """The ONE queue scan both refill claim sites use (initial
+        fill and boundary admission — one definition, so the paths
+        cannot drift): take same-class entries, ONE whole slot each,
+        in priority order, within ``budget`` lanes; drop cancelled
+        tombstones and finish deadline-expired entries with
+        ``DeadlineExceeded`` on the way.
+
+        ``strict_priority=True`` is the boundary-admission fairness
+        valve (docs/22_refill.md): only the priority-order PREFIX of
+        compatible entries is taken — the first live entry of another
+        class (or a solo retry) STOPS the scan, so a long-lived refill
+        wave can never starve other classes by letting its own class
+        jump the queue; with foreign work waiting, the wave stops
+        admitting, drains, and retires (the same bound the plain
+        dispatcher has).  Returns ``[(entry, n)]`` — NOT yet claimed;
+        the caller marks ``in_flight`` under the service lock."""
+        planned: list = []
+        dropped: list = []
+        state = {"budget": int(budget), "blocked": False}
+
+        def want(e: _Entry) -> bool:
+            if e.done.is_set():
+                return True      # cancelled tombstone: just remove
+            if e.deadline_at is not None and now > e.deadline_at:
+                dropped.append(e)
+                return True
+            if state["blocked"]:
+                return False
+            if e.solo or e.cls != cls or e.cancelled:
+                if strict_priority:
+                    state["blocked"] = True
+                return False
+            n = self._refill_slot_size(e)
+            if n > state["budget"]:
+                return False
+            planned.append((e, n))
+            state["budget"] -= n
+            return True
+
+        self._queue.take(want)
+        for e in dropped:
+            self._finish(
+                e,
+                exc=DeadlineExceeded(
+                    e.request.deadline, now - e.submit_t, e.label,
+                ),
+                outcome="deadline_exceeded",
+            )
+        return planned
+
+    def _pack_refill(self, lead: _Entry) -> _RefillWave:
+        """The refill twin of :meth:`_pack`: build the initial wave —
+        the lead's next whole slot plus queued same-class requests
+        (ONE whole slot each, priority order) — and the per-lane
+        request ownership table the boundary controller works against.
+        Pad lanes are born into the free pool: reclaimable capacity,
+        not dead weight."""
+        wave = _RefillWave(lead.cls, bool(lead.solo))
+        budget = self.max_wave - self._refill_slot_size(lead)
+        planned: list = []
+        if budget > 0 and not lead.solo:
+            planned = self._claim_compatible(
+                lead.cls, budget, time.monotonic(),
+                strict_priority=False,
+            )
+        members = [lead]
+        with self._lock:
+            slots = [_RefillSlot(
+                lead, lead.next_lo, self._refill_slot_size(lead)
+            )]
+            for e, n in planned:
+                if e.done.is_set():  # cancelled before the claim: drop
+                    continue
+                e.in_flight = True
+                members.append(e)
+                slots.append(_RefillSlot(e, e.next_lo, n))
+            for e in members:
+                if e.first_dispatch_t is None:
+                    e.first_dispatch_t = time.monotonic()
+            total = sum(s.n for s in slots)
+            # a refill wave's shape is FROZEN for its whole (open-ended)
+            # life, and under sustained load it never retires — a wave
+            # born small would cap the service's throughput at its
+            # birth shape forever.  With pad_waves on, refill waves are
+            # therefore born at FULL quantized capacity: the pad lanes
+            # are reclaimable admission headroom (t_stop=-inf, bitwise
+            # inert), not waste (docs/22_refill.md).  pad_waves=False
+            # keeps the exact packed shape (the latency-insensitive /
+            # test-deterministic arm).
+            if self.pad_waves and not wave.no_admit:
+                cap = self.max_wave
+                if self.mesh is not None:
+                    unit = int(self.mesh.devices.size)
+                    cap -= cap % unit
+                pad = max(cap, total) - total
+            elif self.pad_waves:
+                # a solo (no-admit) wave can never USE admission
+                # headroom — quantize like the plain path instead of
+                # dispatching max_wave-wide chunks for nothing
+                pad = self._wave_shape(total) - total
+            else:
+                pad = 0
+            self._counters["batches"] += 1
+            wave.batch_no = self._counters["batches"]
+            self._counters["waves"] += len(slots)
+            self._counters["lanes_dispatched"] += total
+            self._counters["lanes_padded"] += pad
+            k = len(members)
+            self._occupancy[k] = self._occupancy.get(k, 0) + 1
+            self._depth_samples.append((
+                time.monotonic(), self._queue.depth(),
+                self._class_sample(), total, pad,
+            ))
+        off = 0
+        for s in slots:
+            s.lanes = list(range(off, off + s.n))
+            off += s.n
+        wave.slots = slots
+        wave.free = list(range(total, total + pad))
+        wave.L = total + pad
+        rec = self._tel.spans if self._tel is not None else None
+        if rec is not None:
+            for e in members:
+                if e.trace is None:
+                    continue
+                if e.span_queue is not None:
+                    rec.end(e.span_queue)
+                    e.span_queue = None
+                e.span_wave = rec.start(
+                    e.trace, "wave", parent=e.span_root,
+                    batch=wave.batch_no, members=len(members),
+                    lanes=total, padded=pad, refill=True,
+                )
+        return wave
+
+    def _init_refill_wave(self, wave: _RefillWave):
+        """Compile/fetch the wave's programs and init its lanes.  Like
+        :meth:`_run_batch`'s init leg, except the per-lane ``t_stop``
+        column is ALWAYS materialized (``t_end=None`` rides as
+        ``+inf`` — bitwise the no-horizon cond, docs/14) because lane
+        death, reclamation, and splicing are all horizon-driven."""
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.runner import experiment as ex
+
+        lead = wave.slots[0].entry
+        req = lead.request
+        wave.init_j, wave.chunk_j = _pcache.get_programs(
+            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+            chunk_steps=req.chunk_steps, with_metrics=lead.with_metrics,
+        )
+        wave.refill_j, wave.live_j = _pcache.get_refill_programs(
+            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+            with_metrics=lead.with_metrics,
+        )
+        for s in wave.slots:
+            _pcache.preflight_summary_path(
+                self.cache, s.entry.request.spec, wave.init_j,
+                s.entry.request.summary_path, s.entry.request.params,
+                s.entry.request.n_replications, s.n,
+                s.entry.with_metrics,
+            )
+        wave.pad_row = ex._slice_params(
+            req.params, req.n_replications, 0, 1
+        )
+        reps, seeds, t_stops, pws = [], [], [], []
+        for s in wave.slots:
+            e = s.entry
+            reps.append(jnp.arange(s.lo, s.lo + s.n))
+            seeds.append(ex._seed_column(e.request.seed, s.n))
+            t_stops.append(ex._horizon_column(e.request.t_end, s.n))
+            pws.append(ex._slice_params(
+                e.request.params, e.request.n_replications, s.lo, s.n
+            ))
+        pad = len(wave.free)
+        if pad:
+            reps.append(jnp.zeros((pad,), reps[0].dtype))
+            seeds.append(ex._seed_column(0, pad))
+            t_stops.append(jnp.full((pad,), -jnp.inf, t_stops[0].dtype))
+            pws.append(jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (pad,) + x.shape[1:]),
+                wave.pad_row,
+            ))
+        if len(reps) == 1:
+            cat = (reps[0], seeds[0], t_stops[0], pws[0])
+        else:
+            cat = (
+                jnp.concatenate(reps, axis=0),
+                jnp.concatenate(seeds, axis=0),
+                jnp.concatenate(t_stops, axis=0),
+                jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *pws
+                ),
+            )
+        return wave.init_j(*cat)
+
+    def _fold_refill_slot(self, s: _RefillSlot, sims) -> None:
+        """Retire one slot: gather its lanes (ascending lane order ==
+        replication order) and fold them through the REQUEST's own
+        fold program — the same accumulator walk the direct call's
+        contiguous wave slice takes, so the result stays bitwise."""
+        import jax
+        import jax.numpy as jnp
+
+        e = s.entry
+        fold_j = _pcache.get_fold(
+            self.cache, e.with_metrics, e.request.summary_path,
+        )
+        idx = jnp.asarray(s.lanes)
+        sl = jax.tree.map(lambda x: x[idx], sims)
+        if e.acc is None:
+            e.acc = _pcache.stream_acc(e.request.spec, e.with_metrics)
+        e.acc = fold_j(e.acc, sl)
+        e.n_waves += 1
+        e.next_lo = s.lo + s.n
+        if e.trace is not None:
+            self._tel.spans.event(
+                e.trace, "fold", parent=e.span_wave, lo=s.lo, n=s.n,
+            )
+
+    def _refill_boundary(self, wave: _RefillWave, n: int, sims,
+                         final: bool = False):
+        """The boundary controller, fired after every chunk: read the
+        per-lane liveness, retire slots whose lanes all died (fold +
+        deliver / requeue the remainder), reclaim the lanes of
+        cancelled and deadline-expired requests, and splice queued
+        compatible admissions into the free pool.  Returns the
+        respliced Sim when the wave changed (``drive_chunks`` then
+        discards its stale liveness polls), else None."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.runner import experiment as ex
+
+        live = np.asarray(wave.live_j(sims))
+        with self._lock:
+            self._counters["refill_boundaries"] += 1
+            self._occ_samples.append((int(live.sum()), wave.L))
+        rec = self._tel.spans if self._tel is not None else None
+        now = time.monotonic()
+
+        # 1) retire: fold slots whose lanes all died this chunk —
+        # completion wins over a simultaneous cancel/deadline
+        for s in wave.slots:
+            e = s.entry
+            if s.folded or e.done.is_set():
+                continue
+            if live[s.lanes].any():
+                continue
+            self._fold_refill_slot(s, sims)
+            s.folded = True
+            wave.free.extend(s.lanes)
+            with self._lock:
+                self._counters["refill_retirements"] += 1
+                e.in_flight = False
+            if rec is not None and e.span_wave is not None:
+                rec.end(e.span_wave, outcome="ok")
+                e.span_wave = None
+            if e.next_lo >= e.request.n_replications:
+                if not final:
+                    with self._lock:
+                        self._counters["mid_wave_deliveries"] += 1
+                self._finish_completed(e)
+            elif e.cancelled:
+                # cancelled while its slot was draining: its lanes are
+                # already free — finish NOW instead of requeueing the
+                # remainder (a requeued remainder would be re-admitted
+                # and burn a whole slot of device work before the
+                # Cancelled landed)
+                self._finish(e, exc=Cancelled(e.label),
+                             outcome="cancelled")
+            else:
+                # remaining slots go back through the queue — the
+                # admission scan below (or a later wave) picks the
+                # next whole slot up
+                if e.trace is not None:
+                    e.span_queue = rec.start(
+                        e.trace, "queue", parent=e.span_root,
+                        requeue=True,
+                    )
+                self._queue.requeue(e)
+
+        # 2) reclaim: free the lanes of cancelled / deadline-expired
+        # requests — their lanes flip to t_stop=-inf pad capacity, and
+        # the span tree closes exactly once with the right outcome
+        kills: list = []
+        for s in wave.slots:
+            e = s.entry
+            if s.folded or e.done.is_set():
+                continue
+            expired = e.deadline_at is not None and now > e.deadline_at
+            if not (e.cancelled or expired):
+                continue
+            s.folded = True  # retired without a fold
+            wave.free.extend(s.lanes)
+            kills.extend(s.lanes)
+            with self._lock:
+                e.in_flight = False
+                self._counters["lanes_reclaimed"] += s.n
+            if rec is not None and e.span_wave is not None:
+                rec.end(
+                    e.span_wave,
+                    outcome="cancelled" if e.cancelled else "deadline",
+                )
+                e.span_wave = None
+            if e.cancelled:
+                self._finish(e, exc=Cancelled(e.label),
+                             outcome="cancelled")
+            else:
+                self._finish(
+                    e,
+                    exc=DeadlineExceeded(
+                        e.request.deadline, now - e.submit_t, e.label,
+                    ),
+                    outcome="deadline_exceeded",
+                )
+
+        # 3) admit: splice queued compatible requests into free lanes
+        admitted: list = []
+        with self._lock:
+            stopping = self._stop
+        if not final and not stopping and wave.free and not wave.no_admit:
+            # strict_priority: the fairness valve — a refill wave only
+            # admits the priority-order PREFIX of compatible entries,
+            # so queued work of OTHER classes (which cannot splice
+            # into this wave) stops the refill instead of being
+            # starved behind an endlessly-refilled wave; the wave
+            # then drains and retires like a plain one
+            planned = self._claim_compatible(
+                wave.cls, len(wave.free), now, strict_priority=True,
+            )
+            free_sorted = sorted(wave.free)
+            with self._lock:
+                for e, m in planned:
+                    if e.done.is_set():
+                        continue
+                    e.in_flight = True
+                    if e.first_dispatch_t is None:
+                        e.first_dispatch_t = time.monotonic()
+                    s = _RefillSlot(e, e.next_lo, m)
+                    s.lanes = free_sorted[:m]
+                    free_sorted = free_sorted[m:]
+                    wave.slots.append(s)
+                    admitted.append(s)
+                    self._counters["refill_admissions"] += 1
+                    self._counters["lanes_refilled"] += m
+                    self._counters["waves"] += 1
+                    self._counters["lanes_dispatched"] += m
+            wave.free = free_sorted
+            if rec is not None:
+                for s in admitted:
+                    e = s.entry
+                    if e.trace is None:
+                        continue
+                    if e.span_queue is not None:
+                        rec.end(e.span_queue)
+                        e.span_queue = None
+                    # the per-admission refill span (docs/22_refill.md)
+                    sp = rec.start(
+                        e.trace, "refill", parent=e.span_root,
+                        boundary=n, batch=wave.batch_no, lanes=s.n,
+                        lo=s.lo,
+                    )
+                    e.span_wave = rec.start(
+                        e.trace, "wave", parent=e.span_root,
+                        batch=wave.batch_no, refill=True, lanes=s.n,
+                    )
+                    rec.end(sp)
+            for s in admitted:
+                e = s.entry
+                _pcache.preflight_summary_path(
+                    self.cache, e.request.spec, wave.init_j,
+                    e.request.summary_path, e.request.params,
+                    e.request.n_replications, s.n, e.with_metrics,
+                )
+
+        if final or (not kills and not admitted):
+            # (a final pass never splices — the wave is being retired,
+            # and any killed entries were already finished above)
+            return None
+
+        # 4) splice: one donated refill dispatch re-seeds exactly the
+        # masked lanes (admissions at their own (seed, horizon, rep,
+        # params) rows; reclaimed lanes as t_stop=-inf pads)
+        L = wave.L
+        rep_dt = np.asarray(jnp.arange(1)).dtype
+        mask = np.zeros((L,), bool)
+        reps = np.zeros((L,), rep_dt)
+        seeds = np.zeros((L,), np.uint64)
+        ts = np.full(
+            (L,), -np.inf,
+            np.asarray(ex._horizon_column(None, 1)).dtype,
+        )
+        if kills:
+            mask[np.asarray(kills)] = True
+        pw = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (L,) + x.shape[1:]),
+            wave.pad_row,
+        )
+        for s in admitted:
+            e = s.entry
+            idx = np.asarray(s.lanes)
+            mask[idx] = True
+            reps[idx] = np.arange(s.lo, s.lo + s.n, dtype=rep_dt)
+            seeds[idx] = np.uint64(e.request.seed)
+            ts[idx] = np.asarray(
+                ex._horizon_column(e.request.t_end, 1)
+            )[0]
+            rows = ex._slice_params(
+                e.request.params, e.request.n_replications, s.lo, s.n
+            )
+            jidx = jnp.asarray(idx)
+            pw = jax.tree.map(
+                lambda b, r, j=jidx: b.at[j].set(r), pw, rows
+            )
+        return wave.refill_j(
+            sims, jnp.asarray(mask), jnp.asarray(reps),
+            jnp.asarray(seeds), jnp.asarray(ts), pw,
         )
 
     def _fold_slots(self, slots, sims) -> None:

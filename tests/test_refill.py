@@ -1,0 +1,598 @@
+"""Continuous wave refill (docs/22_refill.md).
+
+Contracts pinned here:
+
+* **refilled == solo, bitwise, both profiles**: a request admitted into
+  another wave's freed lanes at a chunk boundary returns a
+  ``StreamResult`` bitwise equal to its direct single-caller
+  ``run_experiment_stream`` run at the same (seed, R, horizon, params)
+  — lane placement and admission timing are irrelevant to results
+  (per-lane seed/horizon columns + the masked per-lane re-init splice);
+* **pad-lane reclamation**: the pad-and-mask quantization lanes
+  (``t_stop=-inf``) are reclaimable capacity — a queued request splices
+  into them with full bitwise parity;
+* **staggered retirement**: mixed-horizon wave-mates retire at their
+  OWN chunk boundaries — each folded through its own fold program and
+  delivered immediately (``mid_wave_deliveries``), never held for
+  whole-wave retirement — exactly;
+* **mid-wave cancellation / deadline expiry** free the request's lanes
+  at the next boundary (flipped to ``t_stop=-inf``), the structured
+  error surfaces, and the telemetry span tree still closes exactly
+  once per outcome;
+* **refill-off is the baseline**: the ``refill`` trace gate proves the
+  ``CIMBA_REFILL`` knob never binds into a traced chunk program (the
+  PR-14 programs, character-identical), and the knob is registered in
+  ``config.ENV_KNOBS`` / resolved by ``Service(refill=None)``;
+* **zero compiles after warmup**: a second refill wave at the same
+  shapes adds no program-cache misses;
+* **ownership invariants under churn** (slow): a randomized
+  admit/retire soak delivers every request exactly once, bitwise.
+
+Deterministic scheduling comes from gated Service subclasses: the
+pack gate holds the wave until the queue state is constructed, and the
+boundary gate holds the first chunk boundary until the admissions
+under test are queued (the test_serve idiom, one level deeper).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import config, serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.stats import summary as sm
+
+
+def _tiny_spec(t_stop=12.0):
+    """Smallest chunkable model (hold/exit only): one process holding
+    unit steps — the test_serve tier-1 budget model."""
+    m = Model("tiny", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    """tiny records no user summary; pool each lane's final clock (one
+    MODULE-LEVEL function: compatibility and fold programs key on
+    summary_path identity)."""
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+def _assert_results_equal(a, b):
+    assert a.n_waves == b.n_waves
+    al = jax.tree.leaves((a.summary, a.n_failed, a.total_events))
+    bl = jax.tree.leaves((b.summary, b.n_failed, b.total_events))
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_spec()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+def _req(spec, R, *, seed=1, t_end=None, wave=None, **kw):
+    return serve.Request(
+        spec, (), R, seed=seed, t_end=t_end, chunk_steps=4,
+        wave_size=wave, summary_path=_clock_path, **kw,
+    )
+
+
+def _direct(spec, R, cache, *, seed, t_end=None, wave=None):
+    return ex.run_experiment_stream(
+        spec, (), R, wave_size=wave or R, chunk_steps=4, seed=seed,
+        t_end=t_end, summary_path=_clock_path, program_cache=cache,
+    )
+
+
+class _Gated(serve.Service):
+    """Refill service with two gates: ``pack_gate`` holds the wave's
+    initial pack (so every request meant to pack is queued first) and
+    ``release`` holds the chunk boundaries (so boundary admissions are
+    constructed, not raced — ``started`` flips when the wave reaches
+    its first boundary)."""
+
+    def __init__(self, **kw):
+        self.pack_gate = threading.Event()
+        self.started = threading.Event()
+        self.release = threading.Event()
+        kw.setdefault("refill", True)
+        kw.setdefault("horizon_bucket", None)
+        # control at EVERY boundary: the tests reason about exact
+        # chunk-boundary timing (production defaults to poll_every)
+        kw.setdefault("refill_every", 1)
+        super().__init__(**kw)
+
+    def _serve_refill_wave(self, lead):
+        assert self.pack_gate.wait(120), "pack gate never opened"
+        return super()._serve_refill_wave(lead)
+
+    def _refill_boundary(self, wave, n, sims, final=False):
+        self.started.set()
+        assert self.release.wait(120), "boundary gate never opened"
+        return super()._refill_boundary(wave, n, sims, final=final)
+
+
+# --------------------------------------------------------------------------
+# refilled == solo, bitwise, both dtype profiles
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_refilled_request_bitwise_equals_solo(profile):
+    """The headline contract: a lead + a short-horizon mate pack; the
+    short's lanes die and free; a request QUEUED AFTER THE WAVE STARTED
+    is spliced into the freed lanes — and all three results are bitwise
+    their direct solo runs at the same per-lane seeds, on both dtype
+    profiles."""
+    with config.profile(profile):
+        spec = _tiny_spec()
+        cache = pc.ProgramCache(capacity=64)
+        svc = _Gated(max_wave=8, cache=cache, pad_waves=False)
+        try:
+            lead = svc.submit(
+                _req(spec, 4, seed=1, t_end=10.0, label="lead")
+            )
+            short = svc.submit(
+                _req(spec, 4, seed=7, t_end=3.0, label="short")
+            )
+            svc.pack_gate.set()
+            assert svc.started.wait(120)
+            queued = svc.submit(
+                _req(spec, 4, seed=9, t_end=6.0, label="queued")
+            )
+            svc.release.set()
+            results = {
+                "lead": (lead.result(300), 1, 10.0),
+                "short": (short.result(300), 7, 3.0),
+                "queued": (queued.result(300), 9, 6.0),
+            }
+            stats = svc.stats()
+        finally:
+            svc.pack_gate.set()
+            svc.release.set()
+            svc.shutdown()
+        assert stats["refill"]["refill_admissions"] >= 1, stats["refill"]
+        assert stats["refill"]["refill_retirements"] >= 2
+        assert stats["refill"]["mid_wave_deliveries"] >= 1
+        for label, (res, seed, t_end) in results.items():
+            d = _direct(spec, 4, cache, seed=seed, t_end=t_end)
+            _assert_results_equal(res, d)
+
+
+# --------------------------------------------------------------------------
+# pad-lane reclamation
+# --------------------------------------------------------------------------
+
+
+def test_pad_lane_reclamation_parity(tiny, shared_cache):
+    """With pad_waves on, a refill wave is born at FULL quantized
+    capacity (max_wave=8; 3 packed lanes + 5 reclaimable pads) and a
+    request queued mid-wave is spliced into the pad headroom — both
+    results bitwise their direct runs."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=8, cache=cache, pad_waves=True)
+    try:
+        lead = svc.submit(_req(spec, 3, seed=2, t_end=9.0, label="lead"))
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        queued = svc.submit(
+            _req(spec, 1, seed=3, t_end=5.0, label="padfill")
+        )
+        svc.release.set()
+        rl, rq = lead.result(300), queued.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    assert st["lane_occupancy"]["lanes_padded"] == 5  # born at capacity
+    assert st["refill"]["refill_admissions"] >= 1
+    _assert_results_equal(rl, _direct(spec, 3, cache, seed=2, t_end=9.0))
+    _assert_results_equal(rq, _direct(spec, 1, cache, seed=3, t_end=5.0))
+
+
+# --------------------------------------------------------------------------
+# staggered retirement + multi-slot continuation
+# --------------------------------------------------------------------------
+
+
+def test_mixed_horizon_staggered_retirement_exact(tiny, shared_cache):
+    """Three horizons in one wave retire at three different boundaries;
+    each is delivered at ITS boundary (mid_wave_deliveries counts the
+    early ones) and each is bitwise its direct run — and a multi-slot
+    request's later slots ride refill admissions with the fold order
+    (and so the accumulator) exactly the direct call's."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=8, cache=cache, pad_waves=False)
+    try:
+        # lead R=8 in slots of 4: slot 2 is admitted via refill after
+        # slot 1 retires
+        lead = svc.submit(
+            _req(spec, 8, seed=4, t_end=10.0, wave=4, label="lead")
+        )
+        a = svc.submit(_req(spec, 2, seed=5, t_end=2.0, label="a"))
+        b = svc.submit(_req(spec, 2, seed=6, t_end=5.0, label="b"))
+        svc.pack_gate.set()
+        svc.release.set()
+        rl, ra, rb = lead.result(300), a.result(300), b.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    assert rl.n_waves == 2  # two slots, two folds — the direct partition
+    assert st["refill"]["mid_wave_deliveries"] >= 2, st["refill"]
+    assert st["refill"]["refill_admissions"] >= 1
+    # total events ordering proves staggering: a < b < lead
+    assert int(ra.total_events) < int(rb.total_events)
+    _assert_results_equal(
+        rl, _direct(spec, 8, cache, seed=4, t_end=10.0, wave=4)
+    )
+    _assert_results_equal(ra, _direct(spec, 2, cache, seed=5, t_end=2.0))
+    _assert_results_equal(rb, _direct(spec, 2, cache, seed=6, t_end=5.0))
+    # the live-occupancy series saw the wave (decay and refill are
+    # observable in real time, not just at pack time)
+    occ = st["lane_occupancy"]
+    assert occ["occupancy_samples"] >= 1
+    assert occ["lanes_in_wave"] >= 4
+
+
+# --------------------------------------------------------------------------
+# mid-wave cancellation and deadline expiry
+# --------------------------------------------------------------------------
+
+
+def test_cancel_mid_wave_frees_lanes_span_closes_once(
+    tiny, shared_cache, tmp_path,
+):
+    """Cancelling a request whose lanes are mid-wave succeeds (refill
+    mode): its lanes flip to reclaimable ``t_stop=-inf`` capacity at
+    the next boundary, the future raises ``Cancelled``, wave-mates are
+    unperturbed (bitwise), and the span tree closes exactly once."""
+    from cimba_tpu.obs import telemetry as tm
+
+    spec, cache = tiny, shared_cache
+    tel = tm.Telemetry(
+        interval=0, spans=True, span_path=tmp_path / "spans.jsonl",
+    )
+    svc = _Gated(
+        max_wave=4, cache=cache, pad_waves=False, telemetry=tel,
+    )
+    try:
+        lead = svc.submit(
+            _req(spec, 2, seed=4, t_end=20.0, label="lead")
+        )
+        victim = svc.submit(
+            _req(spec, 2, seed=5, t_end=20.0, label="victim")
+        )
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        assert victim.cancel()          # in flight, refill: honored
+        assert not victim.done()        # ...at the NEXT boundary
+        svc.release.set()
+        with pytest.raises(serve.Cancelled):
+            victim.result(300)
+        rl = lead.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    assert st["cancelled"] == 1 and st["completed"] == 1
+    assert st["refill"]["lanes_reclaimed"] == 2
+    _assert_results_equal(
+        rl, _direct(spec, 2, cache, seed=4, t_end=20.0)
+    )
+    # exactly one complete span tree per outcome, nothing left open
+    assert tel.spans.open_count() == 0
+    assert (
+        tel.spans.counters["traces_started"]
+        == tel.spans.counters["traces_ended"]
+        == 2
+    )
+    tel.close()
+
+
+def test_deadline_expiry_mid_wave_frees_lanes(tiny, shared_cache):
+    """A deadline expiring while the request's lanes are mid-wave fails
+    it with ``DeadlineExceeded`` (waited time included) at the next
+    chunk boundary — lanes freed, wave-mates bitwise-unperturbed."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=4, cache=cache, pad_waves=False)
+    try:
+        lead = svc.submit(
+            _req(spec, 2, seed=6, t_end=20.0, label="lead")
+        )
+        doomed = svc.submit(
+            _req(spec, 2, seed=7, t_end=20.0, label="doomed",
+                 deadline=0.3)
+        )
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        time.sleep(0.45)  # deadline passes while lanes are mid-wave
+        svc.release.set()
+        with pytest.raises(serve.DeadlineExceeded) as ei:
+            doomed.result(300)
+        assert ei.value.waited_s >= 0.3
+        rl = lead.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    assert st["deadline_exceeded"] == 1
+    assert st["refill"]["lanes_reclaimed"] == 2
+    _assert_results_equal(
+        rl, _direct(spec, 2, cache, seed=6, t_end=20.0)
+    )
+
+
+def test_foreign_class_queued_stops_boundary_admissions(
+    tiny, shared_cache,
+):
+    """The fairness valve: boundary admissions take only the
+    priority-order PREFIX of compatible entries — a queued request of
+    ANOTHER class (which can never splice into this wave) stops the
+    refill, so the wave drains and retires instead of starving it
+    behind an endlessly-refilled same-class stream."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(
+        max_wave=8, cache=cache, pad_waves=True, horizon_bucket=16.0,
+    )
+    try:
+        # lead in horizon bucket 0; 'foreign' in bucket 2 (different
+        # class); 'mate' back in bucket 0 but QUEUED BEHIND foreign
+        lead = svc.submit(
+            _req(spec, 4, seed=1, t_end=12.0, label="lead")
+        )
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        foreign = svc.submit(
+            _req(spec, 2, seed=2, t_end=500.0, label="foreign")
+        )
+        mate = svc.submit(
+            _req(spec, 2, seed=3, t_end=6.0, label="mate")
+        )
+        svc.release.set()
+        rl = lead.result(300)
+        rf = foreign.result(300)
+        rm = mate.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    # the valve held: nothing was admitted into the lead's wave even
+    # though 'mate' was compatible and pad headroom was free
+    assert st["refill"]["refill_admissions"] == 0, st["refill"]
+    assert st["completed"] == 3
+    _assert_results_equal(
+        rl, _direct(spec, 4, cache, seed=1, t_end=12.0)
+    )
+    _assert_results_equal(
+        rf, _direct(spec, 2, cache, seed=2, t_end=500.0)
+    )
+    _assert_results_equal(
+        rm, _direct(spec, 2, cache, seed=3, t_end=6.0)
+    )
+
+
+def test_cancelled_multislot_remainder_not_readmitted(
+    tiny, shared_cache,
+):
+    """A multi-slot request cancelled while its current slot drains is
+    finished with ``Cancelled`` at the boundary where the slot dies —
+    its remainder is NEVER requeued/re-admitted to burn another slot
+    of device work."""
+    spec, cache = tiny, shared_cache
+    svc = _Gated(max_wave=4, cache=cache, pad_waves=False)
+    try:
+        # R=8 in slots of 4; chunk_steps large enough that slot 1's
+        # lanes are all dead by the first boundary
+        victim = svc.submit(serve.Request(
+            spec, (), 8, seed=4, t_end=4.0, chunk_steps=64,
+            wave_size=4, summary_path=_clock_path, label="victim",
+        ))
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        assert victim.cancel()
+        svc.release.set()
+        with pytest.raises(serve.Cancelled):
+            victim.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    assert st["cancelled"] == 1
+    # slot 2 never ran: no refill admission, exactly one slot ever
+    # dispatched (the initial pack's)
+    assert st["refill"]["refill_admissions"] == 0, st["refill"]
+    assert st["waves"] == 1, st
+
+
+# --------------------------------------------------------------------------
+# live occupancy on the PLAIN dispatch path (the stale-stats fix)
+# --------------------------------------------------------------------------
+
+
+def test_plain_path_lane_occupancy_rebuilt_from_live_readback(
+    tiny, shared_cache,
+):
+    """With refill OFF, ``stats()["lane_occupancy"]`` is no longer the
+    pack-time snapshot: the per-chunk live-lane readback populates the
+    occupancy series (decay over a wave's life is visible to /varz and
+    the fleet health scraper), without perturbing results."""
+    spec, cache = tiny, shared_cache
+    with serve.Service(
+        max_wave=8, cache=cache, refill=False, horizon_bucket=None,
+    ) as svc:
+        res = svc.submit(
+            _req(spec, 4, seed=8, t_end=9.0, label="plain")
+        ).result(300)
+        st = svc.stats()
+    occ = st["lane_occupancy"]
+    assert occ["occupancy_samples"] >= 1, occ
+    assert occ["lanes_in_wave"] == 4
+    assert 0.0 <= occ["occupancy_mean"] <= 1.0
+    assert st["refill"]["enabled"] is False
+    assert st["refill"]["refill_boundaries"] == 0
+    _assert_results_equal(
+        res, _direct(spec, 4, cache, seed=8, t_end=9.0)
+    )
+
+
+# --------------------------------------------------------------------------
+# the refill trace gate + knob registration
+# --------------------------------------------------------------------------
+
+
+def test_refill_gate_off_is_pr14_baseline():
+    """The ``refill`` gate in the check/gates.py registry: CIMBA_REFILL
+    never binds into a traced chunk program — explicit-off, ambient-set,
+    and env-off arms are all character-identical to the baseline, both
+    profiles (refill is a host-side dispatch policy; the chunk program
+    is the PR-14 one byte-for-byte)."""
+    from cimba_tpu.check import gates as G
+
+    refill_gates = [g for g in G.GATES if g.name == "refill"]
+    assert len(refill_gates) == 1
+    findings, report = G.sweep(gates=refill_gates, model="tiny")
+    assert not findings, findings
+    for prof in ("f64", "f32"):
+        assert "ambient-inert" in report[f"refill/{prof}"]
+        assert "env-off==off" in report[f"refill/{prof}"]
+    assert "CIMBA_REFILL" in G.claimed_env_knobs()
+    assert config.ENV_KNOBS["CIMBA_REFILL"]["trace_gate"] is True
+
+
+def test_refill_env_knob_resolves_service_default(
+    tiny, shared_cache, monkeypatch,
+):
+    """``Service(refill=None)`` defers to CIMBA_REFILL; explicit
+    arguments win either way."""
+    monkeypatch.delenv("CIMBA_REFILL", raising=False)
+    with serve.Service(max_wave=4, cache=shared_cache) as svc:
+        assert svc.refill is False
+        assert svc.stats()["refill"]["enabled"] is False
+    monkeypatch.setenv("CIMBA_REFILL", "1")
+    with serve.Service(max_wave=4, cache=shared_cache) as svc:
+        assert svc.refill is True
+    with serve.Service(
+        max_wave=4, cache=shared_cache, refill=False,
+    ) as svc:
+        assert svc.refill is False
+
+
+# --------------------------------------------------------------------------
+# zero compiles after warmup
+# --------------------------------------------------------------------------
+
+
+def test_refill_zero_program_cache_misses_after_warm(tiny):
+    """Two identical refill-wave rounds against one cache: the second
+    adds NO program-cache misses — boundary splices dispatch cached
+    programs, never compile (the steady-state serving contract)."""
+    spec = tiny
+    cache = pc.ProgramCache(capacity=64)
+
+    def round_():
+        svc = _Gated(max_wave=8, cache=cache, pad_waves=False)
+        try:
+            lead = svc.submit(
+                _req(spec, 4, seed=1, t_end=10.0, label="lead")
+            )
+            short = svc.submit(
+                _req(spec, 4, seed=7, t_end=3.0, label="short")
+            )
+            svc.pack_gate.set()
+            assert svc.started.wait(120)
+            queued = svc.submit(
+                _req(spec, 4, seed=9, t_end=6.0, label="queued")
+            )
+            svc.release.set()
+            for h in (lead, short, queued):
+                assert h.result(300) is not None
+            return svc.stats()["refill"]
+        finally:
+            svc.pack_gate.set()
+            svc.release.set()
+            svc.shutdown()
+
+    r1 = round_()
+    assert r1["refill_admissions"] >= 1
+    misses_warm = cache.stats()["misses"]
+    r2 = round_()
+    assert r2["refill_admissions"] >= 1
+    assert cache.stats()["misses"] == misses_warm
+
+
+# --------------------------------------------------------------------------
+# ownership-table invariants under a randomized admit/retire soak
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_refill_ownership_soak_randomized(tiny):
+    """The churn battery (tools/ci.sh runs it): a deterministic PRNG
+    stream of requests with mixed (seed, R, horizon) drives an
+    ungated refill service open-loop.  Invariants: every request
+    delivers exactly once, every result is bitwise its direct solo
+    run, the lane ledger balances (dispatched lanes == the sum of
+    every slot ever packed or refilled), and occupancy samples stay in
+    [0, 1]."""
+    spec = tiny
+    cache = pc.ProgramCache(capacity=64)
+    rng = np.random.RandomState(20260804)
+    reqs = []
+    for i in range(24):
+        R = int(rng.choice([1, 2, 3, 4]))
+        seed = int(rng.randint(1, 1000))
+        t_end = float(rng.choice([2.0, 4.0, 7.0, 11.0]))
+        reqs.append((R, seed, t_end))
+    svc = serve.Service(
+        max_wave=8, cache=cache, refill=True, horizon_bucket=None,
+        pad_waves=True,
+    )
+    handles = []
+    try:
+        for i, (R, seed, t_end) in enumerate(reqs):
+            handles.append(svc.submit(
+                _req(spec, R, seed=seed, t_end=t_end, label=f"r{i}")
+            ))
+            time.sleep(0.005 * int(rng.randint(0, 4)))
+        results = [h.result(600) for h in handles]
+        st = svc.stats()
+    finally:
+        svc.shutdown()
+    assert st["completed"] == len(reqs)
+    for (R, seed, t_end), res in zip(reqs, results):
+        _assert_results_equal(
+            res, _direct(spec, R, cache, seed=seed, t_end=t_end)
+        )
+    # lane ledger: every dispatched lane belongs to exactly one slot
+    total_lanes = sum(R for R, _, _ in reqs)
+    assert st["lanes_dispatched"] == total_lanes
+    occ = st["lane_occupancy"]
+    assert 0.0 <= occ["occupancy_mean"] <= 1.0
+    assert occ["occupancy_samples"] >= 1

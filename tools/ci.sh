@@ -284,6 +284,101 @@ print("mixed-traffic smoke OK: occupancy", occ,
       "lanes", stats["lane_occupancy"])
 EOF
 
+# refill smoke (docs/22_refill.md): 3 mixed-horizon clients through ONE
+# long-lived wave — the short client's lanes free at a chunk boundary
+# and a client queued AFTER the wave started is spliced into them
+# (>= 1 boundary refill observed), every result bitwise its direct
+# call, the live-occupancy floor holds, and the warmed round adds ZERO
+# program-cache misses (boundary splices dispatch, never compile)
+run_cell "refill smoke" python - <<'EOF'
+import threading
+import numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+# (label, n_objects, R, seed, t_end): the long lead outlives the short
+# mate by 4x, so the short's lanes free with the wave still live
+cases = [("lead", 60, 4, 1, 60.0), ("short", 90, 4, 5, 15.0),
+         ("late", 75, 4, 9, 30.0)]
+
+
+class _Gated(serve.Service):
+    """pack_gate holds the wave until lead+short are queued; started
+    flips at the first chunk boundary (the 'late' client then submits
+    into a RUNNING wave); release opens the boundaries."""
+
+    def __init__(self, **kw):
+        self.pack_gate = threading.Event()
+        self.started = threading.Event()
+        self.release = threading.Event()
+        super().__init__(**kw)
+
+    def _serve_refill_wave(self, lead):
+        assert self.pack_gate.wait(600)
+        return super()._serve_refill_wave(lead)
+
+    def _refill_boundary(self, wave, n, sims, final=False):
+        self.started.set()
+        assert self.release.wait(600)
+        return super()._refill_boundary(wave, n, sims, final=final)
+
+
+def round_():
+    svc = _Gated(max_wave=8, cache=cache, refill=True, refill_every=1,
+                 horizon_bucket=None, pad_waves=False)
+    out = {}
+    try:
+        handles = {}
+        for label, n, R, seed, t_end in cases[:2]:
+            handles[label] = svc.submit(serve.Request(
+                spec, mm1.params(n), R, seed=seed, t_end=t_end,
+                wave_size=R, chunk_steps=16, label=label,
+            ))
+        svc.pack_gate.set()
+        assert svc.started.wait(600)
+        label, n, R, seed, t_end = cases[2]
+        handles[label] = svc.submit(serve.Request(
+            spec, mm1.params(n), R, seed=seed, t_end=t_end,
+            wave_size=R, chunk_steps=16, label=label,
+        ))
+        svc.release.set()
+        for label in handles:
+            out[label] = handles[label].result(600)
+        return out, svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+
+
+round_()                                   # warm: compiles everything
+misses_warm = cache.stats()["misses"]
+out, stats = round_()                      # measured round
+assert cache.stats()["misses"] == misses_warm, (
+    "refill round compiled after warm", cache.stats())
+for label, n, R, seed, t_end in cases:
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(n), R, wave_size=R, chunk_steps=16,
+        seed=seed, t_end=t_end, program_cache=cache,
+    )
+    res = out[label]
+    assert int(res.total_events) == int(direct.total_events), label
+    assert float(sm.mean(res.summary)) == float(
+        sm.mean(direct.summary)), label
+    assert float(res.summary.n) == float(direct.summary.n), label
+ref = stats["refill"]
+occ = stats["lane_occupancy"]
+assert ref["refill_admissions"] >= 1, ref
+assert ref["mid_wave_deliveries"] >= 1, ref
+assert occ["occupancy_mean"] >= 0.4, occ
+print("refill smoke OK:", ref, "| occupancy_mean",
+      round(occ["occupancy_mean"], 3), "| cache misses 0 after warm")
+EOF
+
 # sweep smoke: the many-scenario engine (docs/16_sweeps.md) — an easy
 # cell must provably stop >= 1 round before a hard cell under adaptive
 # stopping, and fixed-R engine cells must be BITWISE the direct
