@@ -1780,11 +1780,19 @@ def bench_serve_fleet():
     requests share one seed, so one direct anchor covers them); the
     chaos arm must complete 100% of its requests.  Slices hydrate from
     a warm store built once up front, so per-arm startup is process
-    spawn + deserialize, not recompile.  Knobs:
+    spawn + deserialize, not recompile.  A final capacity A/B
+    (docs/23_fleet_observability.md) drives the SAME offered
+    mixed-horizon refill load through 2 refill slices under
+    capacity-aware vs queue-depth placement — p99 + goodput per arm,
+    per-template digests anchored against direct solo runs, with the
+    per-slice occupancy timeline and the router's ``cimba_fleet_*``
+    snapshot in the run card.  Knobs:
     ``CIMBA_BENCH_FLEET_REQ_R`` (replications/request),
     ``CIMBA_BENCH_FLEET_REQUESTS``, ``CIMBA_BENCH_FLEET_IAT``
-    (inter-arrival seconds).  Under ``CIMBA_BENCH_RUN_CARD`` the line
-    lands as a PR 9 run card like every other battery line."""
+    (inter-arrival seconds), ``CIMBA_BENCH_FLEET_CAP_REQS`` /
+    ``CIMBA_BENCH_FLEET_CAP_IAT`` (the A/B's own load).  Under
+    ``CIMBA_BENCH_RUN_CARD`` the line lands as a PR 9 run card like
+    every other battery line."""
     import tempfile
 
     from cimba_tpu import serve
@@ -1915,6 +1923,147 @@ def bench_serve_fleet():
             {"slice": name, "event": ev, "reason": reason[:120]}
             for _, name, ev, reason in fm.poller.transitions
         ]
+    # capacity A/B (docs/23_fleet_observability.md): the SAME offered
+    # open-loop mixed-horizon load through 2 refill slices, once with
+    # capacity-aware placement (free-lane headroom off the scrapes)
+    # and once pinned to queue-depth least-loaded — p99 + goodput per
+    # arm, every digest anchored against its template's direct solo
+    # run, with the per-slice occupancy timeline (from the same health
+    # scrapes placement reads) and the router's cimba_fleet_* snapshot
+    # in the run card
+    import threading as _threading
+
+    from cimba_tpu.obs import telemetry as _telem
+
+    cap_r = max(req_r // 4, 1)
+    n_cap = int(os.environ.get("CIMBA_BENCH_FLEET_CAP_REQS", "16"))
+    cap_iat = float(os.environ.get("CIMBA_BENCH_FLEET_CAP_IAT", "0.02"))
+
+    def cap_templates(fspec):
+        # one compatibility class, three workload lengths 4x/20x apart
+        # (the docs/22 mixed-horizon decay shape) so refill lanes
+        # actually free mid-wave and the free-lane pool moves
+        def req(s, n):
+            return serve.Request(
+                fspec, mm1.params(n), cap_r, seed=s,
+                wave_size=cap_r, chunk_steps=chunk,
+            )
+
+        return [
+            serve.RequestTemplate("long", req(11, objs)),
+            serve.RequestTemplate("mid", req(22, max(objs // 4, 1)), 2.0),
+            serve.RequestTemplate("short", req(33, max(objs // 20, 1)), 3.0),
+        ]
+
+    cap_anchor = {}
+    for t in cap_templates(spec):
+        r = t.request
+        cap_anchor[t.name] = _audit.stream_result_digest(
+            ex.run_experiment_stream(
+                spec, r.params, r.n_replications, wave_size=r.wave_size,
+                chunk_steps=r.chunk_steps, seed=r.seed,
+                program_cache=pc.ProgramCache(),
+                on_wave=_heartbeat, on_chunk=_heartbeat,
+            )
+        )
+
+    def capacity_arm(capacity):
+        tel = _telem.Telemetry(interval=0.1)
+        timeline: list = []
+        stop = _threading.Event()
+
+        def occ_poller(fm):
+            while not stop.wait(0.1):
+                snap = {}
+                for name, h in fm.router.slices().items():
+                    sc = h.scraped or {}
+                    if h.up and sc.get("occupancy_now") is not None:
+                        snap[name] = {
+                            "occupancy_now": sc["occupancy_now"],
+                            "free_lanes": sc.get("free_lanes"),
+                        }
+                if snap:
+                    timeline.append(snap)
+
+        with FleetManager(
+            models, n_slices=2, max_wave=req_r, store=store_dir,
+            warm_chunk_steps=chunk, window=2, poll_interval=0.2,
+            telemetry=tel, capacity_placement=capacity,
+            slice_env={0: {"CIMBA_REFILL": "1"},
+                       1: {"CIMBA_REFILL": "1"}},
+        ) as fm:
+            # warm every template onto every slice (compiles land
+            # here, not in the timed leg — both arms identically)
+            warm, _ = serve.mixed_requests(
+                cap_templates(fm.spec("mm1")), 8
+            )
+            serve.run_load(
+                fm.router, warm, n_clients=4, result_timeout=600,
+            )
+            _heartbeat()
+            th = _threading.Thread(
+                target=occ_poller, args=(fm,), daemon=True,
+            )
+            th.start()
+            try:
+                report = serve.run_mixed_load(
+                    fm.router, cap_templates(fm.spec("mm1")), n_cap,
+                    n_clients=4, inter_arrival_s=cap_iat,
+                    result_timeout=600,
+                )
+            finally:
+                stop.set()
+                th.join()
+            _heartbeat()
+            assert report.n_completed == n_cap, (
+                "capacity A/B arm lost requests", capacity,
+                report.errors,
+            )
+            for i, res in report.results:
+                assert (_audit.stream_result_digest(res)
+                        == cap_anchor[report.template_names[i]])
+            placed_by = {}
+            for d in fm.router.decision_log():
+                if d[0] == "place":
+                    k = d[3][0] if d[3] else "none"
+                    placed_by[k] = placed_by.get(k, 0) + 1
+            fleet_snapshot = {}
+            for fam in tel.registry.collect():
+                if not fam["name"].startswith("cimba_fleet_"):
+                    continue
+                fleet_snapshot[fam["name"]] = {
+                    ",".join(f"{k}={v}" for k, v in
+                             sorted(s["labels"].items())): (
+                        s["value"] if "value" in s
+                        else {"count": s.get("count"),
+                              "sum": s.get("sum")}
+                    )
+                    for s in fam["series"]
+                }
+            detail = {
+                "capacity_placement": capacity,
+                "requests": report.n_requests,
+                "completed": report.n_completed,
+                "wall_s": report.wall_s,
+                "goodput_reps_per_sec": report.replications_per_sec,
+                "latency": report.latency_percentiles(),
+                "per_template": report.per_template(),
+                "placement_snapshots": placed_by,
+                "occupancy_timeline": timeline,
+                "fleet_telemetry": fleet_snapshot,
+            }
+        tel.close()
+        _heartbeat()
+        return detail
+
+    capacity_ab = {
+        "queue_depth": capacity_arm(False),
+        "capacity_aware": capacity_arm(True),
+        "replications_per_request": cap_r,
+        "requests": n_cap,
+        "inter_arrival_s": cap_iat,
+    }
+
     headline = arms["slices_2"]["replications_per_sec"]
     _line(
         "serve_fleet_reps_per_sec",
@@ -1929,6 +2078,7 @@ def bench_serve_fleet():
             "chunk_steps": chunk,
             "arms": arms,
             "chaos": chaos,
+            "capacity_ab": capacity_ab,
             "anchor_digest": anchor,
             "store": store_dir,
         },

@@ -234,6 +234,27 @@ ENV_KNOBS = {
             "num_processes,process_id — off (the default) never "
             "touches jax.distributed",
     ),
+    "CIMBA_FLEET_TELEMETRY": dict(
+        default="", trace_gate=False,
+        doc="fleet trace plane (docs/23_fleet_observability.md): a "
+            "DIRECTORY path makes every slice process attach a "
+            "Telemetry plane and write its span JSONL to "
+            "<dir>/<slice>.spans.jsonl, adopting the trace context "
+            "carried by run headers so slice span trees graft under "
+            "the router's wire spans; empty (the default) = no slice "
+            "telemetry, zero cost — a host-side observability knob "
+            "with no traced-program effect",
+    ),
+    "CIMBA_FLEET_CAPACITY": dict(
+        default="1", trace_gate=False,
+        doc="capacity-aware fleet placement "
+            "(docs/23_fleet_observability.md): on (the default), the "
+            "router ranks candidate slices by scraped free-lane "
+            "headroom whenever EVERY candidate reports the refill "
+            "capacity signal, falling back to least-loaded otherwise; "
+            "=0 pins least-loaded placement.  Host-side policy only — "
+            "results are bitwise identical either way",
+    ),
     # assertion tiers: compile-out is the FEATURE (utils/dbc.py); the
     # gated-handler invariant battery (test_gated_invariant.py) owns
     # their correctness, so they are not registry gates

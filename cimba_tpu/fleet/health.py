@@ -15,9 +15,14 @@ callback the :class:`~cimba_tpu.fleet.manager.FleetManager` uses to
 respawn a replacement.
 
 Healthy scrapes feed the router's placement: queue depth, outstanding,
-padding waste, and the program-store hit/fallback counters land in
-each handle's ``scraped`` dict (and in :meth:`HealthPoller.reports`),
-which is also what ``tools/metrics_dump.py --fleet`` tabulates.
+padding waste, the program-store hit/fallback counters, and the
+capacity plane (live lane occupancy, the refill wave's free-lane pool)
+land in each handle's ``scraped`` dict (and in
+:meth:`HealthPoller.reports`), which is what
+``tools/metrics_dump.py --fleet`` tabulates, what capacity-aware
+placement ranks by, and — via the scrape's parsed ``families`` — what
+the router federates into one fleet ``/metrics``
+(docs/23_fleet_observability.md).
 """
 
 from __future__ import annotations
@@ -79,10 +84,28 @@ def scrape_slice(health_url: str, timeout: float) -> dict:
             ("store_hits", "cimba_program_store_hits_total"),
             ("store_fallback_shapes",
              "cimba_program_store_fallback_shapes_total"),
+            # the capacity plane (docs/23_fleet_observability.md):
+            # live occupancy + the refill wave's free-lane pool — what
+            # the router's capacity-aware placement ranks by
+            ("occupancy_now", "cimba_serve_lane_occupancy_now"),
+            ("occupancy_mean", "cimba_serve_lane_occupancy_mean"),
+            ("free_lanes", "cimba_serve_free_lanes"),
+            ("refill_enabled", "cimba_serve_refill_enabled"),
+            ("refill_admissions", "cimba_serve_refill_admissions_total"),
+            ("lanes_refilled", "cimba_serve_lanes_refilled_total"),
         ):
             v = total(metric)
             if v is not None:
                 out[field] = v
+        # the whole parsed scrape, one number per family (labels
+        # summed) — what the router federates into the fleet registry
+        # as {family}{slice=...} gauges + a slice="all" rollup.
+        # Histogram le-buckets are cumulative and don't sum.
+        out["families"] = {
+            fname: sum(series.values())
+            for fname, series in samples.items()
+            if not fname.endswith("_bucket")
+        }
     except (OSError, ValueError) as e:
         # connection refused/reset, timeout, or unparseable body —
         # all of them mean "treat this slice as gone"

@@ -83,7 +83,17 @@ class FleetManager:
     ``{1: {"CIMBA_FLEET_CHAOS": "seed=7,kill=20"}}``) — replacements
     spawn with the base env only, so a chaos-killed slice is replaced
     by a healthy one.  ``respawn=False`` disables replacement (a test
-    watching a hole stay open)."""
+    watching a hole stay open).
+
+    The fleet observability plane (docs/23_fleet_observability.md),
+    all None-default and zero-cost off: ``telemetry`` attaches the
+    router's span/metric/healthz plane; ``expose_port`` additionally
+    serves it (``/metrics`` + ``/healthz`` + ``/varz`` on loopback,
+    ``0`` = ephemeral — read ``manager.expose.url``); ``span_dir``
+    exports ``CIMBA_FLEET_TELEMETRY`` to every slice so each writes
+    ``<span_dir>/<slice>.spans.jsonl`` and grafts its spans under the
+    router's wire spans; ``capacity_placement`` forwards to
+    :class:`~cimba_tpu.fleet.router.FleetRouter`."""
 
     def __init__(
         self,
@@ -105,9 +115,18 @@ class FleetManager:
         spawn_timeout: float = 180.0,
         horizon_bucket: Optional[float] = 16.0,
         name: str = "cimba-fleet",
+        telemetry=None,
+        expose_port: Optional[int] = None,
+        span_dir: Optional[str] = None,
+        capacity_placement: Optional[bool] = None,
     ):
         if n_slices <= 0:
             raise ValueError(f"n_slices must be positive: {n_slices}")
+        if expose_port is not None and telemetry is None:
+            raise ValueError(
+                "expose_port needs a telemetry plane to serve — pass "
+                "telemetry= as well (docs/23_fleet_observability.md)"
+            )
         self.models_json = json.dumps(
             models if not isinstance(models, str) else json.loads(models)
         )
@@ -123,11 +142,19 @@ class FleetManager:
         self._closing = False
         self._n = 0
         self._lock = threading.Lock()
+        self.telemetry = telemetry
+        self.span_dir = span_dir
         self.router = FleetRouter(
             models=self._specs, window=window, place_seed=place_seed,
             max_requeues=max_requeues, request_timeout=request_timeout,
             horizon_bucket=horizon_bucket, name=name,
+            telemetry=telemetry, capacity_placement=capacity_placement,
         )
+        self.expose = None
+        if expose_port is not None:
+            from cimba_tpu.obs import expose as _expose
+
+            self.expose = _expose.start(telemetry, port=expose_port)
         procs = []
         try:
             for i in range(n_slices):
@@ -139,6 +166,8 @@ class FleetManager:
         except BaseException:
             for proc, _ in procs:
                 proc.kill()
+            if self.expose is not None:
+                self.expose.close()
             raise
         self.poller = HealthPoller(
             self.router, interval=self.poll_interval,
@@ -176,6 +205,12 @@ class FleetManager:
         env = dict(os.environ)
         if self.store is not None:
             env["CIMBA_PROGRAM_STORE"] = str(self.store)
+        if self.span_dir is not None:
+            # every slice (replacements too) writes
+            # <span_dir>/<name>.spans.jsonl and grafts its spans under
+            # the router's wire spans via the run headers' trace
+            # context (docs/23_fleet_observability.md)
+            env["CIMBA_FLEET_TELEMETRY"] = str(self.span_dir)
         env.update(extra_env or {})
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=None, text=True,
@@ -270,6 +305,8 @@ class FleetManager:
         self._closing = True
         self.poller.close()
         self.router.shutdown(wait=wait, timeout=timeout)
+        if self.expose is not None:
+            self.expose.close()
         for h in self.router.slices().values():
             proc = h.proc
             if proc is None:

@@ -25,6 +25,13 @@ every tie broken by host-side fmix64 over request ids, the PR 7
   is new), the request goes to the live slice with the lowest load
   (router-tracked outstanding + the queue depth last scraped from the
   slice's ``/metrics``), growing the class's slice set;
+* **capacity-aware placement** (docs/23_fleet_observability.md) — when
+  every candidate slice runs the refill plane (docs/22_refill.md), the
+  strongest capacity signal isn't queue depth but the live free-lane
+  pool: placement ranks candidates by free-lane headroom (scraped
+  ``cimba_serve_free_lanes`` minus work already pointed there) and
+  falls back to least-loaded whenever any candidate lacks the signal;
+  ``decision_log()`` records the capacity snapshot behind every pick;
 * **bounded in-flight windows** — at most ``window`` requests are in
   flight per slice (the slice's own admission queue backpressures
   behind that).
@@ -141,6 +148,7 @@ class _FleetEntry:
         "request", "seq", "label", "cls", "model", "excluded",
         "attempts", "assigned", "submit_t", "done", "result", "exc",
         "remote_digest", "n_waves",
+        "trace", "span_root", "span_pending", "span_wire",
     )
 
     def __init__(self, request, seq: int, cls, model: str):
@@ -158,6 +166,12 @@ class _FleetEntry:
         self.exc: Optional[Exception] = None
         self.remote_digest: Optional[str] = None
         self.n_waves = 0
+        # telemetry span state — all None without a plane (the
+        # zero-allocation submit contract, same as serve._Entry)
+        self.trace = None
+        self.span_root = None
+        self.span_pending = None
+        self.span_wire = None
 
 
 class FleetHandle:
@@ -210,7 +224,20 @@ class FleetRouter:
     twins of a registered spec route too.  ``window`` bounds per-slice
     in-flight requests; ``place_seed`` seeds the deterministic
     tie-break; ``max_requeues`` bounds how often one request may be
-    requeued across failing slices before failing loudly."""
+    requeued across failing slices before failing loudly.
+
+    ``telemetry`` (None-default, zero-cost off) attaches the fleet
+    plane (docs/23_fleet_observability.md): router-side spans
+    (request → pending → wire, requeue/failover events) whose trace
+    context rides the wire so slice trees graft under them,
+    ``cimba_fleet_*`` counter/gauge/histogram families, the per-slice
+    rollup federation fed by :meth:`update_scrape`, and a
+    slice-verdict health hook — serve ``/metrics``+``/healthz`` over
+    it with :func:`cimba_tpu.obs.expose.start` and the whole fleet is
+    one scrape target.  ``capacity_placement`` (None = the
+    ``CIMBA_FLEET_CAPACITY`` knob, on by default) selects free-lane
+    headroom ranking when every candidate slice scrapes the refill
+    capacity signal."""
 
     # cimba-check: must-hold(_lock) _slices, _pending, _outstanding, _counters, _decisions, _class_map, _seq, _closed, _stop
 
@@ -226,11 +253,20 @@ class FleetRouter:
         horizon_bucket: Optional[float] = 16.0,
         decision_cap: int = 65536,
         name: str = "cimba-fleet",
+        telemetry=None,
+        capacity_placement: Optional[bool] = None,
     ):
         if window <= 0:
             raise ValueError(f"window must be positive, got {window}")
         from cimba_tpu.serve import cache as _pcache
 
+        if capacity_placement is None:
+            from cimba_tpu import config as _config
+
+            capacity_placement = _config.env_raw(
+                "CIMBA_FLEET_CAPACITY"
+            ).strip().lower() not in ("0", "false", "off")
+        self.capacity_placement = bool(capacity_placement)
         self.name = name
         self.window = int(window)
         self.place_seed = int(place_seed)
@@ -261,11 +297,23 @@ class FleetRouter:
             "expect_digest_mismatches": 0, "stale_results": 0,
         }
         self._class_map: Dict[tuple, List[str]] = {}
+        # the fleet observability plane (docs/23) — None means zero
+        # cost: no spans, no collector, no extra work on any path
+        self._tel = telemetry
+        self._rec = telemetry.spans if telemetry is not None else None
+        # slice-labeled family names mirrored into the fleet registry
+        # by update_scrape (the rollup federation), and names that
+        # collided with a router-local family and are never mirrored
+        self._fleet_families: set = set()
+        self._fleet_skipped: set = set()
         self._threads: List[threading.Thread] = []
         self._placer = threading.Thread(
             target=self._place_loop, name=f"{name}-placer", daemon=True
         )
         self._placer.start()
+        if telemetry is not None:
+            telemetry.add_collector(self._collect)
+            telemetry.add_healthz(self.name, self.fleet_health)
 
     # -- topology ------------------------------------------------------------
 
@@ -311,7 +359,9 @@ class FleetRouter:
             h.queue.clear()
             n = 0
             for e in victims:
-                if self._requeue_locked(e, h, f"slice down: {reason}"):
+                if self._requeue_locked(
+                    e, h, f"slice down: {reason}", kind="failover"
+                ):
                     n += 1
             self._cv.notify_all()
             return n
@@ -331,18 +381,79 @@ class FleetRouter:
             for names in self._class_map.values():
                 if name in names:
                     names.remove(name)
+            if self._tel is not None and h is not None:
+                # drop the corpse's federated series (and refresh the
+                # rollups) so "rollup == sum of live slices" holds
+                # through kill/respawn churn
+                reg = self._tel.registry
+                for fname in self._fleet_families:
+                    reg.gauge(fname, labels=("slice",)).remove(
+                        slice=name
+                    )
+                self._mirror_locked(name, {})
+                for fname, kind in (
+                    ("cimba_fleet_slice_up", "gauge"),
+                    ("cimba_fleet_slice_outstanding", "gauge"),
+                    ("cimba_fleet_slice_placed_total", "counter"),
+                ):
+                    getattr(reg, kind)(
+                        fname, labels=("fleet", "slice")
+                    ).remove(fleet=self.name, slice=name)
             self._cv.notify_all()   # its sender threads wake and exit
         return h is not None
 
     def update_scrape(self, name: str, scraped: Dict[str, Any]) -> None:
         """The health poller's feed: the latest scraped view of one
-        slice (queue depth, verdict, store counters) — read by the
-        least-loaded placement."""
+        slice (queue depth, verdict, capacity signals, store counters)
+        — read by placement, and (with a telemetry plane) mirrored
+        into the fleet registry: the scrape's parsed single-value
+        families land as ``{family}{slice=<name>}`` gauges plus a
+        ``slice="all"`` rollup series summing the live slices, so one
+        fleet ``/metrics`` covers every slice
+        (docs/23_fleet_observability.md)."""
         with self._lock:
             h = self._slices.get(name)
-            if h is not None:
-                h.scraped = dict(scraped)
-                h.last_scrape_t = time.monotonic()
+            if h is None:
+                return
+            h.scraped = dict(scraped)
+            h.last_scrape_t = time.monotonic()
+            if self._tel is not None and scraped.get("families"):
+                self._mirror_locked(name, scraped["families"])
+
+    # cimba-check: assume-held
+    def _mirror_locked(self, name: str, fams: Dict[str, float]) -> None:
+        """Federate one slice's scraped families into the fleet
+        registry (gauges — a federation snapshot, kinds intentionally
+        flattened) and refresh the ``slice="all"`` rollups.  The name
+        ``"all"`` is reserved for the rollup series."""
+        reg = self._tel.registry
+        for fname, val in fams.items():
+            if fname in self._fleet_skipped:
+                continue
+            try:
+                fam = reg.gauge(fname, labels=("slice",))
+            except ValueError:
+                fam = None
+            if fam is None or fam.label_names != ("slice",):
+                # the name collides with a router-LOCAL family of a
+                # different kind or label set (both processes mint
+                # e.g. cimba_ticks_total / cimba_heartbeat_age_seconds):
+                # the local series wins and the slice copy is skipped,
+                # never corrupted
+                self._fleet_skipped.add(fname)
+                continue
+            fam.labels(slice=name).set(float(val))
+            self._fleet_families.add(fname)
+        for fname in self._fleet_families:
+            total = 0.0
+            for h2 in self._slices.values():
+                if h2.up:
+                    total += float(
+                        (h2.scraped.get("families") or {}).get(fname, 0.0)
+                    )
+            reg.gauge(fname, labels=("slice",)).labels(
+                slice="all"
+            ).set(total)
 
     # -- client surface ------------------------------------------------------
 
@@ -402,6 +513,20 @@ class FleetRouter:
                 )
             self._seq += 1
             entry = _FleetEntry(request, self._seq, cls, model)
+            rec = self._rec
+            if rec is not None:
+                # minted BEFORE the heappush (the serve.Service
+                # submit-before-publish invariant, one level up): once
+                # the placer can see the entry, its trace exists
+                entry.trace = rec.new_trace()
+                entry.span_root = rec.start(
+                    entry.trace, "request", seq=entry.seq,
+                    label=entry.label, model=entry.model,
+                    fleet=self.name,
+                )
+                entry.span_pending = rec.start(
+                    entry.trace, "pending", parent=entry.span_root
+                )
             self._outstanding += 1
             self._counters["submitted"] += 1
             heapq.heappush(
@@ -452,6 +577,13 @@ class FleetRouter:
                             outcome="cancelled",
                         )
             self._cv.notify_all()
+        if self._tel is not None:
+            # final counter flush, then detach: a scrape after shutdown
+            # sees the router's last totals, not a collector racing a
+            # torn-down fleet
+            self._collect()
+            self._tel.remove_collector(self._collect)
+            self._tel.remove_healthz(self.name)
 
     def __enter__(self):
         return self
@@ -463,10 +595,14 @@ class FleetRouter:
 
     def decision_log(self) -> List[tuple]:
         """Placement/requeue decisions in order (the most recent
-        ``decision_cap``): ``("place", seq, slice)`` /
-        ``("requeue", seq, slice)`` — the determinism pin's subject
-        (same request stream + same chaos seed -> identical log;
-        tests/test_fleet.py)."""
+        ``decision_cap``): ``("place", seq, slice, snap)`` /
+        ``("requeue", seq, slice, None)`` — the determinism pin's
+        subject (same request stream + same chaos seed + same scraped
+        state -> identical log; tests/test_fleet.py).  ``snap`` records
+        the capacity evidence behind the pick:
+        ``("capacity", free_lanes, headroom)`` when free-lane ranking
+        engaged, ``("load", load)`` for the least-loaded fallback
+        (docs/23_fleet_observability.md)."""
         with self._lock:
             return list(self._decisions)
 
@@ -486,7 +622,116 @@ class FleetRouter:
                 for h in self._slices.values()
             }
             out["classes_seen"] = len(self._class_map)
+            out["capacity_placement"] = self.capacity_placement
         return out
+
+    def _collect(self) -> None:
+        """Telemetry collector (``Telemetry.add_collector``): mirror
+        the router's counters and topology into ``cimba_fleet_*``
+        families at every sample/scrape, the ``_service_collector``
+        idiom one level up (docs/23_fleet_observability.md)."""
+        reg = self._tel.registry
+        with self._lock:
+            counters = dict(self._counters)
+            pending = len(self._pending)
+            outstanding = self._outstanding
+            slices = [
+                (h.name, h.up, h.outstanding, h.placed_total)
+                for h in self._slices.values()
+            ]
+            classes = len(self._class_map)
+        ev = reg.counter(
+            "cimba_fleet_requests_total",
+            "router request lifecycle, by event",
+            labels=("fleet", "event"),
+        )
+        for k in ("submitted", "placed", "requeues", "completed",
+                  "failed", "cancelled"):
+            ev.labels(fleet=self.name, event=k).set_total(counters[k])
+        fault = reg.counter(
+            "cimba_fleet_wire_faults_total",
+            "transport-level faults, by kind",
+            labels=("fleet", "kind"),
+        )
+        for k in ("wire_errors", "wire_digest_mismatches",
+                  "expect_digest_mismatches", "stale_results"):
+            fault.labels(fleet=self.name, kind=k).set_total(counters[k])
+        fl = {"fleet": self.name}
+        reg.gauge(
+            "cimba_fleet_pending",
+            "requests awaiting placement", labels=("fleet",),
+        ).labels(**fl).set(pending)
+        reg.gauge(
+            "cimba_fleet_outstanding",
+            "requests admitted but not completed", labels=("fleet",),
+        ).labels(**fl).set(outstanding)
+        reg.gauge(
+            "cimba_fleet_classes_seen",
+            "distinct compatibility classes routed", labels=("fleet",),
+        ).labels(**fl).set(classes)
+        reg.gauge(
+            "cimba_fleet_slices_up",
+            "live slices", labels=("fleet",),
+        ).labels(**fl).set(sum(1 for _, up, _, _ in slices if up))
+        reg.gauge(
+            "cimba_fleet_capacity_placement",
+            "1 when free-lane headroom ranking is enabled",
+            labels=("fleet",),
+        ).labels(**fl).set(1.0 if self.capacity_placement else 0.0)
+        up_f = reg.gauge(
+            "cimba_fleet_slice_up",
+            "slice liveness as the router sees it (1 up / 0 down)",
+            labels=("fleet", "slice"),
+        )
+        out_f = reg.gauge(
+            "cimba_fleet_slice_outstanding",
+            "router-tracked in-flight requests per slice",
+            labels=("fleet", "slice"),
+        )
+        placed_f = reg.counter(
+            "cimba_fleet_slice_placed_total",
+            "placements per slice", labels=("fleet", "slice"),
+        )
+        for name, up, outst, placed in slices:
+            up_f.labels(fleet=self.name, slice=name).set(
+                1.0 if up else 0.0
+            )
+            out_f.labels(fleet=self.name, slice=name).set(outst)
+            placed_f.labels(fleet=self.name, slice=name).set_total(
+                placed
+            )
+
+    def fleet_health(self) -> dict:
+        """The fleet healthz hook (``Telemetry.add_healthz``): one
+        verdict over the whole fleet.  Any slice down or scraped
+        unhealthy/degraded -> ``degraded`` (requests still flow around
+        it); a dead placer thread or zero live slices -> ``unhealthy``
+        (nothing can make progress) — the serve dispatcher-dead
+        semantics lifted one level (docs/23_fleet_observability.md)."""
+        with self._lock:
+            slices = {}
+            n_up = 0
+            degraded = False
+            for h in self._slices.values():
+                if h.up:
+                    n_up += 1
+                    v = str(h.scraped.get("verdict", "unknown"))
+                    if v in ("degraded", "unhealthy"):
+                        degraded = True
+                else:
+                    v = f"down:{h.down_reason}"
+                    degraded = True
+                slices[h.name] = v
+            status = "degraded" if degraded else "ok"
+            if n_up == 0 or not self._placer.is_alive():
+                status = "unhealthy"
+            return {
+                "status": status,
+                "slices": slices,
+                "up": n_up,
+                "pending": len(self._pending),
+                "outstanding": self._outstanding,
+            }
 
     def slice_stats(self, name: str,
                     timeout: float = 10.0) -> dict:
@@ -530,6 +775,20 @@ class FleetRouter:
         entry.exc = exc
         self._counters[outcome] += 1
         self._outstanding -= 1
+        if self._rec is not None and entry.trace is not None:
+            # end_trace closes whatever is still open (pending on a
+            # cancel, wire on a late failure) children-first, so one
+            # fleet request is exactly ONE complete span tree whatever
+            # its outcome (docs/23_fleet_observability.md)
+            self._rec.end_trace(entry.trace, outcome=outcome)
+        if self._tel is not None:
+            self._tel.registry.histogram(
+                "cimba_fleet_request_latency_seconds",
+                "router submit -> completion, end to end",
+                labels=("fleet", "outcome"),
+            ).labels(fleet=self.name, outcome=outcome).observe(
+                time.monotonic() - entry.submit_t
+            )
         entry.done.set()
         self._cv.notify_all()
 
@@ -554,7 +813,7 @@ class FleetRouter:
 
     # cimba-check: assume-held
     def _requeue_locked(self, entry: _FleetEntry, h: SliceHandle,
-                        reason: str) -> bool:
+                        reason: str, *, kind: str = "requeue") -> bool:
         if entry.done.is_set():
             return False
         if not self._release_locked(entry, h.name):
@@ -562,7 +821,27 @@ class FleetRouter:
         entry.excluded.add(h.name)
         entry.attempts += 1
         self._counters["requeues"] += 1
-        self._decisions.append(("requeue", entry.seq, h.name))
+        self._decisions.append(("requeue", entry.seq, h.name, None))
+        rec = self._rec
+        if rec is not None and entry.trace is not None:
+            # the wire attempt (if one was in flight) ends "requeued";
+            # the instant event distinguishes a transport bounce from a
+            # health-poller failover in the merged tree
+            if entry.span_wire is not None:
+                rec.end(
+                    entry.span_wire, outcome="requeued", reason=reason
+                )
+                entry.span_wire = None
+            rec.event(
+                entry.trace, kind, parent=entry.span_root,
+                slice=h.name, reason=reason, attempt=entry.attempts,
+            )
+        if self._tel is not None:
+            self._tel.registry.counter(
+                "cimba_fleet_requeues_total",
+                "requests bounced off a slice, by trigger",
+                labels=("fleet", "kind"),
+            ).labels(fleet=self.name, kind=kind).inc()
         if entry.attempts > self.max_requeues:
             self._finish_locked(
                 entry,
@@ -572,6 +851,14 @@ class FleetRouter:
                 outcome="failed",
             )
             return True
+        if rec is not None and entry.trace is not None:
+            # back to pending: a fresh pending span so queue time spent
+            # waiting for the NEXT placement is attributed, not folded
+            # into the failed wire attempt
+            entry.span_pending = rec.start(
+                entry.trace, "pending", parent=entry.span_root,
+                requeue=entry.attempts,
+            )
         heapq.heappush(
             self._pending,
             ((-entry.request.priority, entry.seq), entry),
@@ -589,7 +876,35 @@ class FleetRouter:
         return h.outstanding + float(h.scraped.get("queue_depth", 0))
 
     # cimba-check: assume-held
-    def _choose_locked(self, entry: _FleetEntry) -> Optional[SliceHandle]:
+    def _capacity_locked(
+        self, cands: List[SliceHandle]
+    ) -> Optional[Dict[str, Tuple[float, float]]]:
+        """The free-lane capacity view of ``cands`` — ``name ->
+        (free_lanes, headroom)`` where headroom is the scraped free-lane
+        pool minus the work already pointed at the slice (router
+        outstanding + scraped queue depth).  None when ANY candidate
+        lacks the refill signal (refill off, or not scraped yet): the
+        ranking only engages when the whole comparison is apples to
+        apples (docs/23_fleet_observability.md)."""
+        if not self.capacity_placement:
+            return None
+        caps: Dict[str, Tuple[float, float]] = {}
+        for h in cands:
+            sc = h.scraped
+            free = sc.get("free_lanes")
+            if not sc.get("refill_enabled") or free is None:
+                return None
+            free = float(free)
+            caps[h.name] = (
+                free,
+                free - h.outstanding - float(sc.get("queue_depth", 0)),
+            )
+        return caps or None
+
+    # cimba-check: assume-held
+    def _choose_locked(
+        self, entry: _FleetEntry
+    ) -> Tuple[Optional[SliceHandle], Optional[tuple]]:
         cands = [
             h for h in self._slices.values()
             if h.up and h.name not in entry.excluded
@@ -616,14 +931,22 @@ class FleetRouter:
                 if h.up and h.outstanding < self.window
             ]
         if not cands:
-            return None
+            return None, None
         bound = self._class_map.get(entry.cls)
         if bound:
             stuck = [h for h in cands if h.name in bound]
             if stuck:
                 cands = stuck
-        lo = min(self._load_locked(h) for h in cands)
-        best = [h for h in cands if self._load_locked(h) == lo]
+        caps = self._capacity_locked(cands)
+        if caps is not None:
+            # capacity-aware: rank by free-lane headroom — the live
+            # signal of what a refill slice can ABSORB, stronger than
+            # queue depth which only says what's already parked
+            hi = max(caps[h.name][1] for h in cands)
+            best = [h for h in cands if caps[h.name][1] == hi]
+        else:
+            lo = min(self._load_locked(h) for h in cands)
+            best = [h for h in cands if self._load_locked(h) == lo]
         # deterministic tie-break: fmix64 over the request id (the
         # PR 7 round_seed idiom) — NOT arrival order of a dict
         idx = _fmix64(
@@ -631,10 +954,14 @@ class FleetRouter:
             & ((1 << 64) - 1)
         ) % len(best)
         pick = best[idx]
+        snap = (
+            ("capacity",) + caps[pick.name] if caps is not None
+            else ("load", lo)
+        )
         names = self._class_map.setdefault(entry.cls, [])
         if pick.name not in names:
             names.append(pick.name)
-        return pick
+        return pick, snap
 
     def _place_loop(self) -> None:
         while True:
@@ -650,7 +977,7 @@ class FleetRouter:
                     key, entry = heapq.heappop(self._pending)
                     if entry.done.is_set():
                         continue            # cancelled tombstone
-                    pick = self._choose_locked(entry)
+                    pick, snap = self._choose_locked(entry)
                     if pick is None:
                         kept.append((key, entry))
                         continue
@@ -660,8 +987,15 @@ class FleetRouter:
                     pick.queue.append(entry)
                     self._counters["placed"] += 1
                     self._decisions.append(
-                        ("place", entry.seq, pick.name)
+                        ("place", entry.seq, pick.name, snap)
                     )
+                    if (self._rec is not None
+                            and entry.span_pending is not None):
+                        self._rec.end(
+                            entry.span_pending, outcome="placed",
+                            slice=pick.name,
+                        )
+                        entry.span_pending = None
                     placed = True
                 for item in kept:
                     heapq.heappush(self._pending, item)
@@ -743,6 +1077,22 @@ class FleetRouter:
             "deadline": deadline,
             "label": req.label,
         }
+        rec = self._rec
+        if rec is not None and entry.trace is not None:
+            with self._lock:
+                if entry.done.is_set() or entry.assigned != h.name:
+                    # requeued (mark_down swept it) while we built the
+                    # frame — starting a span now would orphan it
+                    return
+                entry.span_wire = rec.start(
+                    entry.trace, "wire", parent=entry.span_root,
+                    slice=h.name, attempt=attempt,
+                )
+                span_wire = entry.span_wire
+            # the cross-process graft: the slice's service adopts this
+            # trace and parents its tree under our wire span
+            header["trace"] = wire.trace_context(entry.trace, span_wire)
+        t0 = time.monotonic()
         try:
             resp, blobs_in = wire.call(
                 h.host, h.port, header, tuple(blobs_out),
@@ -766,6 +1116,14 @@ class FleetRouter:
                 # no-op if mark_down already requeued this entry
                 self._requeue_locked(entry, h, reason)
             return
+        if self._tel is not None:
+            self._tel.registry.histogram(
+                "cimba_fleet_wire_roundtrip_seconds",
+                "one wire call: connect + run + response",
+                labels=("fleet", "slice"),
+            ).labels(fleet=self.name, slice=h.name).observe(
+                time.monotonic() - t0
+            )
         if resp.get("ok"):
             self._deliver(h, entry, resp, blobs_in)
             return
@@ -776,6 +1134,12 @@ class FleetRouter:
             exc = self._remote_exc(type_name, message, resp, entry)
             with self._lock:
                 if self._release_locked(entry, h.name):
+                    if rec is not None and entry.span_wire is not None:
+                        rec.end(
+                            entry.span_wire, outcome="error",
+                            error=type_name,
+                        )
+                        entry.span_wire = None
                     self._finish_locked(entry, exc=exc, outcome="failed")
         else:
             # an unclassified slice-side crash: treat like a slice
@@ -844,6 +1208,12 @@ class FleetRouter:
                 return
             if expect is not None and expect != local_digest:
                 self._counters["expect_digest_mismatches"] += 1
+            if self._rec is not None and entry.span_wire is not None:
+                self._rec.end(
+                    entry.span_wire, outcome="ok",
+                    n_waves=int(resp.get("n_waves", 0)),
+                )
+                entry.span_wire = None
             entry.remote_digest = local_digest
             self._finish_locked(
                 entry, result=result, outcome="completed"

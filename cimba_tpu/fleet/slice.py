@@ -149,7 +149,24 @@ class _SliceServer:
                         file=sys.stderr, flush=True,
                     )
 
-        self.telemetry = _tm.Telemetry(interval=telemetry_interval)
+        # span recording is opt-in via CIMBA_FLEET_TELEMETRY (a
+        # directory): each slice streams its span JSONL to
+        # <dir>/<name>.spans.jsonl, ids namespaced by slice name so the
+        # files merge with the router's into one tree
+        # (docs/23_fleet_observability.md); unset = no recorder, the
+        # zero-cost default
+        span_dir = _config.env_raw("CIMBA_FLEET_TELEMETRY").strip()
+        span_path = None
+        if span_dir:
+            os.makedirs(span_dir, exist_ok=True)
+            span_path = os.path.join(
+                span_dir, f"{name}.spans.jsonl"
+            )
+        self.span_path = span_path
+        self.telemetry = _tm.Telemetry(
+            interval=telemetry_interval, span_path=span_path,
+            span_node=name if span_path else None,
+        )
         self.exposition = _expose.start(
             self.telemetry, port=health_port,
             delay_s=self.chaos.scrape_delay_ms / 1000.0,
@@ -252,6 +269,15 @@ class _SliceServer:
                 priority=int(header.get("priority", 0)),
                 deadline=header.get("deadline"),
                 label=header.get("label"),
+                # the router's trace id + wire-span parent: the
+                # service adopts them so this slice's span tree
+                # grafts under the router's (docs/23); absent or
+                # malformed = locally-rooted, same as today
+                trace_context=(
+                    header["trace"]
+                    if isinstance(header.get("trace"), dict)
+                    else None
+                ),
             )
             handle = self.service.submit(request)
             result = handle.result()
@@ -299,6 +325,7 @@ class _SliceServer:
             "url": self.exposition.url,
             "warm": self.warm_report,
             "chaos": self.chaos.active,
+            "spans": self.span_path,
         }
 
     def close(self) -> None:

@@ -22,6 +22,14 @@ parameter tuple round-trips bit-exactly (``json`` floats serialize via
 ``repr`` and re-parse to the identical double), which is what keeps a
 routed request's trajectories bitwise the direct call's.
 
+**Trace context** (docs/23_fleet_observability.md): a ``run`` header
+may carry a ``"trace"`` object — ``{"id": <router trace id>,
+"parent": <router span id>}``, built by :func:`trace_context` — that
+the slice's service adopts, grafting its local span tree under the
+router's.  Plain JSON keys, strictly additive: a slice that predates
+the field ignores it, and a header without it means a locally-rooted
+trace (or none at all).
+
 See docs/20_fleet.md for the message catalogue (``run`` / ``stats`` /
 ``ping``) and the failover semantics built on top.
 """
@@ -46,6 +54,17 @@ MAX_BLOBS = 4096
 
 class WireError(ConnectionError):
     """Malformed frame or a peer that hung up mid-frame."""
+
+
+def trace_context(trace_id: str, parent_span: Optional[str]) -> dict:
+    """The ``"trace"`` header object a ``run`` frame carries: the
+    router's trace id plus the span the slice's tree should hang under
+    (its wire span).  One constructor so the two sides of the wire
+    agree on the key names."""
+    out: dict = {"id": str(trace_id)}
+    if parent_span is not None:
+        out["parent"] = str(parent_span)
+    return out
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -233,6 +233,14 @@ class Family:
                 self._series[key] = s
         return _Handle(self, s)
 
+    def remove(self, **kv) -> None:
+        """Drop one labeled series (no-op when absent) — how the fleet
+        federation prunes a dead slice's series so rollups stay
+        sum-of-live (docs/23_fleet_observability.md)."""
+        key = _label_key(self.label_names, kv)
+        with self._lock:
+            self._series.pop(key, None)
+
     # label-less convenience: family-level update ops act on the () series
     def inc(self, n: float = 1.0) -> None:
         self.labels().inc(n)
@@ -383,20 +391,35 @@ class SpanRecorder:
     ``path + ".1"``, replacing the previous generation) — but ONLY at
     a trace boundary with NO other trace open, so a span tree is never
     torn across files (a long soak keeps at most two generations on
-    disk; ``counters["rotations"]`` says how often it happened)."""
+    disk; ``counters["rotations"]`` says how often it happened).
 
-    # cimba-check: must-hold(_lock) _open, _by_trace, _n, _bytes, _fh, counters, completed
+    **Cross-process grafting** (docs/23_fleet_observability.md): a
+    recorder can :meth:`adopt_trace` a trace id minted by ANOTHER
+    process's recorder (the fleet router), recording its local span
+    tree under the remote trace with the local root parented on a
+    remote span id.  ``node`` namespaces every locally-minted id with a
+    ``.node`` suffix, so the two processes' per-process counters cannot
+    collide when their JSONL files are merged into one tree."""
+
+    # cimba-check: must-hold(_lock) _open, _by_trace, _n, _bytes, _fh, counters, completed, _remote_parent
 
     def __init__(self, path=None, cap: int = 4096,
-                 max_bytes: Optional[int] = None):
+                 max_bytes: Optional[int] = None,
+                 node: Optional[str] = None):
         self._lock = threading.Lock()
         self._m0 = time.monotonic()
         self._n = 0
+        self._node = None if node is None else str(node)
+        self._suffix = "" if node is None else f".{node}"
         self._open: Dict[str, dict] = {}
         self._by_trace: "OrderedDict[str, List[str]]" = OrderedDict()
+        # trace id -> the REMOTE parent span id its local root hangs
+        # under (adopt_trace); end_trace needs it to recognize the
+        # local root, whose parent is NOT None for an adopted trace
+        self._remote_parent: Dict[str, str] = {}
         self.completed: deque = deque(maxlen=int(cap))
         self.counters = {
-            "traces_started": 0, "traces_ended": 0,
+            "traces_started": 0, "traces_ended": 0, "traces_adopted": 0,
             "spans_started": 0, "spans_ended": 0, "events": 0,
             "rotations": 0,
         }
@@ -416,17 +439,35 @@ class SpanRecorder:
     def new_trace(self) -> str:
         with self._lock:
             self._n += 1
-            tid = f"t{self._n:08x}"
+            tid = f"t{self._n:08x}{self._suffix}"
             self._by_trace[tid] = []
             self.counters["traces_started"] += 1
             return tid
+
+    def adopt_trace(self, trace: str,
+                    parent: Optional[str] = None) -> str:
+        """Adopt a trace id minted by a REMOTE recorder (the wire's
+        ``trace`` header): spans recorded locally under ``trace`` write
+        lines carrying the remote id, so the two processes' JSONL files
+        merge into one tree.  ``parent`` is the remote span id the
+        local root will hang under — :meth:`end_trace` treats the span
+        parented on it as the root (its parent is not ``None``, which
+        is how a purely local root is recognized).  Idempotent per
+        trace id; returns ``trace``."""
+        with self._lock:
+            if trace not in self._by_trace:
+                self._by_trace[trace] = []
+                self.counters["traces_adopted"] += 1
+            if parent is not None:
+                self._remote_parent[trace] = str(parent)
+            return trace
 
     def start(self, trace: str, name: str,
               parent: Optional[str] = None, **attrs) -> str:
         now = time.monotonic()
         with self._lock:
             self._n += 1
-            sid = f"s{self._n:08x}"
+            sid = f"s{self._n:08x}{self._suffix}"
             rec = {
                 "trace": trace, "span": sid, "parent": parent,
                 "name": name, "m0": now,
@@ -503,13 +544,18 @@ class SpanRecorder:
         now = time.monotonic()
         with self._lock:
             sids = self._by_trace.pop(trace, None)
+            remote = self._remote_parent.pop(trace, None)
             if sids is None:
                 return
             for sid in reversed(sids):
                 rec = self._open.pop(sid, None)
                 if rec is None:
                     continue
-                is_root = rec["parent"] is None
+                # an adopted trace's local root is parented on the
+                # REMOTE span id, not None (adopt_trace recorded it)
+                is_root = (
+                    rec["parent"] is None or rec["parent"] == remote
+                )
                 self._finish_locked(
                     rec, now, outcome if is_root else "aborted",
                     attrs if is_root else {},
@@ -630,6 +676,7 @@ class Telemetry:
         spans: bool = False,
         span_path=None,
         span_max_bytes: Optional[int] = None,
+        span_node: Optional[str] = None,
         registry: Optional[Registry] = None,
         stall_s: float = 30.0,
         autostart: bool = True,
@@ -638,7 +685,8 @@ class Telemetry:
             history=history
         )
         self.spans: Optional[SpanRecorder] = (
-            SpanRecorder(path=span_path, max_bytes=span_max_bytes)
+            SpanRecorder(path=span_path, max_bytes=span_max_bytes,
+                         node=span_node)
             if (spans or span_path is not None) else None
         )
         self.interval = float(interval)
@@ -647,6 +695,9 @@ class Telemetry:
         self._lock = threading.RLock()
         self._hb: Dict[str, float] = {}
         self._collectors: List[Callable[[], None]] = []
+        self._healthz_hooks: "OrderedDict[str, Callable[[], dict]]" = (
+            OrderedDict()
+        )
         self._services: List[tuple] = []       # (name, service)
         self._service_collectors: Dict[int, Callable] = {}
         self._thread: Optional[threading.Thread] = None
@@ -695,6 +746,30 @@ class Telemetry:
             self._collectors.append(fn)
         if self._autostart:
             self.start()
+
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        """Drop a collector registered with :meth:`add_collector`
+        (idempotent) — what a shutting-down fleet router calls so a
+        long-lived plane stops scraping it."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def add_healthz(self, name: str, fn: Callable[[], dict]) -> None:
+        """Register an extra health contributor: ``fn()`` returns a
+        check dict whose ``"status"`` ("ok" | "degraded" | "unhealthy")
+        folds into the overall :meth:`healthz` verdict and whose body
+        lands under ``checks[name]``.  How a non-``Service`` component
+        (the fleet router's slice-verdict rollup,
+        docs/23_fleet_observability.md) joins the verdict."""
+        with self._lock:
+            self._healthz_hooks[str(name)] = fn
+
+    def remove_healthz(self, name: str) -> None:
+        with self._lock:
+            self._healthz_hooks.pop(str(name), None)
 
     def attach_service(self, service, name: Optional[str] = None) -> str:
         """Register ``service`` with the plane: a stats collector, the
@@ -888,16 +963,34 @@ class Telemetry:
             if mism:
                 worse("degraded")
             checks[name] = c
+        # extra contributors (add_healthz): each returns a check dict
+        # with a "status" that folds into the verdict — the fleet
+        # router's slice rollup reports through here
+        with self._lock:
+            hooks = list(self._healthz_hooks.items())
+        extra: Dict[str, Any] = {}
+        for hname, fn in hooks:
+            try:
+                c = dict(fn())
+            except Exception as e:
+                c = {"status": "unhealthy", "error": repr(e)}
+            s = c.get("status", "ok")
+            worse(s if s in ("ok", "degraded", "unhealthy")
+                  else "unhealthy")
+            extra[hname] = c
         with self._lock:
             thread = self._thread
             errors = self._errors
-        return {
+        out = {
             "status": status,
             "ok": status != "unhealthy",
             "services": checks,
             "sampler_alive": thread is not None and thread.is_alive(),
             "collector_errors": errors,
         }
+        if extra:
+            out["checks"] = extra
+        return out
 
     def varz(self) -> dict:
         """The full JSON snapshot behind ``/varz``: every registry
@@ -1037,6 +1130,14 @@ def _service_collector(registry: Registry, name: str, service):
                 "continuous wave refill active (docs/22_refill.md)",
                 labels=("service",),
             ).labels(**lab).set(1.0 if ref.get("enabled") else 0.0)
+            # the refill wave's free-lane pool RIGHT NOW — the fleet
+            # router's capacity-placement signal (docs/23): admission
+            # headroom, where queue depth is only backlog
+            registry.gauge(
+                P + "serve_free_lanes",
+                "free lanes in the in-flight refill wave",
+                labels=("service",),
+            ).labels(**lab).set(ref.get("free_lanes", 0))
             for k in ("refill_boundaries", "refill_admissions",
                       "refill_retirements", "lanes_refilled",
                       "lanes_reclaimed", "mid_wave_deliveries"):

@@ -178,6 +178,13 @@ class Request:
     deadline: Optional[float] = None
     label: Optional[str] = None
     expect_digest: Optional[str] = None
+    # cross-process trace grafting (docs/23_fleet_observability.md):
+    # ``{"id": <remote trace id>, "parent": <remote span id>}`` — the
+    # fleet slice fills this from the wire header so the request's span
+    # tree grows under the router's, instead of starting a new trace.
+    # None (the default) means a locally-rooted trace; ignored when the
+    # service has no telemetry plane.  Never part of the class key.
+    trace_context: Optional[dict] = None
 
     def __post_init__(self):
         if self.summary_path is None:
@@ -482,6 +489,11 @@ class Service:
         # ``stats()["lane_occupancy"]`` live over a wave's life instead
         # of frozen at pack time (docs/22_refill.md).
         self._occ_samples = deque(maxlen=256)
+        # free lanes in the in-flight refill wave RIGHT NOW — the
+        # admission-headroom signal capacity-aware fleet placement
+        # scrapes (docs/23_fleet_observability.md); 0 whenever no
+        # refill wave is in flight (plain waves have no free pool)
+        self._free_lanes = 0
         # plain-path liveness-readback programs, per compatibility
         # class (dispatcher-thread only — see _run_batch)
         self._live_cache: dict = {}
@@ -603,11 +615,26 @@ class Service:
             # put() returns, the dispatcher may pack, run, and even
             # finish the request, and a span started after that would
             # resurrect the already-ended trace as a permanent leak.
-            entry.trace = rec.new_trace()
+            # cross-process grafting (docs/23_fleet_observability.md):
+            # a request arriving over the fleet wire carries the
+            # router's trace id + parent span — adopt them so this
+            # process's tree hangs under the router's, instead of
+            # minting a disconnected local trace
+            ctx = request.trace_context
+            remote_parent = None
+            if ctx is not None and ctx.get("id"):
+                remote_parent = (
+                    str(ctx["parent"]) if ctx.get("parent") else None
+                )
+                entry.trace = rec.adopt_trace(
+                    str(ctx["id"]), remote_parent
+                )
+            else:
+                entry.trace = rec.new_trace()
             entry.span_root = rec.start(
-                entry.trace, "request", seq=entry.seq,
-                label=entry.label, service=self._tel_name,
-                lanes=R,
+                entry.trace, "request", parent=remote_parent,
+                seq=entry.seq, label=entry.label,
+                service=self._tel_name, lanes=R,
             )
             entry.span_queue = rec.start(
                 entry.trace, "queue", parent=entry.span_root
@@ -732,6 +759,7 @@ class Service:
             out["refill"] = {"enabled": self.refill}
             for k in _REFILL_COUNTERS:
                 out["refill"][k] = self._counters[k]
+            out["refill"]["free_lanes"] = self._free_lanes
             occ_samples = list(self._occ_samples)
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
@@ -1417,6 +1445,8 @@ class Service:
             # whatever retired during the last (unpolled) chunks
             self._refill_boundary(wave, -1, sims, final=True)
         except Exception as e:
+            with self._lock:
+                self._free_lanes = 0   # no in-flight wave, no headroom
             members, seen = [], set()
             if wave is not None:
                 for s in wave.slots:
@@ -1576,6 +1606,8 @@ class Service:
         wave.slots = slots
         wave.free = list(range(total, total + pad))
         wave.L = total + pad
+        with self._lock:
+            self._free_lanes = len(wave.free)
         rec = self._tel.spans if self._tel is not None else None
         if rec is not None:
             for e in members:
@@ -1834,6 +1866,11 @@ class Service:
                     e.request.summary_path, e.request.params,
                     e.request.n_replications, s.n, e.with_metrics,
                 )
+
+        with self._lock:
+            # the scrapeable free-lane headroom tracks the pool across
+            # retire/reclaim/admit; a retiring wave has no pool
+            self._free_lanes = 0 if final else len(wave.free)
 
         if final or (not kills and not admitted):
             # (a final pass never splices — the wave is being retired,

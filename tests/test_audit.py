@@ -74,6 +74,8 @@ def _stream(spec, cache, seed, audit_=None, n=N, **kw):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; the audit gate's
+# off==baseline pin also runs in the ci.sh static-analysis gate sweep
 def test_audit_off_chunk_jaxpr_identical(monkeypatch):
     """SENTINEL (one profile): ``audit=False`` (and the default) trace
     the HISTORICAL chunk jaxpr character-for-character — even with the
@@ -114,6 +116,8 @@ def test_audit_off_chunk_jaxpr_identical(monkeypatch):
         assert on != base
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh
+# cells (the audit smoke re-proves bitwise-unperturbed on every ci run)
 def test_audited_results_bitwise_unperturbed(spec, cache):
     """Audit on never changes what the run computes: the audited run's
     result digest equals the digest of the unaudited run at the same
@@ -207,6 +211,8 @@ def test_incomparable_cards_exit_2(tmp_path):
     assert "incomparable" in proc.stdout
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh
+# cells (every ci tests tier includes the 8dev mesh configuration)
 def test_mesh_digest_matches_single_device(spec, cache):
     """A 1-device mesh digests through shard_map + psum with global
     lane offsets — the trail must equal the unsheltered one (integer

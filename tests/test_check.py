@@ -171,6 +171,8 @@ def test_env_raw_registry():
         config.env_raw("CIMBA_NOT_A_KNOB")
 
 
+@pytest.mark.slow  # heavyweight: the same full both-profile gate sweep runs in the
+# tools/ci.sh "static analysis" cell (tools/check.py) on every ci run
 def test_gate_sweep_off_is_baseline_both_profiles():
     """The registry sweep: off == baseline jaxpr identity for EVERY
     registered gate under both dtype profiles (plus the ambient-env,

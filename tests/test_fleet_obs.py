@@ -1,0 +1,457 @@
+"""The fleet trace plane (docs/23_fleet_observability.md).
+
+Contracts pinned here:
+
+* **one tree across processes**: a request routed through the fleet
+  yields exactly ONE complete span tree — the router's
+  ``request -> pending -> wire`` spans plus the slice subprocess's
+  grafted ``request -> queue -> ...`` tree under the wire span —
+  merged from the per-process JSONL files by trace id, with chaos
+  requeues appearing as a ``requeued`` wire span + instant event + a
+  fresh pending span, ``open_count() == 0`` after the traffic, and
+  the merged doc passing ``obs.export.validate_chrome_trace``;
+* **bitwise with telemetry ON**: every routed digest equals the
+  direct in-process anchor's (observability must never perturb
+  results);
+* **fleet rollup exposition**: the manager's ``/metrics`` federates
+  every slice's scraped families as ``{family}{slice=...}`` gauges
+  whose reserved ``slice="all"`` series equals the sum over live
+  slices — parsed by the one in-repo ``parse_prometheus_text`` — and
+  ``/healthz`` folds the router's slice-verdict rollup into the
+  fleet verdict (any slice degraded/down -> degraded, no live slice
+  or dead placer -> unhealthy);
+* **capacity-aware placement determinism**: with every candidate
+  scraping the refill capacity signal, placement ranks free-lane
+  headroom, records a ``("capacity", free, headroom)`` snapshot in
+  every decision, and two fresh routers fed the identical request
+  stream + scraped state produce IDENTICAL decision logs;
+* **zero cost off**: ``telemetry=None`` mints no trace state, and
+  the knobs are registered with ``trace_gate=False``.
+
+One module-scoped fleet (2 slices over one warm store, drop-chaos on
+slice0, telemetry + exposition + span dir attached) serves the
+battery.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cimba_tpu import serve
+from cimba_tpu.fleet.manager import FleetManager
+from cimba_tpu.fleet.router import FleetRouter, SliceHandle
+from cimba_tpu.models import mm1
+from cimba_tpu.obs import audit
+from cimba_tpu.obs import export as oe
+from cimba_tpu.obs import telemetry as tm
+from cimba_tpu.obs.expose import parse_prometheus_text
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.serve import store as ps
+
+MODELS = {
+    "mm1": {"fn": "cimba_tpu.models.mm1:build",
+            "kwargs": {"record": False}},
+}
+OBJ, R, WAVE, CHUNK = 30, 16, 16, 128
+POLL, SCRAPE_T = 0.25, 1.0
+
+
+def _req(spec, seed, label=None):
+    return serve.Request(
+        spec, mm1.params(OBJ), R, seed=seed, wave_size=WAVE,
+        chunk_steps=CHUNK, label=label,
+    )
+
+
+@pytest.fixture(scope="module")
+def warm_store(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fleet_obs_store"))
+    spec, _ = mm1.build(record=False)
+    st = ps.ProgramStore(root, enable_xla_cache=False)
+    rep = st.save_programs(
+        spec, mm1.params(OBJ), R, wave_sizes=(WAVE,),
+        chunk_steps=CHUNK, horizon_modes=("none",),
+    )
+    assert not rep["downgrades"], rep
+    return root
+
+
+@pytest.fixture(scope="module")
+def span_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("fleet_spans")
+
+
+@pytest.fixture(scope="module")
+def tel(span_dir):
+    t = tm.Telemetry(
+        interval=0.1,
+        span_path=str(span_dir / "router.spans.jsonl"),
+        span_node="router",
+    )
+    yield t
+    t.close()
+
+
+@pytest.fixture(scope="module")
+def fleet(warm_store, tel, span_dir):
+    """2 slices (drop chaos on slice0) with the full observability
+    plane: router telemetry + /metrics exposition + per-slice span
+    JSONL via CIMBA_FLEET_TELEMETRY."""
+    fm = FleetManager(
+        MODELS, n_slices=2, max_wave=WAVE, store=warm_store,
+        warm_chunk_steps=CHUNK, window=2, poll_interval=POLL,
+        scrape_timeout=SCRAPE_T,
+        telemetry=tel, expose_port=0, span_dir=str(span_dir),
+        slice_env={0: {"CIMBA_FLEET_CHAOS": "seed=5,drop=2"}},
+    )
+    try:
+        yield fm
+    finally:
+        fm.shutdown(wait=False)
+
+
+@pytest.fixture(scope="module")
+def direct_cache(warm_store):
+    return pc.ProgramCache(
+        store=ps.ProgramStore(warm_store, enable_xla_cache=False)
+    )
+
+
+def _direct_digest(seed, direct_cache):
+    spec, _ = mm1.build(record=False)
+    return audit.stream_result_digest(ex.run_experiment_stream(
+        spec, mm1.params(OBJ), R, wave_size=WAVE, chunk_steps=CHUNK,
+        seed=seed, program_cache=direct_cache,
+    ))
+
+
+def _span_lines(span_dir):
+    recs = []
+    for p in sorted(span_dir.glob("*.spans.jsonl")):
+        for line in p.read_text().splitlines():
+            recs.append(json.loads(line))
+    return recs
+
+
+def _chrome_doc(recs):
+    """The merged per-process JSONL lines as one Trace Event Format
+    doc: pid = trace id, sorted so per-pid timestamps are monotone
+    (cross-process monotonic clocks share no origin)."""
+    evs = []
+    for r in recs:
+        if r.get("ph") == "i":
+            evs.append({
+                "name": r["name"], "ph": "i", "s": "t",
+                "ts": r["t"] * 1e6, "pid": r["trace"], "tid": 0,
+            })
+        else:
+            evs.append({
+                "name": r["name"], "ph": "X",
+                "ts": r["t0"] * 1e6, "dur": r["dur"] * 1e6,
+                "pid": r["trace"], "tid": 0,
+            })
+    evs.sort(key=lambda e: (str(e["pid"]), e["ts"]))
+    return {
+        "traceEvents": evs,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "fleet spans"},
+    }
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"{msg} not reached in {timeout}s")
+        time.sleep(0.05)
+
+
+def _fetch(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# -- tentpole (a): one span tree across processes ----------------------------
+
+
+def test_cross_process_span_tree_with_requeue(fleet, span_dir,
+                                              direct_cache):
+    """A fresh router over the chaos slice ONLY (the test_fleet replay
+    setup: request seq 2 deterministically drops its first attempt):
+    every request completes bitwise, and each one's spans — router file
+    + slice file merged by trace id — form exactly one complete tree,
+    requeue included, validator-clean."""
+    h0 = fleet.router.slices()["slice0"]
+    rtel = tm.Telemetry(
+        interval=0, autostart=False,
+        span_path=str(span_dir / "r1.spans.jsonl"), span_node="r1",
+    )
+    router = FleetRouter(
+        models={"mm1": fleet.spec("mm1")}, window=2, place_seed=11,
+        request_timeout=180.0, telemetry=rtel, name="obs-fleet-1",
+    )
+    try:
+        router.add_slice(SliceHandle(
+            h0.name, h0.host, h0.port, h0.health_url,
+        ))
+        digests = {}
+        for i in range(3):
+            h = router.submit(_req(fleet.spec("mm1"), 40 + i, f"obs{i}"))
+            assert h.result(180) is not None
+            digests[f"obs{i}"] = (40 + i, h.digest())
+        log = router.decision_log()
+    finally:
+        router.shutdown(wait=True, timeout=30)
+        rtel.close()
+
+    # telemetry ON never perturbs results: routed == direct, bitwise
+    for seed, dig in digests.values():
+        assert dig == _direct_digest(seed, direct_cache)
+
+    # seq 2's first attempt dropped on slice0 (seed=5 chaos) and the
+    # requeue decision carries the new 4-tuple shape
+    assert ("requeue", 2, "slice0", None) in log, log
+    assert all(len(d) == 4 for d in log), log
+
+    assert rtel.spans.open_count() == 0
+    recs = [r for r in _span_lines(span_dir)
+            if str(r.get("trace", "")).endswith(".r1")]
+    by_trace = {}
+    for r in recs:
+        by_trace.setdefault(r["trace"], []).append(r)
+    # one trace per request, each a single complete tree
+    roots = [r for r in recs
+             if r.get("ph") != "i" and r.get("parent") is None]
+    assert len(roots) == 3, roots
+    for root in roots:
+        lines = by_trace[root["trace"]]
+        ids = {r["span"] for r in lines if r.get("span")}
+        for r in lines:
+            p = r.get("parent")
+            assert p is None or p in ids, (r, sorted(ids))
+        assert root["name"] == "request"
+        assert root["outcome"] == "completed", root
+        names = [r["name"] for r in lines if r.get("ph") != "i"]
+        assert "pending" in names and "wire" in names, names
+        # the graft: the slice subprocess recorded its own request
+        # tree under this trace, parented on a router wire span
+        slice_spans = [r for r in lines
+                       if str(r.get("span", "")).endswith(".slice0")]
+        assert slice_spans, lines
+        wire_ids = {r["span"] for r in lines if r["name"] == "wire"}
+        grafts = [r for r in slice_spans
+                  if r["name"] == "request" and r["parent"] in wire_ids]
+        assert grafts, slice_spans
+
+    # seq 2's tree shows the full requeue story: a "requeued" wire
+    # span, the failover/requeue instant event, a restarted pending,
+    # then the winning attempt
+    t2 = [r for r in roots if r.get("seq") == 2][0]["trace"]
+    lines2 = by_trace[t2]
+    wires2 = [r for r in lines2 if r["name"] == "wire"]
+    assert [w["outcome"] for w in wires2].count("requeued") == 1, wires2
+    assert [w["outcome"] for w in wires2].count("ok") == 1, wires2
+    assert sum(1 for r in lines2 if r["name"] == "pending") == 2, lines2
+    assert any(r.get("ph") == "i" and r["name"] == "requeue"
+               for r in lines2), lines2
+
+    oe.validate_chrome_trace(_chrome_doc(recs))
+
+
+# -- tentpole (b): fleet rollup exposition -----------------------------------
+
+
+def test_fleet_metrics_rollup_and_healthz(fleet):
+    """The manager's /metrics federates slice scrapes: per-slice
+    series + a slice="all" rollup equal to the sum over live slices,
+    next to the router's own cimba_fleet_* families; /healthz carries
+    the router's slice-verdict rollup."""
+    hs = [fleet.router.submit(_req(fleet.spec("mm1"), 60 + i))
+          for i in range(4)]
+    for h in hs:
+        assert h.result(180) is not None
+
+    fam = "cimba_serve_requests_completed_total"
+    key = (("event", "completed"), ("fleet", "cimba-fleet"))
+
+    def rollup_consistent():
+        _, text = _fetch(fleet.expose.url + "/metrics")
+        samples = parse_prometheus_text(text)["samples"]
+        series = samples.get(fam, {})
+        vals = {dict(k).get("slice"): v for k, v in series.items()}
+        if "slice0" not in vals or "slice1" not in vals:
+            return False
+        done = samples.get("cimba_fleet_requests_total", {}).get(key, 0.0)
+        return (
+            vals["slice0"] + vals["slice1"] >= 4
+            and vals.get("all") == vals["slice0"] + vals["slice1"]
+            and done >= 4
+        )
+
+    # the federation is eventually consistent (one scrape per slice
+    # per poll interval, one sampler tick for the router mirror); it
+    # must converge once traffic quiesces
+    _wait(rollup_consistent, timeout=30, msg="metrics rollup")
+
+    _, text = _fetch(fleet.expose.url + "/metrics")
+    samples = parse_prometheus_text(text)["samples"]
+    completed = samples["cimba_fleet_requests_total"]
+    assert completed[key] >= 4, completed
+    ups = samples["cimba_fleet_slice_up"]
+    assert sum(ups.values()) == 2.0, ups
+    # the capacity signal is scraped (refill off in these slices, so
+    # placement falls back — but the families federate regardless)
+    assert "cimba_serve_free_lanes" in samples, sorted(samples)
+
+    status, body = _fetch(fleet.expose.url + "/healthz")
+    hz = json.loads(body)
+    assert status == 200 and hz["ok"], hz
+    check = hz["checks"]["cimba-fleet"]
+    assert check["status"] == "ok" and check["up"] == 2, check
+    assert set(check["slices"]) == {"slice0", "slice1"}, check
+
+
+def test_fleet_health_verdict_rollup_unit():
+    """The verdict fold, no processes needed: scraped degraded ->
+    degraded; a down slice -> degraded; zero live slices ->
+    unhealthy."""
+    t = tm.Telemetry(interval=0, autostart=False)
+    router = FleetRouter(models={}, telemetry=t, name="hfleet")
+    try:
+        router.add_slice(SliceHandle("a", "127.0.0.1", 1, "http://x"))
+        router.add_slice(SliceHandle("b", "127.0.0.1", 2, "http://y"))
+        router.update_scrape("a", {"verdict": "ok"})
+        router.update_scrape("b", {"verdict": "ok"})
+        assert t.healthz()["status"] == "ok"
+        router.update_scrape("b", {"verdict": "degraded"})
+        hz = t.healthz()
+        assert hz["status"] == "degraded" and hz["ok"], hz
+        router.mark_down("b", "test")
+        hz = t.healthz()
+        assert hz["status"] == "degraded", hz
+        assert hz["checks"]["hfleet"]["slices"]["b"] == "down:test"
+        router.mark_down("a", "test")
+        assert t.healthz()["status"] == "unhealthy"
+        # dead slices' federated series are pruned on removal
+        router.update_scrape("a", {"verdict": "ok"})  # no-op: down
+        router.remove_slice("a")
+        router.remove_slice("b")
+        assert t.healthz()["status"] == "unhealthy"   # zero slices
+    finally:
+        router.shutdown(wait=False)
+        t.close()
+    # detached at shutdown: the hook no longer contributes
+    assert "checks" not in t.healthz()
+
+
+# -- tentpole (c): capacity-aware placement ----------------------------------
+
+
+def test_capacity_placement_deterministic(fleet, direct_cache):
+    """Two fresh routers over the live slices, fed the IDENTICAL
+    injected capacity scrapes and request stream (no poller touches
+    them), produce identical decision logs — every placement carrying
+    its ("capacity", free, headroom) snapshot — and results stay
+    bitwise the direct call's.  Flipping which slice has headroom
+    flips the first pick; lacking the signal falls back to
+    ("load", ...)."""
+    live = {n: h for n, h in fleet.router.slices().items() if h.up}
+    assert set(live) == {"slice0", "slice1"}
+
+    def run(free0, free1, n=3, capacity=None):
+        router = FleetRouter(
+            models={"mm1": fleet.spec("mm1")}, window=2,
+            place_seed=11, request_timeout=180.0,
+            capacity_placement=capacity, name="obs-cap",
+        )
+        try:
+            for name in ("slice0", "slice1"):
+                h = live[name]
+                router.add_slice(SliceHandle(
+                    h.name, h.host, h.port, h.health_url,
+                ))
+                free = {"slice0": free0, "slice1": free1}[name]
+                scrape = {"queue_depth": 0.0}
+                if free is not None:
+                    scrape.update(
+                        refill_enabled=1.0, free_lanes=float(free)
+                    )
+                router.update_scrape(name, scrape)
+            digs = []
+            for i in range(n):
+                h = router.submit(_req(fleet.spec("mm1"), 80 + i))
+                assert h.result(180) is not None
+                digs.append(h.digest())
+            return router.decision_log(), digs
+        finally:
+            router.shutdown(wait=True, timeout=30)
+
+    log_a, dig_a = run(8, 2)
+    log_b, dig_b = run(8, 2)
+    assert log_a == log_b, (log_a, log_b)
+    assert dig_a == dig_b
+    assert dig_a[0] == _direct_digest(80, direct_cache)
+    # headroom ranking picked the free slice and recorded the evidence
+    assert log_a[0] == ("place", 1, "slice0", ("capacity", 8.0, 8.0))
+    assert all(
+        d[3][0] == "capacity" for d in log_a if d[0] == "place"
+    ), log_a
+
+    # flip the headroom -> the first pick flips (same seed, stream)
+    log_c, _ = run(2, 8, n=1)
+    assert log_c[0] == ("place", 1, "slice1", ("capacity", 8.0, 8.0))
+
+    # any candidate without the signal -> least-loaded fallback
+    log_d, _ = run(8, None, n=1)
+    assert log_d[0][3][0] == "load", log_d
+
+
+# -- zero cost off -----------------------------------------------------------
+
+
+def test_zero_cost_off_and_knobs(fleet, monkeypatch):
+    from cimba_tpu import config as _cfg
+
+    for knob in ("CIMBA_FLEET_TELEMETRY", "CIMBA_FLEET_CAPACITY"):
+        assert knob in _cfg.ENV_KNOBS
+        assert not _cfg.ENV_KNOBS[knob]["trace_gate"]
+    assert _cfg.env_raw("CIMBA_FLEET_TELEMETRY") == ""
+
+    # telemetry=None: no recorder, no span state minted on submit
+    router = FleetRouter(models={"mm1": fleet.spec("mm1")})
+    try:
+        assert router._rec is None and router._tel is None
+        h = router.submit(_req(fleet.spec("mm1"), 99))
+        assert h._entry.trace is None
+        assert h._entry.span_root is None
+        assert h.cancel()
+    finally:
+        router.shutdown(wait=False)
+    assert router.stats()["capacity_placement"] is True
+
+    monkeypatch.setenv("CIMBA_FLEET_CAPACITY", "0")
+    r2 = FleetRouter(models={})
+    assert r2.capacity_placement is False
+    r2.shutdown(wait=False)
+
+    # a cancelled request with spans on still yields ONE complete tree
+    t = tm.Telemetry(interval=0, autostart=False, spans=True)
+    r3 = FleetRouter(models={"mm1": fleet.spec("mm1")}, telemetry=t)
+    try:
+        h = r3.submit(_req(fleet.spec("mm1"), 99))
+        assert h.cancel()
+        assert t.spans.open_count() == 0
+        recs = list(t.spans.completed)
+        root = [r for r in recs if r["parent"] is None][0]
+        assert root["outcome"] == "cancelled", recs
+    finally:
+        r3.shutdown(wait=False)
+        t.close()
+
+    # the free-lane pool is scrapable over the wire (stats op):
+    # refill off in these slices -> the key exists and reads 0
+    st = fleet.router.slice_stats("slice1")
+    assert st["refill"]["free_lanes"] == 0, st["refill"]

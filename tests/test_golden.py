@@ -15,6 +15,7 @@ Regenerate after an INTENTIONAL semantic change with:
 
 import jax
 import numpy as np
+import pytest
 
 from cimba_tpu.core import loop as cl
 from cimba_tpu.models import awacs, jobshop, mg1, mm1, mmc
@@ -93,6 +94,7 @@ def test_golden_mg1():
     _check("mg1")
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_golden_jobshop():
     _check("jobshop")
 

@@ -737,6 +737,7 @@ def test_program_cache_env_cap(monkeypatch):
         pc.ProgramCache()
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_stream_correct_under_cache_eviction_pressure():
     """A capacity-starved cache only costs recompiles, never
     correctness: alternating two specs through one 2-entry cache (each

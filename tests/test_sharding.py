@@ -65,6 +65,7 @@ def test_make_sharded_experiment_merge_is_exact():
     )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_spawn_model_mesh_matches_single_device():
     """Layout invariance holds for spawn pools too: dynamic activation
     (free-row scans, row recycling) is per-lane state machinery, so the

@@ -21,6 +21,7 @@ def test_tut_1_mm1_matches_theory():
     assert mean > 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_tut_2_park_preemption_reconciles():
     muggings = tut_2_park.main()
     assert muggings > 0
@@ -32,6 +33,7 @@ def test_tut_3_balking_reneging_jockeying():
     assert visits > 0
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_tut_4_harbor_all_ships_sail():
     sailed = tut_4_harbor.main()
     assert sailed > 0
@@ -49,6 +51,7 @@ def test_tut_5_awacs_nn_hook():
     assert tut_5_awacs.main() > 0.5 * tut_5_awacs.N_TARGETS
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_cookbook_balking_runs_as_printed():
     """The manual's capstone (docs/08_cookbook_balking.md) ships as a
     runnable example; its self-assertions (balk fraction, accounting

@@ -739,6 +739,134 @@ print("fleet smoke OK:", report.n_completed, "completed,",
       "replacement burst %.2fs," % burst_s, "store", sstats)
 EOF
 
+# fleet trace smoke (docs/23_fleet_observability.md): 2 slices + the
+# router with the FULL observability plane attached — router telemetry
+# with span JSONL, /metrics + /healthz exposition, and
+# CIMBA_FLEET_TELEMETRY span files in every slice subprocess.  Every
+# digest must stay bitwise the direct call's (telemetry never perturbs
+# results), the fleet healthz rollup must read ok with both slices up,
+# the slice="all" federated rollup must equal the per-slice sum, and
+# the merged cross-process span JSONL must form one complete,
+# validator-clean tree per request with the slice trees grafted under
+# the router's wire spans
+run_cell "fleet trace smoke" python - <<'EOF'
+import json, os, tempfile, time, urllib.request
+store = tempfile.mkdtemp()
+spandir = tempfile.mkdtemp()
+
+from cimba_tpu.models import mm1
+from cimba_tpu.serve import store as pstore
+spec, _ = mm1.build(record=False)
+pstore.get_store(store).save_programs(
+    spec, mm1.params(30), 16, wave_sizes=(16,), chunk_steps=128,
+    horizon_modes=("none",))
+
+from cimba_tpu import serve
+from cimba_tpu.fleet.manager import FleetManager
+from cimba_tpu.obs import audit
+from cimba_tpu.obs import export as oe
+from cimba_tpu.obs import telemetry as tm
+from cimba_tpu.obs.expose import parse_prometheus_text
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+
+models = {"mm1": {"fn": "cimba_tpu.models.mm1:build",
+                  "kwargs": {"record": False}}}
+tel = tm.Telemetry(interval=0.1,
+                   span_path=os.path.join(spandir, "router.spans.jsonl"),
+                   span_node="router")
+N = 4
+with FleetManager(models, n_slices=2, max_wave=16, store=store,
+                  warm_chunk_steps=128, window=2, poll_interval=0.3,
+                  scrape_timeout=1.0, telemetry=tel, expose_port=0,
+                  span_dir=spandir) as fm:
+    fspec = fm.spec("mm1")
+    hs = [fm.router.submit(serve.Request(
+        fspec, mm1.params(30), 16, seed=7, wave_size=16,
+        chunk_steps=128, label=f"t{i}")) for i in range(N)]
+    results = [h.result(300) for h in hs]
+
+    # bitwise vs the direct single-process call
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(30), 16, wave_size=16, chunk_steps=128, seed=7,
+        program_cache=pc.ProgramCache())
+    anchor = audit.stream_result_digest(direct)
+    for res in results:
+        assert audit.stream_result_digest(res) == anchor
+
+    def fetch(path):
+        with urllib.request.urlopen(fm.expose.url + path, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    # federated rollup: slice="all" == sum over live slices, and the
+    # router's own lifecycle counters ride the same endpoint; the
+    # federation is eventually consistent (one scrape per poll, one
+    # sampler tick for the mirror) so poll for convergence
+    fam = "cimba_serve_requests_completed_total"
+    key = (("event", "completed"), ("fleet", "cimba-fleet"))
+    deadline = time.monotonic() + 30
+    while True:
+        _, text = fetch("/metrics")
+        samples = parse_prometheus_text(text)["samples"]
+        vals = {dict(k).get("slice"): v
+                for k, v in samples.get(fam, {}).items()}
+        done = samples.get("cimba_fleet_requests_total", {}).get(key, 0.0)
+        if ("slice0" in vals and "slice1" in vals
+                and vals["slice0"] + vals["slice1"] >= N
+                and vals.get("all") == vals["slice0"] + vals["slice1"]
+                and done >= N):
+            break
+        assert time.monotonic() < deadline, (vals, done)
+        time.sleep(0.1)
+
+    # fleet healthz rollup: ok, both slices up
+    status, body = fetch("/healthz")
+    hz = json.loads(body)
+    assert status == 200 and hz["ok"], hz
+    check = hz["checks"]["cimba-fleet"]
+    assert check["status"] == "ok" and check["up"] == 2, check
+assert tel.spans.open_count() == 0, tel.spans.counters
+tel.close()
+
+# merged cross-process span files: one complete validator-clean tree
+# per request, slice trees grafted under the router's wire spans
+recs = []
+for fn in sorted(os.listdir(spandir)):
+    if fn.endswith(".spans.jsonl"):
+        with open(os.path.join(spandir, fn)) as f:
+            recs += [json.loads(l) for l in f if l.strip()]
+router_recs = [r for r in recs if str(r.get("trace", "")).endswith(".router")]
+roots = [r for r in router_recs
+         if r.get("ph") != "i" and r.get("parent") is None]
+assert len(roots) == N, roots
+by_trace = {}
+for r in router_recs:
+    by_trace.setdefault(r["trace"], []).append(r)
+for root in roots:
+    assert root["name"] == "request" and root["outcome"] == "completed", root
+    lines = by_trace[root["trace"]]
+    ids = {r["span"] for r in lines if r.get("span")}
+    for r in lines:
+        assert r.get("parent") is None or r["parent"] in ids, r
+    wire_ids = {r["span"] for r in lines if r["name"] == "wire"}
+    grafts = [r for r in lines
+              if r["name"] == "request" and r.get("parent") in wire_ids]
+    assert grafts, lines
+evs = []
+for r in router_recs:
+    if r.get("ph") == "i":
+        evs.append({"name": r["name"], "ph": "i", "s": "t",
+                    "ts": r["t"] * 1e6, "pid": r["trace"], "tid": 0})
+    else:
+        evs.append({"name": r["name"], "ph": "X", "ts": r["t0"] * 1e6,
+                    "dur": r["dur"] * 1e6, "pid": r["trace"], "tid": 0})
+evs.sort(key=lambda e: (str(e["pid"]), e["ts"]))
+oe.validate_chrome_trace({"traceEvents": evs, "displayTimeUnit": "ms",
+                          "otherData": {"source": "fleet trace smoke"}})
+print("fleet trace smoke OK:", N, "requests,", len(recs), "span lines,",
+      "rollup", vals, "fleet healthz", check["status"])
+EOF
+
 # tune smoke (docs/21_autotune.md): search 3 schedule arms on the tiny
 # probe model (every arm bitwise-pinned against the default inside the
 # search), persist the winner into a temp program store, then a CLEAN

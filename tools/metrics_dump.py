@@ -13,7 +13,10 @@ Fleet mode (docs/20_fleet.md): several ``--url``s, or ``--fleet`` with
 a fleet manifest file (``{"slices": [{"name", "url"}, ...]}`` — what
 ``FleetManager.fleet_manifest()`` emits), prints one PER-SLICE row
 (health verdict, queue depth, outstanding, padding waste, store
-hits/fallbacks) plus a fleet rollup:
+hits/fallbacks, lane occupancy now/mean, free lanes, refill state —
+the capacity plane of docs/23_fleet_observability.md) plus a fleet
+rollup (verdict counts, queued/outstanding, refill-enabled slices and
+their summed free lanes):
 
     python tools/metrics_dump.py --url http://h:9321 --url http://h:9322
     python tools/metrics_dump.py --fleet fleet.json
@@ -153,12 +156,15 @@ def dump_fleet(slices, timeout: float) -> int:
     cols = (
         ("slice", 18), ("verdict", 12), ("queue", 6), ("outst", 6),
         ("waste", 6), ("hits", 6), ("fallbk", 7), ("done", 6),
+        ("occ", 6), ("mocc", 6), ("free", 5), ("refill", 6),
     )
     print("  ".join(f"{name:<{w}}" for name, w in cols))
     print("  ".join("-" * w for _, w in cols))
     rollup = {"ok": 0, "degraded": 0, "unhealthy": 0, "unreachable": 0}
     depth_total = 0
     outst_total = 0
+    free_total = 0
+    refill_on = 0
     bad = 0
     for name, url in slices:
         rep = scrape_slice(url, timeout)
@@ -168,6 +174,9 @@ def dump_fleet(slices, timeout: float) -> int:
             bad += 1
         depth_total += int(rep.get("queue_depth", 0))
         outst_total += int(rep.get("outstanding", 0))
+        if rep.get("refill_enabled"):
+            refill_on += 1
+            free_total += int(rep.get("free_lanes") or 0)
 
         def fmt(key, pct=False):
             v = rep.get(key)
@@ -179,6 +188,11 @@ def dump_fleet(slices, timeout: float) -> int:
             name[:18], verdict, fmt("queue_depth"), fmt("outstanding"),
             fmt("padding_waste", pct=True), fmt("store_hits"),
             fmt("store_fallback_shapes"), fmt("completed"),
+            fmt("occupancy_now", pct=True),
+            fmt("occupancy_mean", pct=True),
+            fmt("free_lanes"),
+            ("on" if rep.get("refill_enabled")
+             else "-" if rep.get("refill_enabled") is None else "off"),
         )
         print("  ".join(
             f"{v:<{w}}" for v, (_, w) in zip(row, cols)
@@ -190,6 +204,7 @@ def dump_fleet(slices, timeout: float) -> int:
         f"fleet: {len(slices)} slice(s) — "
         + ", ".join(f"{k} {v}" for k, v in rollup.items() if v)
         + f"; queued {depth_total}, outstanding {outst_total}"
+        + f"; refill on {refill_on}, free lanes {free_total}"
     )
     if bad:
         print(f"UNHEALTHY: {bad} slice(s) down or unreachable")
