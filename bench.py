@@ -1697,6 +1697,235 @@ def bench_serve_refill():
     )
 
 
+def bench_serve_preempt():
+    """The preemptive device scheduler vs run-to-completion dispatch
+    at the SAME offered load (docs/24_device_scheduler.md): one long
+    low-priority background request is mid-wave when a burst of short
+    HIGH-priority urgent requests arrives in a different horizon-bucket
+    class.  sched_off (the baseline arm) makes the urgents wait out
+    the background wave; sched_on checkpoint-evicts the background at
+    a quantum boundary, runs the urgent class first, and restores.
+    Acceptance: urgent p99 submit->deliver latency improves >= 2x,
+    EVERY result (preempted background included, 64 digests across
+    arms x repeats) is bitwise its direct solo run, and ZERO programs
+    compile during the timed rounds (preempt/evict/restore is pure
+    dispatch — the prepare legs warm everything, including one full
+    preemption)."""
+    import time as _time
+
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.tune import measure as _tm
+
+    accel = _accel()
+    wave = int(os.environ.get(
+        "CIMBA_BENCH_PREEMPT_WAVE", str(4096 if accel else 16)
+    ))
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = int(os.environ.get(
+        "CIMBA_BENCH_PREEMPT_CHUNK", str(256 if accel else 32)
+    ))
+    req_r = max(int(os.environ.get(
+        "CIMBA_BENCH_PREEMPT_REQ_R", str(max(wave // 4, 1))
+    )), 1)
+    n_urgent = int(os.environ.get("CIMBA_BENCH_PREEMPT_URGENT", "15"))
+    # mm1 is finite-population: n_objects IS the trajectory length, so
+    # the background's 400x object count is what makes it long-lived;
+    # the t_end caps exist to put the two classes in DIFFERENT horizon
+    # buckets (16.0: 60000 -> bucket 3, 60 -> bucket 1), which is what
+    # forbids splicing and forces the scheduling decision
+    bg_objs = int(os.environ.get(
+        "CIMBA_BENCH_PREEMPT_BG_OBJS", str(400 * N)
+    ))
+    ur_objs = 2 * N
+    bg_t_end = float(os.environ.get(
+        "CIMBA_BENCH_PREEMPT_BG_T", "60000.0"
+    ))
+    ur_t_end = float(os.environ.get("CIMBA_BENCH_PREEMPT_UR_T", "60.0"))
+    repeats = int(os.environ.get("CIMBA_BENCH_PREEMPT_REPEATS", "2"))
+    ur_seeds = (11, 22, 33)
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = serve.ProgramCache()
+
+        def _req(n_objects, seed, t_end, prio, label):
+            return serve.Request(
+                spec, mm1.params(n_objects), req_r, seed=seed,
+                t_end=t_end, wave_size=req_r, chunk_steps=chunk,
+                priority=prio, label=label,
+            )
+
+        def load_round(sched_on, timed):
+            """One round: background submitted, wave observed live,
+            then the urgent burst; returns (results keyed by (seed,
+            t_end), urgent latencies, stats)."""
+            svc = serve.Service(
+                max_wave=wave, cache=cache, device_sched=sched_on,
+                waves_per_device=1, preempt_quantum=2, refill_every=2,
+                horizon_bucket=16.0, pad_waves=False,
+                on_chunk=_heartbeat,
+            )
+            try:
+                bg = svc.submit(_req(bg_objs, 1, bg_t_end, 0, "bg"))
+                # the urgents must arrive against a RUNNING wave —
+                # poll until the background's lanes are live
+                deadline = _time.monotonic() + 120
+                while (svc.stats()["lane_occupancy"]["lanes_in_wave"]
+                       == 0 and _time.monotonic() < deadline):
+                    _time.sleep(0.002)
+                t0 = {}
+                handles = []
+                for i in range(n_urgent):
+                    seed = ur_seeds[i % len(ur_seeds)]
+                    h = svc.submit(_req(
+                        ur_objs, seed, ur_t_end, 10, f"ur{i}"
+                    ))
+                    t0[i] = _time.monotonic()
+                    handles.append((i, seed, h))
+                lat = []
+                results = {}
+                for i, seed, h in handles:
+                    results.setdefault(
+                        (ur_objs, seed, ur_t_end), []
+                    ).append(h.result(600))
+                    lat.append(_time.monotonic() - t0[i])
+                results[(bg_objs, 1, bg_t_end)] = [bg.result(600)]
+                stats = svc.stats()
+            finally:
+                svc.shutdown()
+            return results, lat, stats
+
+        payloads: dict = {}
+        misses_at_first_run: dict = {}
+
+        def make_arm(name, sched_on):
+            def prepare():
+                # warm every program this arm dispatches — the
+                # sched_on leg includes a full preempt/restore cycle
+                load_round(sched_on, timed=False)
+
+            def run():
+                misses_at_first_run.setdefault(
+                    "misses", cache.stats()["misses"]
+                )
+                res, lat, stats = load_round(sched_on, True)
+                payloads.setdefault(name, []).append(
+                    (res, lat, stats)
+                )
+                return stats
+
+            return _tm.Arm(name=name, run=run, prepare=prepare)
+
+        arms = [
+            make_arm("sched_off", False), make_arm("sched_on", True),
+        ]
+        mreport = _tm.measure_arms(
+            arms, repeats=repeats, baseline=0, on_round=_heartbeat,
+        )
+        compiled_in_timed = (
+            cache.stats()["misses"] - misses_at_first_run["misses"]
+            if misses_at_first_run else None
+        )
+        assert compiled_in_timed == 0, (
+            "programs compiled during the timed preempt rounds",
+            compiled_in_timed, cache.stats(),
+        )
+        # digest anchors: every (objects, seed, t_end) point's direct
+        # solo run
+        direct_digest = {}
+        for key in (
+            [(ur_objs, s, ur_t_end) for s in ur_seeds]
+            + [(bg_objs, 1, bg_t_end)]
+        ):
+            n_obj, seed, t_end = key
+            direct_digest[key] = _audit.stream_result_digest(
+                ex.run_experiment_stream(
+                    spec, mm1.params(n_obj), req_r, wave_size=req_r,
+                    chunk_steps=chunk, seed=seed, t_end=t_end,
+                    program_cache=cache, on_wave=_heartbeat,
+                    on_chunk=_heartbeat,
+                )
+            )
+        digest_checked = digest_equal = 0
+        arm_detail: dict = {}
+        for name, rounds in payloads.items():
+            all_lat: list = []
+            preempts = restores = 0
+            for res, lat, stats in rounds:
+                all_lat.extend(lat)
+                for key, rs in res.items():
+                    for r in rs:
+                        digest_checked += 1
+                        digest_equal += (
+                            _audit.stream_result_digest(r)
+                            == direct_digest[key]
+                        )
+                ds = stats.get("device_sched", {})
+                preempts += ds.get("preemptions", 0)
+                restores += ds.get("restores", 0)
+            s = sorted(all_lat)
+
+            def pct(p, s=s):
+                return s[min(int(len(s) * p), len(s) - 1)]
+
+            arm_detail[name] = {
+                "urgent_latency_s": {
+                    "p50": pct(0.50), "p95": pct(0.95),
+                    "p99": pct(0.99), "n": len(s),
+                },
+                "preemptions": preempts,
+                "restores": restores,
+            }
+    on_d = arm_detail["sched_on"]
+    off_d = arm_detail["sched_off"]
+    p99_on = on_d["urgent_latency_s"]["p99"]
+    p99_off = off_d["urgent_latency_s"]["p99"]
+    assert digest_checked and digest_equal == digest_checked, (
+        "preempted results drifted from their solo digests",
+        digest_equal, digest_checked,
+    )
+    assert on_d["preemptions"] >= 1 and on_d["restores"] >= 1, on_d
+    assert p99_on * 2.0 <= p99_off, (
+        "urgent p99 under preemption not >= 2x better",
+        p99_on, p99_off,
+    )
+    _line(
+        "serve_preempt_urgent_p99_s",
+        p99_on,
+        p99_off / p99_on if p99_on else None,
+        {
+            "path": "serve_device_scheduler",
+            "profile": prof,
+            "urgent_requests": n_urgent,
+            "bg_objects": bg_objs,
+            "urgent_objects": ur_objs,
+            "bg_t_end": bg_t_end,
+            "urgent_t_end": ur_t_end,
+            "objects_per_replication": N,
+            "replications_per_request": req_r,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "measure": mreport.to_json(),
+            "preempt": {
+                "arms": arm_detail,
+                "p99_speedup_on_vs_off": (
+                    p99_off / p99_on if p99_on else None
+                ),
+                "compiles_in_timed_rounds": compiled_in_timed,
+                "digest_anchors": {
+                    "checked": digest_checked, "equal": digest_equal,
+                },
+            },
+            "program_cache": cache.stats(),
+        },
+        unit="s",
+    )
+
+
 #: the serve_cold child: one fresh process per trial per arm, timing
 #: import / programs-ready / first-result legs of a single serve-shaped
 #: request.  The hydrated arm warms from the AOT store manifest (NO
@@ -2879,6 +3108,7 @@ CONFIGS = {
     "serve_cold": bench_serve_cold,
     "serve_fleet": bench_serve_fleet,
     "serve_mixed": bench_serve_mixed,
+    "serve_preempt": bench_serve_preempt,
     "serve_refill": bench_serve_refill,
     "mmc": bench_mmc,
     "mg1": bench_mg1,

@@ -238,6 +238,21 @@ GATES: Tuple[Gate, ...] = (
         ambient_env={"CIMBA_REFILL": "1"},
         off_env={"CIMBA_REFILL": "0"},
     ),
+    Gate(
+        name="device_sched",
+        env=("CIMBA_DEVICE_SCHED",),
+        program="chunk",
+        # the preemptive device scheduler
+        # (docs/24_device_scheduler.md) is, like refill, a HOST-side
+        # dispatch policy: the knob selects concurrent-wave admission
+        # and checkpoint-evict-restore preemption in the serve
+        # dispatcher and must never bind into a traced chunk program —
+        # a scheduled wave runs the SAME chunk program as the plain
+        # one (checkpointing reuses the PR 3 resumable path, outside
+        # any trace).  No ON arm: no chunk-program state to flip.
+        ambient_env={"CIMBA_DEVICE_SCHED": "1"},
+        off_env={"CIMBA_DEVICE_SCHED": "0"},
+    ),
 )
 
 

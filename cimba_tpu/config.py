@@ -183,6 +183,18 @@ ENV_KNOBS = {
             "inertness), and the refill/liveness programs are separate "
             "compiles keyed by the same compatibility class",
     ),
+    "CIMBA_DEVICE_SCHED": dict(
+        default="", trace_gate=True,
+        doc="preemptive device scheduler "
+            "(docs/24_device_scheduler.md): =1 makes "
+            "Service(device_sched=None) run concurrent refill waves "
+            "per device with memory-aware admission and checkpoint-"
+            "evict-restore preemption of lower-priority waves.  Purely "
+            "a HOST-side dispatch policy: the chunk program is "
+            "untouched (the 'device_sched' gate in check/gates.py pins "
+            "ambient inertness); checkpoints ride the PR 3 resumable "
+            "path, so a preempted wave restores bit-identically",
+    ),
     # kernel-path knobs: Mosaic programs, covered by the dedicated
     # kernel parity batteries (test_mosaic_aot / test_pallas_run), not
     # the XLA-path gate sweep (interpret-mode tracing is over tier-1

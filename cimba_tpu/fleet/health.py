@@ -93,6 +93,15 @@ def scrape_slice(health_url: str, timeout: float) -> dict:
             ("refill_enabled", "cimba_serve_refill_enabled"),
             ("refill_admissions", "cimba_serve_refill_admissions_total"),
             ("lanes_refilled", "cimba_serve_lanes_refilled_total"),
+            # the device-scheduler plane (docs/24_device_scheduler.md):
+            # concurrent live waves + estimated free device memory —
+            # the memory-side capacity signal next to free_lanes — and
+            # the preempt/restore churn counters
+            ("waves_live", "cimba_serve_waves_live"),
+            ("preemptions", "cimba_serve_preemptions_total"),
+            ("restores", "cimba_serve_restores_total"),
+            ("est_free_mem",
+             "cimba_serve_est_free_device_mem_bytes"),
         ):
             v = total(metric)
             if v is not None:

@@ -1146,6 +1146,36 @@ def _service_collector(registry: Registry, name: str, service):
                         P + f"serve_{k}_total",
                         k.replace("_", " "), labels=("service",),
                     ).labels(**lab).set_total(ref[k])
+        ds = st.get("device_sched")
+        if ds:
+            registry.gauge(
+                P + "serve_device_sched_enabled",
+                "preemptive device scheduler active "
+                "(docs/24_device_scheduler.md)",
+                labels=("service",),
+            ).labels(**lab).set(1.0 if ds.get("enabled") else 0.0)
+            registry.gauge(
+                P + "serve_waves_live",
+                "concurrent RUNNING waves on the device right now",
+                labels=("service",),
+            ).labels(**lab).set(ds.get("waves_live", 0))
+            # the admission headroom in BYTES — the memory-side twin
+            # of serve_free_lanes for capacity-aware placement
+            free = ds.get("est_free_mem_bytes")
+            if free is not None:
+                registry.gauge(
+                    P + "serve_est_free_device_mem_bytes",
+                    "estimated free device memory under the "
+                    "admission budget",
+                    labels=("service",),
+                ).labels(**lab).set(free)
+            for k in ("preemptions", "evictions", "restores",
+                      "sched_waves_started", "mem_rejects"):
+                if k in ds:
+                    registry.counter(
+                        P + f"serve_{k}_total",
+                        k.replace("_", " "), labels=("service",),
+                    ).labels(**lab).set_total(ds[k])
         registry.gauge(
             P + "serve_classes_seen", "distinct compatibility classes",
             labels=("service",),

@@ -21,6 +21,9 @@ store — ``CIMBA_PROGRAM_STORE`` hydrates a fresh process to
 warm-serving without recompiling, docs/15_program_store.md),
 :mod:`~cimba_tpu.serve.sched` (queue/deadline/retry policy),
 :mod:`~cimba_tpu.serve.service` (the dispatcher),
+:mod:`~cimba_tpu.serve.device` (the preemptive device scheduler —
+concurrent waves per device, memory-aware admission,
+checkpoint-evict-restore preemption, docs/24_device_scheduler.md),
 :mod:`~cimba_tpu.serve.client` (synthetic load drivers).
 """
 
@@ -45,6 +48,7 @@ from cimba_tpu.serve.sched import (
     Backoff,
     Cancelled,
     DeadlineExceeded,
+    MemoryBudgetExceeded,
     QueueFull,
     RetriesExhausted,
     ServeError,
@@ -60,6 +64,6 @@ __all__ = [
     "run_load", "run_mixed_load", "mixed_requests",
     "AdmissionQueue", "Backoff",
     "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
-    "DeadlineExceeded", "RetriesExhausted",
+    "DeadlineExceeded", "RetriesExhausted", "MemoryBudgetExceeded",
     "Request", "ResultHandle", "Service",
 ]

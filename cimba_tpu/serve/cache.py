@@ -441,6 +441,124 @@ def get_refill_programs(
     return _get_or_create(programs, key, build)[:2]
 
 
+#: conservative working-set multiplier when no measured program
+#: footprint is available: the chunk program donates its carry, so the
+#: steady state holds roughly input + output + XLA temps — 3x the lane
+#: buffers bounds that from above without a compile
+_FOOTPRINT_SAFETY = 3
+
+
+def wave_footprint_bytes(
+    programs: MutableMapping,
+    spec,
+    *,
+    mesh,
+    pack,
+    chunk_steps: int,
+    with_metrics: bool,
+    lanes: int,
+    params,
+    n_replications: int,
+) -> int:
+    """Estimated device bytes ONE wave of ``lanes`` lanes holds while
+    live — the memory-aware admission cost of the device scheduler
+    (docs/24_device_scheduler.md): the Sim pytree's lane buffers (from
+    ``jax.eval_shape`` over the init program — no device work) plus
+    the chunk program's own working set, resolved down a ladder:
+
+    1. a store-persisted ``footprint_bytes`` on the hydrated chunk
+       program (measured by ``save_programs`` at AOT-compile time —
+       no re-lowering, the PR 17 manifest satellite);
+    2. ``chunk_j.lower(aval).compile().memory_analysis()`` where the
+       backend implements it (one AOT compile per (class, shape)
+       point, memoized here like any program);
+    3. a conservative estimate (``_FOOTPRINT_SAFETY`` x the lane
+       buffers) when neither is available.
+
+    Memoized in ``programs`` under a ``("footprint", ...)`` key beside
+    the programs it describes, so steady-state admission never
+    recomputes (and never compiles) anything."""
+    import jax
+
+    from cimba_tpu.runner import experiment as ex
+
+    row_aval = jax.eval_shape(
+        lambda: ex._slice_params(params, n_replications, 0, 1)
+    )
+    psig = (
+        jax.tree.structure(row_aval),
+        tuple(
+            (tuple(l.shape[1:]), str(l.dtype))
+            for l in jax.tree.leaves(row_aval)
+        ),
+    )
+    key = ("footprint",) + program_key(
+        spec, with_metrics, mesh=mesh, pack=pack,
+        chunk_steps=chunk_steps,
+    ) + (int(lanes), psig)
+
+    def build():
+        import jax.numpy as jnp
+        import numpy as np
+
+        init_j, chunk_j = get_programs(
+            programs, spec, mesh=mesh, pack=pack,
+            chunk_steps=chunk_steps, with_metrics=with_metrics,
+        )
+        L = int(lanes)
+        pw = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (L,) + x.shape[1:]),
+            ex._slice_params(params, n_replications, 0, 1),
+        )
+        sims_aval = jax.eval_shape(
+            init_j, jnp.arange(L), ex._seed_column(0, L),
+            ex._horizon_column(None, L), pw,
+        )
+        buf = sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(sims_aval)
+        )
+        prog = None
+        # rung 1: the store-measured footprint riding the hydrated
+        # chunk program (no lowering, no compile)
+        fp_for = getattr(chunk_j, "footprint_for", None)
+        if fp_for is not None:
+            prog = fp_for(sims_aval)
+        elif hasattr(chunk_j, "lower"):
+            # rung 2: AOT memory_analysis — unimplemented on some
+            # backends (and the whole rung is best-effort: admission
+            # must never fail because a compiler API moved)
+            try:
+                mem = chunk_j.lower(sims_aval).compile() \
+                    .memory_analysis()
+                prog = _memory_analysis_bytes(mem)
+            except Exception:
+                prog = None
+        if prog is None or prog <= 0:
+            # rung 3: conservative estimate — the extra copies bound
+            # donated-carry temps from above
+            prog = (_FOOTPRINT_SAFETY - 1) * buf
+        return int(buf + prog)
+
+    return _get_or_create(programs, key, build)
+
+
+def _memory_analysis_bytes(mem) -> "int | None":
+    """Sum the working-set fields a PjRt ``memory_analysis()`` object
+    exposes (field names vary by backend/version — absent ones count
+    0; a backend returning None yields None)."""
+    if mem is None:
+        return None
+    total = 0
+    for f in ("temp_size_in_bytes", "output_size_in_bytes",
+              "argument_size_in_bytes"):
+        try:
+            total += int(getattr(mem, f, 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    return total if total > 0 else None
+
+
 def get_fold(programs: MutableMapping, with_metrics: bool, summary_path):
     """The jitted wave-fold program shared by the stream runner and the
     service's per-request accumulators: merge the wave's pooled Pébay

@@ -16,11 +16,19 @@ jax — so the scheduling policy is testable without a device.
   reproducible schedules beat decorrelation at a single dispatcher).
 * The structured error taxonomy: :class:`DeadlineExceeded`,
   :class:`Cancelled`, :class:`QueueFull`, :class:`ServiceClosed`,
-  :class:`RetriesExhausted` — all subclasses of :class:`ServeError`,
-  all carrying enough state to be actionable without parsing strings.
+  :class:`RetriesExhausted`, :class:`MemoryBudgetExceeded` — all
+  subclasses of :class:`ServeError`, all carrying enough state to be
+  actionable without parsing strings.
 
 Ordering: higher ``priority`` pops first; ties break FIFO by admission
 sequence number (a total order — the pack scan is deterministic).
+Under the preemptive device scheduler (docs/24_device_scheduler.md)
+the same ``priority`` is also the PREEMPTION policy: a claimed request
+of strictly higher priority than the lowest-priority running wave may
+checkpoint-evict that wave at its next quantum boundary, run, and have
+the victim restored bit-identically — equal priority never preempts
+(FIFO among peers), so the plain priority semantics are unchanged when
+the scheduler is off.
 """
 
 from __future__ import annotations
@@ -90,6 +98,31 @@ class RetriesExhausted(ServeError):
         self.label = label
         super().__init__(
             f"dispatch failed after {attempts} attempt(s)"
+            + (f" (request {label!r})" if label else "")
+        )
+
+
+class MemoryBudgetExceeded(ServeError):
+    """The request's wave could NEVER be admitted: its estimated device
+    footprint (programs + lane buffers at the quantized wave shape)
+    exceeds the device scheduler's whole memory budget on its own
+    (docs/24_device_scheduler.md).  Structured backpressure — carries
+    the estimate and the budget so a client can resize (smaller
+    ``wave_size``) or route elsewhere, never a wrong program or a
+    silent OOM.  A request that merely doesn't fit *right now* (budget
+    held by live waves) is not an error: it waits, or preempts a
+    lower-priority wave."""
+
+    def __init__(
+        self, needed_bytes: int, budget_bytes: int,
+        label: Optional[str] = None,
+    ):
+        self.needed_bytes = int(needed_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.label = label
+        super().__init__(
+            f"estimated wave footprint {self.needed_bytes} B exceeds "
+            f"the device memory budget {self.budget_bytes} B"
             + (f" (request {label!r})" if label else "")
         )
 

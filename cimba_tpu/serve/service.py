@@ -298,6 +298,11 @@ _REFILL_COUNTERS = (
     "lanes_refilled", "lanes_reclaimed", "mid_wave_deliveries",
 )
 
+_DEVSCHED_COUNTERS = (
+    "preemptions", "evictions", "restores", "sched_waves_started",
+    "mem_rejects",
+)
+
 
 class _RefillSlot:
     """One request slot's lane ownership inside a refill-driven wave:
@@ -388,6 +393,22 @@ class Service:
       per-boundary liveness readback feeding the live occupancy gauge
       (service-local, never the shared program cache).
 
+    * ``device_sched`` (default None → the ``CIMBA_DEVICE_SCHED`` env
+      knob, unset = off): the preemptive device scheduler
+      (docs/24_device_scheduler.md) — the dispatcher interleaves up to
+      ``waves_per_device`` concurrent refill waves round-robin, one
+      ``preempt_quantum`` of chunks each per turn; a new wave admits
+      only when its estimated footprint fits the memory budget
+      (``mem_budget_bytes``, default ``mem_fraction`` x device memory;
+      a request that could NEVER fit fails fast with structured
+      :class:`~cimba_tpu.serve.sched.MemoryBudgetExceeded`); and an
+      urgent request may checkpoint-evict a strictly lower-priority
+      wave at a quantum boundary and restore it bit-identically later
+      (the PR 3 resumable-checkpoint path).  The three policy knobs
+      left None resolve from a tuned schedule at submit time, else the
+      ``tune.space`` defaults.  Off, dispatch is byte-identical to the
+      refill/plain paths (the 'device_sched' trace gate pins this).
+
     ``telemetry`` (default None) attaches a
     :class:`cimba_tpu.obs.telemetry.Telemetry` plane: the background
     sampler scrapes :meth:`stats` into the time-series registry, the
@@ -398,7 +419,7 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
-    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples, _waves_live, _est_free_mem, _waves_per_device, _preempt_quantum, _mem_fraction, _mem_budget_bytes
 
     def __init__(
         self,
@@ -417,6 +438,11 @@ class Service:
         telemetry=None,
         refill: Optional[bool] = None,
         refill_every: Optional[int] = None,
+        device_sched: Optional[bool] = None,
+        waves_per_device: Optional[int] = None,
+        preempt_quantum: Optional[int] = None,
+        mem_fraction: Optional[float] = None,
+        mem_budget_bytes: Optional[int] = None,
         name: str = "cimba-serve",
     ):
         from cimba_tpu import config as _config
@@ -449,6 +475,43 @@ class Service:
         self.refill_every = max(
             int(poll_every if refill_every is None else refill_every), 1
         )
+        # the preemptive device scheduler (docs/24_device_scheduler.md):
+        # None defers to the CIMBA_DEVICE_SCHED env knob (unset = off).
+        # On, the dispatcher thread delegates to
+        # serve.device.DeviceScheduler — concurrent refill waves per
+        # device with memory-aware admission and checkpoint-evict-
+        # restore preemption.  Host-side dispatch policy only, like
+        # refill: compiled programs are byte-identical either way (the
+        # 'device_sched' trace gate pins this).  The three policy knobs
+        # stay None here when unset so a tuned schedule can adopt them
+        # at submit time (_adopt_sched_knobs); effective defaults live
+        # in tune.space (DEFAULT_WAVES_PER_DEVICE & co).
+        self.device_sched = (
+            _config.env_raw("CIMBA_DEVICE_SCHED") == "1"
+            if device_sched is None else bool(device_sched)
+        )
+        self._waves_per_device = (
+            None if waves_per_device is None else int(waves_per_device)
+        )
+        self._preempt_quantum = (
+            None if preempt_quantum is None else int(preempt_quantum)
+        )
+        self._mem_fraction = (
+            None if mem_fraction is None else float(mem_fraction)
+        )
+        self._mem_budget_bytes = (
+            None if mem_budget_bytes is None else int(mem_budget_bytes)
+        )
+        if self._waves_per_device is not None \
+                and self._waves_per_device <= 0:
+            raise ValueError(
+                f"waves_per_device must be positive: {waves_per_device}"
+            )
+        if self._mem_fraction is not None \
+                and not 0.0 < self._mem_fraction <= 1.0:
+            raise ValueError(
+                f"mem_fraction must be in (0, 1]: {mem_fraction}"
+            )
         self.max_retries = int(max_retries)
         self.backoff = backoff
         self.cache = cache if cache is not None else _pcache.ProgramCache()
@@ -480,6 +543,8 @@ class Service:
             self._counters[o] = 0
         for o in _REFILL_COUNTERS:
             self._counters[o] = 0
+        for o in _DEVSCHED_COUNTERS:
+            self._counters[o] = 0
         # per-chunk live-lane occupancy samples: (live, lanes_in_wave)
         # pairs appended at every chunk boundary — ``live`` is a host
         # int on the refill path (the boundary controller already
@@ -494,6 +559,12 @@ class Service:
         # scrapes (docs/23_fleet_observability.md); 0 whenever no
         # refill wave is in flight (plain waves have no free pool)
         self._free_lanes = 0
+        # device-scheduler aggregates (docs/24_device_scheduler.md):
+        # live RUNNING waves and the estimated free device memory under
+        # the admission budget — written by DeviceScheduler after every
+        # wave-set change, scraped by stats()/fleet health
+        self._waves_live = 0
+        self._est_free_mem: Optional[int] = None
         # plain-path liveness-readback programs, per compatibility
         # class (dispatcher-thread only — see _run_batch)
         self._live_cache: dict = {}
@@ -603,6 +674,8 @@ class Service:
                 self._sched_sources.get(rs.source, 0) + 1
             )
             self._schedules[label] = rs.block()
+            if self.device_sched and rs.schedule is not None:
+                self._adopt_sched_knobs(rs.schedule)
             entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
             self._outstanding += 1
@@ -760,6 +833,17 @@ class Service:
             for k in _REFILL_COUNTERS:
                 out["refill"][k] = self._counters[k]
             out["refill"]["free_lanes"] = self._free_lanes
+            out["device_sched"] = {
+                "enabled": self.device_sched,
+                "waves_per_device": self._waves_per_device,
+                "preempt_quantum": self._preempt_quantum,
+                "mem_fraction": self._mem_fraction,
+                "mem_budget_bytes": self._mem_budget_bytes,
+                "waves_live": self._waves_live,
+                "est_free_mem_bytes": self._est_free_mem,
+            }
+            for k in _DEVSCHED_COUNTERS:
+                out["device_sched"][k] = self._counters[k]
             occ_samples = list(self._occ_samples)
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
@@ -968,9 +1052,10 @@ class Service:
             if entry.done.is_set():
                 return False
             if entry.in_flight:
-                if not self.refill:
+                if not (self.refill or self.device_sched):
                     return False
-                # refill mode: an in-flight request's lanes are freed
+                # refill/device-sched mode: an in-flight request's
+                # lanes are freed
                 # at the NEXT chunk boundary (flipped to t_stop=-inf —
                 # reclaimable capacity), where the boundary controller
                 # finishes it with Cancelled exactly once.  Best
@@ -1033,7 +1118,35 @@ class Service:
                 rec.end_trace(entry.trace, outcome,
                               retries=entry.retries)
 
+    # cimba-check: assume-held
+    def _adopt_sched_knobs(self, sched) -> None:
+        """Adopt a tuned schedule's device-scheduler policy knobs
+        (docs/24_device_scheduler.md) for every knob the constructor
+        left None — explicit constructor values always win, and the
+        first adopted value sticks (one service, one policy; a later
+        class resolving a different tuned schedule does not flap the
+        scheduler mid-flight).  Caller holds the service lock."""
+        if self._waves_per_device is None \
+                and sched.waves_per_device is not None:
+            self._waves_per_device = int(sched.waves_per_device)
+        if self._preempt_quantum is None \
+                and sched.preempt_quantum is not None:
+            self._preempt_quantum = int(sched.preempt_quantum)
+        if self._mem_fraction is None \
+                and sched.mem_fraction is not None:
+            self._mem_fraction = float(sched.mem_fraction)
+
     def _loop(self) -> None:
+        if self.device_sched:
+            # the preemptive device scheduler
+            # (docs/24_device_scheduler.md) owns this thread: concurrent
+            # refill waves, memory-aware admission, checkpoint-evict-
+            # restore preemption.  Off, everything below is the
+            # historical loop, byte for byte.
+            from cimba_tpu.serve.device import DeviceScheduler
+
+            DeviceScheduler(self).run()
+            return
         while True:
             if self._tel is not None:
                 # liveness: the dispatcher beats at least once per

@@ -76,7 +76,10 @@ XLA_MIN_S_ENV = "CIMBA_PROGRAM_STORE_XLA_MIN_S"
 
 #: manifest format version: bump on any layout/semantic change — old
 #: stores then invalidate loudly instead of deserializing garbage.
-FORMAT = 1
+#: 2: PR 17 added per-program ``footprint_bytes`` (the device
+#: scheduler's memory-aware admission reads it off hydrated programs
+#: without re-lowering, docs/24_device_scheduler.md).
+FORMAT = 2
 
 MANIFEST = "manifest.json"
 ARTIFACT_DIR = "artifacts"
@@ -513,15 +516,20 @@ class _HydratedProgram:
     e.g. the preflight's ``eval_shape`` — fall back to the wrapped
     ``jax.jit`` program, which mechanism (a) softens to a disk hit."""
 
-    __slots__ = ("_jit", "_table", "_store", "_role", "_fallback_seen")
+    __slots__ = ("_jit", "_table", "_store", "_role", "_fallback_seen",
+                 "_footprints")
 
     def __init__(self, jit_fn, table: dict, store: "ProgramStore",
-                 role: str):
+                 role: str, footprints: Optional[dict] = None):
         self._jit = jit_fn
         self._table = table
         self._store = store
         self._role = role
         self._fallback_seen: set = set()
+        # per-shape measured device footprint (bytes), from the
+        # manifest's ``footprint_bytes`` — the memory-aware admission
+        # input that needs no re-lowering (docs/24_device_scheduler.md)
+        self._footprints: dict = footprints or {}
 
     def __call__(self, *args):
         import jax
@@ -560,6 +568,13 @@ class _HydratedProgram:
 
     def lower(self, *args, **kwargs):
         return self._jit.lower(*args, **kwargs)
+
+    def footprint_for(self, *args) -> Optional[int]:
+        """The store-measured device footprint (bytes) of this
+        program at the given arg shapes, or None when the manifest
+        carries none for that shape (``cache.wave_footprint_bytes``
+        then falls through to its next rung)."""
+        return self._footprints.get(_args_sig_digest(args))
 
 
 class ProgramStore:
@@ -937,6 +952,20 @@ class ProgramStore:
                 "bytes": len(blob),
                 "compile_s": compile_s,
             }
+            # measured device footprint, where the backend implements
+            # memory_analysis() — hydrated admission reads it instead
+            # of re-lowering (docs/24_device_scheduler.md); absent on
+            # backends without the API (estimate rung covers them)
+            try:
+                from cimba_tpu.serve import cache as _pcache
+
+                fp = _pcache._memory_analysis_bytes(
+                    compiled.memory_analysis()
+                )
+            except Exception:
+                fp = None
+            if fp is not None:
+                rec["footprint_bytes"] = int(fp)
             if path is not None:
                 rec["path"] = path
             programs.append(rec)
@@ -1092,6 +1121,7 @@ class ProgramStore:
             self._count("invalidated")
             return None
         tables: dict = {"init": {}, "chunk": {}}
+        footprints: dict = {"init": {}, "chunk": {}}
         folds: dict = {}
         for rec in entry.get("programs", []):
             path = os.path.join(self.root, ARTIFACT_DIR, rec["file"])
@@ -1116,6 +1146,10 @@ class ProgramStore:
                 folds[(rec.get("path"), rec["shape"])] = loaded
             else:
                 tables.setdefault(rec["role"], {})[rec["shape"]] = loaded
+                if rec.get("footprint_bytes") is not None:
+                    footprints.setdefault(rec["role"], {})[
+                        rec["shape"]
+                    ] = int(rec["footprint_bytes"])
         if not tables["init"] and not tables["chunk"]:
             self._count("misses")
             return None
@@ -1123,8 +1157,10 @@ class ProgramStore:
         init_j = ex._init_program(spec, mesh)
         chunk_j = ex._chunk_program(spec, None, pack, chunk_steps, mesh)
         return HydratedPrograms(
-            _HydratedProgram(init_j, tables["init"], self, "init"),
-            _HydratedProgram(chunk_j, tables["chunk"], self, "chunk"),
+            _HydratedProgram(init_j, tables["init"], self, "init",
+                             footprints["init"]),
+            _HydratedProgram(chunk_j, tables["chunk"], self, "chunk",
+                             footprints["chunk"]),
             folds,
         )
 

@@ -322,7 +322,9 @@ class ResolvedEntry:
             "wave_size": self.wave_size,
         }
         if self.schedule is not None:
-            for f in ("eventset_hier", "eventset_block", "lane_block"):
+            for f in ("eventset_hier", "eventset_block", "lane_block",
+                      "waves_per_device", "preempt_quantum",
+                      "mem_fraction"):
                 v = getattr(self.schedule, f)
                 if v is not None:
                     knobs[f] = v
@@ -385,6 +387,17 @@ def resolve_entry(
             applied["eventset_block"] = int(sched.eventset_block)
         if sched.lane_block is not None:
             applied["lane_block"] = int(sched.lane_block)
+        # device-scheduler policy knobs (docs/24_device_scheduler.md):
+        # service-level, not per-request — serve.Service adopts them
+        # at submit time when its own constructor knobs were left
+        # None (Service._adopt_sched_knobs); they count as applied so
+        # the resolution source stays truthful
+        if sched.waves_per_device is not None:
+            applied["waves_per_device"] = int(sched.waves_per_device)
+        if sched.preempt_quantum is not None:
+            applied["preempt_quantum"] = int(sched.preempt_quantum)
+        if sched.mem_fraction is not None:
+            applied["mem_fraction"] = float(sched.mem_fraction)
     if source == "tuned" and not applied:
         # a tuned entry existed but every one of its knobs lost to an
         # explicit kwarg/ambient override — the run is the caller's

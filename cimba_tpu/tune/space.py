@@ -43,14 +43,25 @@ __all__ = ["Schedule", "ScheduleSpace", "default_space"]
 
 #: schema version of the persisted schedule record — bump on field
 #: changes so stale tuned entries invalidate loudly instead of
-#: resolving garbage knobs
-SCHEDULE_FORMAT = 1
+#: resolving garbage knobs.  2: PR 17 added the device-scheduler knobs
+#: (``waves_per_device`` / ``preempt_quantum`` / ``mem_fraction``).
+SCHEDULE_FORMAT = 2
 
 #: the knob fields, in canonical order (the JSON/digest field set)
 _FIELDS = (
     "eventset_hier", "eventset_block", "pack",
     "chunk_steps", "wave_size", "lane_block",
+    "waves_per_device", "preempt_quantum", "mem_fraction",
 )
+
+#: device-scheduler knob defaults (docs/24_device_scheduler.md) — ONE
+#: definition: ``serve.Service`` resolves its ``None`` constructor
+#: values against these, and :meth:`Schedule.canonical` collapses
+#: explicit settings equal to them (an arm binding the default is the
+#: default arm — prune, don't measure)
+DEFAULT_WAVES_PER_DEVICE = 2
+DEFAULT_PREEMPT_QUANTUM = 8
+DEFAULT_MEM_FRACTION = 0.8
 
 #: schedule fields that change the *geometry* of a run (wave partition
 #: / chunk boundaries) rather than the traced step program — the
@@ -84,6 +95,15 @@ class Schedule:
     chunk_steps: Optional[int] = None
     wave_size: Optional[int] = None
     lane_block: Optional[int] = None
+    # device-scheduler policy knobs (docs/24_device_scheduler.md):
+    # concurrent waves per device, the preemption quantum (chunks
+    # between preemption points), and the device-memory admission
+    # fraction.  Host-side dispatch policy only — results are bitwise
+    # whatever these bind — consumed by serve.Service when its own
+    # constructor knobs are left None.
+    waves_per_device: Optional[int] = None
+    preempt_quantum: Optional[int] = None
+    mem_fraction: Optional[float] = None
 
     def knobs(self) -> dict:
         """The non-default fields only (what this schedule binds)."""
@@ -203,9 +223,24 @@ class Schedule:
             # program
             if cap < 2 * eff_block:
                 hier, block = None, None
+        # device-scheduler knobs: an arm binding the stock default IS
+        # the default arm (host-side policy; never traced)
+        wpd, quantum, memf = (
+            self.waves_per_device, self.preempt_quantum,
+            self.mem_fraction,
+        )
+        if wpd is not None and int(wpd) == DEFAULT_WAVES_PER_DEVICE:
+            wpd = None
+        if quantum is not None and (
+            int(quantum) == DEFAULT_PREEMPT_QUANTUM
+        ):
+            quantum = None
+        if memf is not None and float(memf) == DEFAULT_MEM_FRACTION:
+            memf = None
         return dataclasses.replace(
             self, eventset_hier=hier, eventset_block=block,
-            pack=pack, chunk_steps=chunk,
+            pack=pack, chunk_steps=chunk, waves_per_device=wpd,
+            preempt_quantum=quantum, mem_fraction=memf,
         )
 
     # -- persistence ---------------------------------------------------------
@@ -229,6 +264,8 @@ class Schedule:
             if v is not None:
                 if f in ("eventset_hier", "pack"):
                     v = bool(v)
+                elif f == "mem_fraction":
+                    v = float(v)
                 else:
                     v = int(v)
             kw[f] = v
@@ -264,6 +301,9 @@ class ScheduleSpace:
     chunk_steps: Tuple = ()
     wave_size: Tuple = ()
     lane_block: Tuple = ()
+    waves_per_device: Tuple = ()
+    preempt_quantum: Tuple = ()
+    mem_fraction: Tuple = ()
 
     def axes(self) -> dict:
         """The non-empty axes, name -> value tuple."""
@@ -308,7 +348,9 @@ class ScheduleSpace:
         return out
 
 
-def default_space(spec=None, *, kernel: bool = False) -> ScheduleSpace:
+def default_space(
+    spec=None, *, kernel: bool = False, device_sched: bool = False,
+) -> ScheduleSpace:
     """The stock search space over the dispatch knobs of
     docs/11_dispatch_cost.md: hierarchical event-set on/off with a
     pow2 block grid, packed carry on/off, and a small ``chunk_steps``
@@ -316,7 +358,11 @@ def default_space(spec=None, *, kernel: bool = False) -> ScheduleSpace:
     searched by default (its pooled summary is merge-order-sensitive —
     opt in explicitly when counts-exact statistics are what you
     serve); ``lane_block`` joins only with ``kernel=True`` (the Pallas
-    path).  Axes that are structurally inert for ``spec`` cost nothing:
+    path); the device-scheduler policy knobs (``waves_per_device``,
+    ``preempt_quantum`` — docs/24_device_scheduler.md) join only with
+    ``device_sched=True``, since they are inert outside a
+    ``CIMBA_DEVICE_SCHED`` serve loop.  Axes that are structurally
+    inert for ``spec`` cost nothing:
     :meth:`ScheduleSpace.candidates` collapses them."""
     space = ScheduleSpace(
         eventset_hier=(True, False),
@@ -324,5 +370,7 @@ def default_space(spec=None, *, kernel: bool = False) -> ScheduleSpace:
         pack=(True, False),
         chunk_steps=(256, 1024, 4096),
         lane_block=(8, 16, 32) if kernel else (),
+        waves_per_device=(1, 2, 4) if device_sched else (),
+        preempt_quantum=(4, 8, 16) if device_sched else (),
     )
     return space

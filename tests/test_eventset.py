@@ -4,6 +4,7 @@ coverage: ordering contract, handles, cancel/reschedule, patterns)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cimba_tpu.core import eventset as ev
 
@@ -110,6 +111,7 @@ def test_works_under_jit_and_vmap():
     np.testing.assert_array_equal(np.asarray(kinds), [2, 2, 2, 2])
     np.testing.assert_allclose(np.asarray(times), [1.0, 2.0, 3.0, 4.0])
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_big_capacity_battery():
     """Large GENERAL table (cap=2048): ordering, handle ops and pop all
     behave at the scale a timer-heavy model would drive (models fill

@@ -131,6 +131,8 @@ def _run_arm(ops, cap, hier, block):
         )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (randomized soak; the f32 twin stays tier-1)
 def test_randomized_ops_match_flat_oracle_f64():
     ops = _op_program(seed=3, cap=16, n_ops=26)
     flat = _run_arm(ops, 16, hier=False, block=None)

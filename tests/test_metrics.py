@@ -32,6 +32,8 @@ def _run_mm1(R, n_objects, seed=1):
     return spec, sims
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (content_sane + run_report keep the pooled-registry contract tier-1)
 def test_pooled_counters_equal_per_lane_sum(obs_off):
     """pool() over vmapped registries == summing each lane's counters by
     hand; high-water gauges == the per-lane max; and the pooled
@@ -57,6 +59,7 @@ def test_pooled_counters_equal_per_lane_sum(obs_off):
     assert int(om.events_dispatched(pooled)) == int(jnp.sum(sims.n_events))
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_histogram_merge_order_independent(obs_off):
     """Pooling is a sum/max reduction — permuting the replication axis
     must not change any pooled value (the associative+commutative merge
@@ -88,6 +91,8 @@ def test_metrics_content_sane(obs_off):
     assert snap["event_hwm"] >= 1
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (every ci tests tier includes the 8dev mesh configuration)
 def test_sharded_experiment_pools_metrics_over_mesh(obs_off):
     """The ICI leg: with the registry enabled at build time,
     make_sharded_experiment returns a 4th element — the registry pooled

@@ -25,6 +25,8 @@ def test_hwseed_is_entropic():
     assert len({native.hwseed() for _ in range(8)}) == 8
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (at-scale soak; the replication-scale and fast-path oracle pins stay)
 def test_engine_matches_cpp_oracle_at_scale():
     """20k objects x 4 replications: the jitted batched engine and the
     sequential C++ engine must agree to float-accumulation precision
@@ -52,6 +54,8 @@ def test_engine_matches_cpp_oracle_at_scale():
         np.testing.assert_allclose(float(w.mn), ora["min"], rtol=1e-6)
         np.testing.assert_allclose(float(w.mx), ora["max"], rtol=1e-8)
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (at-scale soak; the c1-degenerates and replication-scale oracle pins stay)
 def test_mmc_engine_matches_cpp_oracle_at_scale():
     """M/M/c (c=3) toolkit path vs the sequential C++ oracle: guard FIFO
     wake order, no-jump-ahead fairness and the cascade signal must line up

@@ -190,6 +190,7 @@ def test_dataset_summarize_matches_fold():
     assert np.isclose(float(s.m4), m4)
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_acf_of_ar1():
     """AR(1) with phi=0.7: ACF(k) ~ 0.7^k, PACF cuts off after lag 1."""
     rng = np.random.default_rng(5)

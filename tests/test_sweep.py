@@ -193,6 +193,8 @@ def test_fixed_r_cells_bitwise_direct_stream(tiny, shared_cache):
         assert int(res.total_events[i]) == int(direct.total_events)
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (the f64 twin stays tier-1)
 def test_fixed_r_cells_bitwise_direct_stream_f32(tiny, shared_cache):
     """The accelerator profile arm of the acceptance pin (both dtype
     profiles).  A fresh spec: dtypes bind at trace time."""
@@ -328,6 +330,8 @@ def test_adaptive_max_rounds_reports_unmet(tiny, shared_cache):
 # --- serve-backed -----------------------------------------------------------
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (the ci.sh sweep smoke re-proves serve-backed cells bitwise on every pass)
 def test_serve_backed_sweep_bitwise_direct_engine(tiny, shared_cache):
     """The grid submitted as per-lane-seed/horizon serve requests
     (shared heterogeneous waves, PR 5 classes) returns per-cell

@@ -13,6 +13,8 @@ from cimba_tpu.runner import experiment as ex
 from cimba_tpu.stats import summary as sm
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (long-run statistics vs Jackson theory soak)
 def test_tandem_matches_jackson_theory():
     """Per-visit sojourns at both stations vs W_i = 1/(mu_i - lambda_i)
     with lambda_i = lambda/(1-p) (Jackson traffic equations), the

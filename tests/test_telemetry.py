@@ -550,6 +550,7 @@ def test_runner_and_sweep_telemetry_ticks(tiny, shared_cache, tmp_path):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_stats_hammer_scraper_vs_live_load(tiny, shared_cache):
     """A scraper thread polls ``Service.stats()`` + the rendered
     ``/metrics`` text as fast as it can while mixed traffic (different

@@ -10,6 +10,7 @@ assertion is sharp.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cimba_tpu.core import api, cmd, dyn
 from cimba_tpu.core import loop as cl
@@ -590,6 +591,8 @@ def test_pool_release_cascades_to_all_satisfiable_waiters():
     )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (long-run statistics vs Erlang-C theory soak)
 def test_mmc_matches_erlang_c():
     from cimba_tpu.models import mmc
     from cimba_tpu.runner import experiment as ex

@@ -93,6 +93,8 @@ def test_disabled_recorder_zero_op_jaxpr(obs_off):
     assert j_disabled == j_removed
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
+# (ring-independence soak; wrap/unwrapped ring pins stay tier-1)
 def test_vmap_rings_independent(obs_off):
     """One ring per replication: per-lane counts equal per-lane
     n_events, and different seeds record different trajectories."""
@@ -149,6 +151,7 @@ def test_chrome_export_acceptance(obs_off, tmp_path):
     )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_trace_str_and_sim_str(obs_off):
     """The golden-dump rendering: trace_str shows the ring in
     eventset_str's format, and sim_str includes it iff a ring is

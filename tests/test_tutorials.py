@@ -16,6 +16,7 @@ from examples import (  # noqa: E402
 )
 
 
+@pytest.mark.slow  # heavyweight: over the timed tier-1 budget; runs in tools/ci.sh cells
 def test_tut_1_mm1_matches_theory():
     mean, half = tut_1_mm1.main()
     assert mean > 0

@@ -379,6 +379,103 @@ print("refill smoke OK:", ref, "| occupancy_mean",
       round(occ["occupancy_mean"], 3), "| cache misses 0 after warm")
 EOF
 
+# preempt smoke (docs/24_device_scheduler.md): one wave slot, a
+# running low-priority background wave, an urgent foreign-class client
+# — the background is checkpoint-evicted at a quantum boundary, the
+# urgent class runs to completion FIRST, the background restores and
+# finishes bitwise its direct call, and the warmed round adds ZERO
+# program-cache misses (preempt/restore is pure dispatch)
+run_cell "preempt smoke" python - <<'EOF'
+import threading
+import numpy as np
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+# (label, R, seed, t_end, priority): horizon buckets (16.0) put the
+# 60.0 background and the 6.0 urgent in DIFFERENT compatibility
+# classes, so the urgent cannot splice — with one wave slot it must
+# preempt
+cases = [("bg", 4, 1, 60.0, 0), ("ur", 4, 9, 6.0, 10)]
+
+
+class _Gated(serve.Service):
+    """pack_gate holds the background wave until it is queued; started
+    flips at its first chunk boundary (the urgent then submits against
+    a RUNNING wave); release opens the boundaries."""
+
+    def __init__(self, **kw):
+        self.pack_gate = threading.Event()
+        self.started = threading.Event()
+        self.release = threading.Event()
+        super().__init__(**kw)
+
+    def _pack_refill(self, lead):
+        assert self.pack_gate.wait(600)
+        return super()._pack_refill(lead)
+
+    def _refill_boundary(self, wave, n, sims, final=False):
+        self.started.set()
+        assert self.release.wait(600)
+        return super()._refill_boundary(wave, n, sims, final=final)
+
+
+def round_():
+    svc = _Gated(max_wave=8, cache=cache, device_sched=True,
+                 waves_per_device=1, preempt_quantum=1, refill_every=1,
+                 horizon_bucket=16.0, pad_waves=False)
+    try:
+        label, R, seed, t_end, prio = cases[0]
+        bg = svc.submit(serve.Request(
+            spec, mm1.params(60), R, seed=seed, t_end=t_end,
+            wave_size=R, chunk_steps=16, priority=prio, label=label,
+        ))
+        svc.pack_gate.set()
+        assert svc.started.wait(600)
+        label, R, seed, t_end, prio = cases[1]
+        ur = svc.submit(serve.Request(
+            spec, mm1.params(60), R, seed=seed, t_end=t_end,
+            wave_size=R, chunk_steps=16, priority=prio, label=label,
+        ))
+        svc.release.set()
+        r_ur = ur.result(600)
+        bg_done = bg.done()
+        out = {"bg": bg.result(600), "ur": r_ur}
+        return out, svc.stats(), bg_done
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+
+
+round_()                                   # warm: compiles everything
+misses_warm = cache.stats()["misses"]
+out, stats, bg_done_at_urgent = round_()   # measured round
+assert cache.stats()["misses"] == misses_warm, (
+    "preempt round compiled after warm", cache.stats())
+assert not bg_done_at_urgent, "urgent did not run first"
+for label, R, seed, t_end, prio in cases:
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(60), R, wave_size=R, chunk_steps=16,
+        seed=seed, t_end=t_end, program_cache=cache,
+    )
+    res = out[label]
+    assert int(res.total_events) == int(direct.total_events), label
+    assert float(sm.mean(res.summary)) == float(
+        sm.mean(direct.summary)), label
+    assert float(res.summary.n) == float(direct.summary.n), label
+ds = stats["device_sched"]
+assert ds["preemptions"] >= 1 and ds["evictions"] >= 1, ds
+assert ds["restores"] >= 1, ds
+assert ds["sched_waves_started"] == 2, ds
+print("preempt smoke OK:", {k: ds[k] for k in (
+    "preemptions", "evictions", "restores", "sched_waves_started")},
+    "| cache misses 0 after warm | urgent finished first")
+EOF
+
 # sweep smoke: the many-scenario engine (docs/16_sweeps.md) — an easy
 # cell must provably stop >= 1 round before a hard cell under adaptive
 # stopping, and fixed-R engine cells must be BITWISE the direct

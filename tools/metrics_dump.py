@@ -157,6 +157,7 @@ def dump_fleet(slices, timeout: float) -> int:
         ("slice", 18), ("verdict", 12), ("queue", 6), ("outst", 6),
         ("waste", 6), ("hits", 6), ("fallbk", 7), ("done", 6),
         ("occ", 6), ("mocc", 6), ("free", 5), ("refill", 6),
+        ("wlive", 5), ("preempt", 7), ("restor", 6), ("freeMB", 7),
     )
     print("  ".join(f"{name:<{w}}" for name, w in cols))
     print("  ".join("-" * w for _, w in cols))
@@ -165,6 +166,8 @@ def dump_fleet(slices, timeout: float) -> int:
     outst_total = 0
     free_total = 0
     refill_on = 0
+    waves_total = 0
+    preempt_total = 0
     bad = 0
     for name, url in slices:
         rep = scrape_slice(url, timeout)
@@ -177,6 +180,8 @@ def dump_fleet(slices, timeout: float) -> int:
         if rep.get("refill_enabled"):
             refill_on += 1
             free_total += int(rep.get("free_lanes") or 0)
+        waves_total += int(rep.get("waves_live") or 0)
+        preempt_total += int(rep.get("preemptions") or 0)
 
         def fmt(key, pct=False):
             v = rep.get(key)
@@ -184,6 +189,9 @@ def dump_fleet(slices, timeout: float) -> int:
                 return "-"
             return f"{v:.1%}" if pct else f"{v:g}"
 
+        # estimated free device memory scrapes in bytes; the table
+        # shows MiB (a raw byte count wrecks the column layout)
+        free_mem = rep.get("est_free_mem")
         row = (
             name[:18], verdict, fmt("queue_depth"), fmt("outstanding"),
             fmt("padding_waste", pct=True), fmt("store_hits"),
@@ -193,6 +201,8 @@ def dump_fleet(slices, timeout: float) -> int:
             fmt("free_lanes"),
             ("on" if rep.get("refill_enabled")
              else "-" if rep.get("refill_enabled") is None else "off"),
+            fmt("waves_live"), fmt("preemptions"), fmt("restores"),
+            "-" if free_mem is None else f"{free_mem / (1 << 20):.0f}",
         )
         print("  ".join(
             f"{v:<{w}}" for v, (_, w) in zip(row, cols)
@@ -205,6 +215,7 @@ def dump_fleet(slices, timeout: float) -> int:
         + ", ".join(f"{k} {v}" for k, v in rollup.items() if v)
         + f"; queued {depth_total}, outstanding {outst_total}"
         + f"; refill on {refill_on}, free lanes {free_total}"
+        + f"; waves live {waves_total}, preemptions {preempt_total}"
     )
     if bad:
         print(f"UNHEALTHY: {bad} slice(s) down or unreachable")
