@@ -6,7 +6,7 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,tune,jobshop,awacs}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,tune,jobshop,awacs,compile_wall}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
@@ -3100,6 +3100,94 @@ def bench_tune():
     )
 
 
+def bench_compile_wall():
+    """BASELINE configs[+]: the compile wall (docs/25_compile_wall.md)
+    — AWACS chunk-program trace+lower+compile wall seconds and program
+    size across P (process-table height) for BOTH table-dispatch arms
+    (dense one-hot vs scan-over-rows), interleaved best-of-k through
+    ``tune.measure.measure_arms`` with the self-vs-self noise twin (the
+    PR 14 measurement contract).  Runs on the CPU container: the wall
+    being measured is XLA's, not the accelerator's — the Mosaic-AOT leg
+    of the same story is tracked in BENCH_NOTES (dense AWACS at Lb=1024
+    is compile-prohibitive, >25 min)."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu.models import awacs
+    from cimba_tpu.obs import program_size as _ps
+    from cimba_tpu.tune import measure as _tm
+
+    lanes = int(os.environ.get("CIMBA_BENCH_R", 4))
+    repeats = int(os.environ.get("CIMBA_BENCH_REPEATS", 2))
+    scales = tuple(
+        int(x) for x in os.environ.get(
+            "CIMBA_BENCH_COMPILE_WALL_P", "32,256,1001"
+        ).split(",")
+    )
+    max_steps = int(os.environ.get("CIMBA_BENCH_KERNEL_CHUNK", 64))
+    prof = _bench_profile()
+
+    def compile_once(spec, scan):
+        """One full trace+lower+compile of a FRESH chunk program under
+        the given table arm — fresh ``make_chunk`` closure per call so
+        neither the jit cache nor tracing memos can shortcut the wall
+        being measured."""
+        prev = (_cfg.TABLE_SCAN, _cfg.TABLE_SCAN_BLOCK)
+        _cfg.TABLE_SCAN = scan
+        try:
+            with _cfg.profile(prof):
+                sims = jax.eval_shape(
+                    jax.vmap(lambda r: cl.init_sim(spec, 2026, r, (1.0,))),
+                    jnp.arange(lanes),
+                )
+                fn = cl.make_chunk(spec, max_steps=max_steps)
+                jax.jit(fn).lower(sims).compile()
+        finally:
+            _cfg.TABLE_SCAN, _cfg.TABLE_SCAN_BLOCK = prev
+
+    for n_p in scales:
+        with _cfg.profile(prof):
+            spec, _ = awacs.build(n_p - 1)   # + the sensor process = P rows
+        sizes = {}
+        for name, scan in (("dense", False), ("scan", True)):
+            prev = (_cfg.TABLE_SCAN, _cfg.TABLE_SCAN_BLOCK)
+            _cfg.TABLE_SCAN = scan
+            try:
+                sizes[name] = _ps.chunk_program_size(
+                    spec, (1.0,), lanes=lanes, max_steps=max_steps,
+                    profile=prof,
+                ).to_dict()
+            finally:
+                _cfg.TABLE_SCAN, _cfg.TABLE_SCAN_BLOCK = prev
+        report = _tm.measure_arms(
+            [
+                _tm.Arm("dense", run=lambda spec=spec: compile_once(spec, False),
+                        program_size=sizes["dense"]),
+                _tm.Arm("scan", run=lambda spec=spec: compile_once(spec, True),
+                        program_size=sizes["scan"]),
+            ],
+            repeats=repeats, baseline=0, noise_twin=True,
+        )
+        dense_w = report.arm("dense").best_wall
+        scan_w = report.arm("scan").best_wall
+        _line(
+            "awacs_compile_wall_speedup",
+            dense_w / scan_w if dense_w and scan_w else None,
+            None,
+            {
+                "path": "xla_compile",
+                "profile": prof,
+                "n_processes": n_p,
+                "lanes": lanes,
+                "max_steps": max_steps,
+                "dense_wall_s": dense_w,
+                "scan_wall_s": scan_w,
+                "noise_floor_frac": report.noise_floor_frac,
+                "rounds": report.rounds_done,
+                "program_size": sizes,
+            },
+            unit="x",
+        )
+
+
 CONFIGS = {
     "mm1": bench_mm1,
     "mm1_stream": bench_mm1_stream,
@@ -3117,6 +3205,7 @@ CONFIGS = {
     "tune": bench_tune,
     "jobshop": bench_jobshop,
     "awacs": bench_awacs,
+    "compile_wall": bench_compile_wall,
 }
 
 

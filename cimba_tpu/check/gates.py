@@ -112,6 +112,21 @@ def _noop_state():
 
 
 @contextlib.contextmanager
+def _table_scan_state(scan: Optional[bool], block: Optional[int] = None):
+    from cimba_tpu import config
+
+    prev_s, prev_b = config.TABLE_SCAN, config.TABLE_SCAN_BLOCK
+    try:
+        config.TABLE_SCAN = scan
+        if block is not None:
+            config.TABLE_SCAN_BLOCK = block
+        yield
+    finally:
+        config.TABLE_SCAN = prev_s
+        config.TABLE_SCAN_BLOCK = prev_b
+
+
+@contextlib.contextmanager
 def _tune_state(on: bool):
     """The tune gate's arms: a resolved :class:`~cimba_tpu.tune.space.
     Schedule` binds through its ``scope()`` (the config tri-states) —
@@ -197,6 +212,25 @@ GATES: Tuple[Gate, ...] = (
         # structurally inert below the 2x-block capacity threshold —
         # which every shipped model is; the ON arm must therefore trace
         # the SAME program (that inertness is itself the pinned claim)
+        on_differs=False,
+    ),
+    Gate(
+        name="table_scan",
+        env=("CIMBA_TABLE_SCAN", "CIMBA_TABLE_SCAN_BLOCK"),
+        program="run",
+        off_ctx=lambda: _table_scan_state(False),
+        on_ctx=lambda: _table_scan_state(True),
+        off_env={"CIMBA_TABLE_SCAN": "0"},
+        # the scan-over-rows dispatch only engages on table axes
+        # STRICTLY taller than the block (docs/25_compile_wall.md) —
+        # every sweep-model axis is <= the default block, so the ON
+        # program must equal the OFF one (that small-P structural
+        # inertness is itself the pinned claim; knob liveness at tall-P
+        # is pinned in tests/test_table_scan.py where the model height
+        # is controlled).  The ambient arm rides the same inertness:
+        # the env knob DOES bind at trace time, but at sweep-model
+        # scale it must still trace the baseline program.
+        ambient_env={"CIMBA_TABLE_SCAN": "1"},
         on_differs=False,
     ),
     Gate(
@@ -453,6 +487,22 @@ def sweep(profiles=PROFILES, gates=None, model="mm1") -> Tuple[list, dict]:
                         fail(
                             f"env off-state {gate.off_env} does not "
                             "reproduce the explicit-off program"
+                        )
+                if gate.name == "table_scan":
+                    # second block size, still above every sweep-model
+                    # axis: "axes <= the block stay dense" must hold at
+                    # any block, not just the default
+                    ran.append("block-inert")
+                    blocked = build(
+                        profile, gate, "table_scan:block1024", {},
+                        lambda: _table_scan_state(True, 1024), {},
+                    )
+                    if blocked != off:
+                        fail(
+                            "CIMBA_TABLE_SCAN_BLOCK=1024 changed the "
+                            "traced program for a model whose every "
+                            "table axis fits one block (small-table "
+                            "structural inertness broken)"
                         )
                 if gate.name == "eventset_hier":
                     # block-size inertness below the capacity threshold

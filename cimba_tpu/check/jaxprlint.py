@@ -1,7 +1,7 @@
 """Program-level lints over traced jaxprs — static w.r.t. execution.
 
 These checks trace and lower real programs (``jax.make_jaxpr`` /
-``jit.lower``) but never compile or execute anything.  Three rules plus
+``jit.lower``) but never compile or execute anything.  Four rules plus
 the gate-registry sweep (:mod:`cimba_tpu.check.gates`):
 
 * **JXL001 — donation coverage.**  Every carry input of a
@@ -22,6 +22,14 @@ the gate-registry sweep (:mod:`cimba_tpu.check.gates`):
   exactly the dtype-profile memo-leak hazard behind the PR 1
   ``_DtypeHandle`` bug.  Verified over the init program's abstract
   output under both dtype profiles.
+* **JXL004 — program-size budget.**  The chunk program's total jaxpr
+  equation count must stay under the model's registered ceiling
+  (:data:`EQN_BUDGET`).  Program TEXT growth is the compile wall
+  (docs/25_compile_wall.md): a ceiling breach means something started
+  emitting per-row or per-step equations (a Python loop over processes,
+  an unrolled scan) — the class of regression that compiles fine at dev
+  scale and takes >25 minutes at AWACS scale.  Counted with the same
+  walker as ``cimba_tpu.obs.program_size``.
 
 Run by ``tools/check.py`` (skipped under ``--ast-only``) and tier-1's
 tests/test_check.py.
@@ -35,9 +43,9 @@ from typing import Dict, List, Optional, Tuple
 from cimba_tpu.check import Finding
 
 __all__ = [
-    "BANNED_PRIMITIVES", "GATHER_BUDGET",
+    "BANNED_PRIMITIVES", "GATHER_BUDGET", "EQN_BUDGET",
     "donation_findings", "purity_findings", "weak_type_findings",
-    "check_programs", "collect_primitives",
+    "size_findings", "check_programs", "collect_primitives",
 ]
 
 #: primitives that must never appear in a chunk program (host
@@ -52,6 +60,14 @@ BANNED_PRIMITIVES = frozenset({
 #: shipped model compiles to zero — raise a model's budget here ONLY
 #: with a comment justifying the access pattern
 GATHER_BUDGET: Dict[str, int] = {}
+
+#: per-model chunk-program equation ceiling for JXL004.  Calibrated
+#: ~1.3x over the measured default-knob counts (mm1 8675, awacs 4191
+#: dense / 4475 scan-on, both profiles within a few eqns) so dtype
+#: profiles and the table-scan arm fit, but a per-row unroll (which
+#: multiplies the count by table height) cannot.  Raise only with a
+#: program_size measurement justifying the new floor.
+EQN_BUDGET: Dict[str, int] = {"mm1": 11000, "awacs": 6000}
 
 _ALIAS_MARKER = re.compile(r"tf\.aliasing_output")
 
@@ -156,6 +172,30 @@ def purity_findings(
     return out
 
 
+def size_findings(
+    eqns: int, label: str, budget: Optional[int],
+) -> List[Finding]:
+    """JXL004 for one traced program: total equation count (recursive —
+    count with the :func:`collect_primitives` walk or
+    ``obs.program_size``) under the model's ceiling."""
+    if budget is None:
+        return []
+    n = int(eqns)
+    if n > budget:
+        return [Finding(
+            rule="JXL004", path=f"program:{label}", line=0,
+            message=(
+                f"chunk program has {n} jaxpr equations (budget "
+                f"{budget}) — program text growth is the compile wall "
+                "(docs/25_compile_wall.md); look for a Python loop "
+                "over table rows or an unrolled scan, or raise "
+                "check.jaxprlint.EQN_BUDGET with a program_size "
+                "measurement justifying the new floor"
+            ),
+        )]
+    return []
+
+
 def weak_type_findings(tree, label: str) -> List[Finding]:
     """JXL003 over a pytree of (abstract or concrete) carry values."""
     import jax
@@ -209,10 +249,33 @@ def check_programs(
         findings.extend(purity_findings(
             jaxpr, label, GATHER_BUDGET.get("mm1", 0)
         ))
+        findings.extend(size_findings(
+            sum(collect_primitives(jaxpr).values()), label,
+            EQN_BUDGET.get("mm1"),
+        ))
         findings.extend(weak_type_findings(sims, label))
         report["programs"][label] = {
             "carry_leaves": len(jax.tree_util.tree_leaves(sims)),
-            "checks": ["JXL001", "JXL002", "JXL003"],
+            "checks": ["JXL001", "JXL002", "JXL003", "JXL004"],
+        }
+        # JXL004 additionally covers the model whose table height IS
+        # the compile wall (awacs: [P, ...] tables); trace-only, small
+        # P — the eqn count is P-independent unless something unrolls
+        with config.profile(profile):
+            from cimba_tpu.models import awacs as _awacs
+
+            a_spec, _ = _awacs.build(16)
+            a_label = f"awacs/{profile}"
+            from cimba_tpu.obs import program_size as _ps
+
+            a_size = _ps.chunk_program_size(
+                a_spec, _awacs.params(2.0), profile=None, lower=False,
+            )
+        findings.extend(size_findings(
+            a_size.eqns, a_label, EQN_BUDGET.get("awacs"),
+        ))
+        report["programs"][a_label] = {
+            "eqns": a_size.eqns, "checks": ["JXL004"],
         }
     if with_gates:
         from cimba_tpu.check import gates as _gates

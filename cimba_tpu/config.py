@@ -183,6 +183,24 @@ ENV_KNOBS = {
             "inertness), and the refill/liveness programs are separate "
             "compiles keyed by the same compatibility class",
     ),
+    "CIMBA_TABLE_SCAN": dict(
+        default="", trace_gate=True,
+        doc="scan-over-rows process-table dispatch (core/dyn.py, "
+            "docs/25_compile_wall.md): =1 replaces the dense one-hot "
+            "expand/select over full [P, ...] component tables with a "
+            "counted loop over fixed-size row blocks, so emitted "
+            "program text references one block regardless of P.  Off "
+            "(the default) is jaxpr character-identical to the dense "
+            "dispatch; on is bitwise result-identical (same one-hot "
+            "pick within the owning block).  Only engages on axes "
+            "strictly taller than the block size — structurally inert "
+            "for small-P models",
+    ),
+    "CIMBA_TABLE_SCAN_BLOCK": dict(
+        default="128", trace_gate=True,
+        doc="row-block height for the scan-over-rows table dispatch "
+            "(sublane-friendly multiple; axes <= the block stay dense)",
+    ),
     "CIMBA_DEVICE_SCHED": dict(
         default="", trace_gate=True,
         doc="preemptive device scheduler "
@@ -333,6 +351,30 @@ EVENTSET_BLOCK = None
 #: per-leaf on CPU (today's jaxpr).  ``CIMBA_XLA_PACK=0`` / ``False``
 #: always reproduces the current per-leaf jaxpr bitwise.
 XLA_PACK = None
+
+
+#: Scan-over-rows table dispatch (core/dyn.py).  ``None`` ->
+#: ``CIMBA_TABLE_SCAN`` (default off — dense one-hot dispatch, today's
+#: jaxpr character-identical); ``True`` blocks every table access whose
+#: row axis is taller than :func:`table_scan_block`.
+TABLE_SCAN = None
+
+#: Row-block height for the scan-over-rows dispatch.  ``None`` ->
+#: ``CIMBA_TABLE_SCAN_BLOCK`` (default 128).
+TABLE_SCAN_BLOCK = None
+
+
+def table_scan_enabled() -> bool:
+    if TABLE_SCAN is not None:
+        return bool(TABLE_SCAN)
+    raw = env_raw("CIMBA_TABLE_SCAN").strip()
+    return bool(raw) and raw != "0"
+
+
+def table_scan_block() -> int:
+    if TABLE_SCAN_BLOCK is not None:
+        return int(TABLE_SCAN_BLOCK)
+    return int(env_raw("CIMBA_TABLE_SCAN_BLOCK"))
 
 
 def eventset_hier_enabled() -> bool:

@@ -9,10 +9,11 @@ feeding selects are the one i1 pattern Mosaic handles everywhere.
 
 What: re-interpret a jaxpr with every bool value carried as int32 (0/1):
 
-* comparisons (`eq/ne/lt/...`, `is_finite`) bind natively and stay i1
-  until a consumer needs the carrier (lazy pair, see eval_bool32 —
-  select preds consume the i1 directly, saving a widen+re-compare round
-  trip per comparison);
+* comparisons (`eq/ne/lt/...`) bind natively and stay i1 until a
+  consumer needs the carrier (lazy pair, see eval_bool32 — select preds
+  consume the i1 directly, saving a widen+re-compare round trip per
+  comparison); `is_finite` is rewritten to `x - x == 0` (Mosaic has no
+  is_finite lowering);
 * `and/or/xor/not` on bools become bitwise ops on the i32 carriers;
 * `select_n` with a bool pred re-derives the pred as ``carrier != 0``
   (comparison-born, full shape) and selects over carriers;
@@ -45,10 +46,14 @@ from jax._src import core as jcore
 _I32 = jnp.int32
 
 _LOGIC = {"and": lax.bitwise_and, "or": lax.bitwise_or, "xor": lax.bitwise_xor}
-_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge", "is_finite"}
+_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge"}
 _STRUCTURAL = {
     "broadcast_in_dim", "reshape", "transpose", "slice", "squeeze",
     "concatenate", "rev", "expand_dims",
+    # block slice/write-back of the scan-over-rows table dispatch
+    # (core/dyn.py): starts are non-bool scalars and pass through; the
+    # bool operand/update ride as i32 carriers like any other reshape
+    "dynamic_slice", "dynamic_update_slice",
 }
 
 
@@ -82,12 +87,34 @@ def _to_carrier(x):
     return jnp.asarray(np.asarray(x, np.int32))
 
 
+def _canon_literal(val):
+    """64-bit scalar literals survive from an x64-on source trace; when
+    x64 is off at re-bind time, pass their 32-bit counterparts instead
+    (Mosaic's ir_constant switches on the literal VALUE's dtype, and it
+    has no 64-bit constants).  Out-of-range values would be a real
+    program difference, so they raise rather than wrap."""
+    import numpy as np
+
+    if jax.config.jax_enable_x64:
+        return val
+    a = np.asarray(val)
+    tgt = {"int64": np.int32, "uint64": np.uint32,
+           "float64": np.float32}.get(a.dtype.name)
+    if tgt is None:
+        return val
+    out = a.astype(tgt)
+    if a.dtype.kind in "iu" and out != a:
+        raise OverflowError(
+            f"64-bit literal {a} does not fit {np.dtype(tgt).name}")
+    return out
+
+
 def _read(env, v):
     if isinstance(v, jcore.Literal):
         val = v.val
         if _is_bool(v.aval):
             return _to_carrier(val)
-        return val
+        return _canon_literal(val)
     return env[v]
 
 
@@ -175,6 +202,13 @@ def eval_bool32(jaxpr, consts, *args):
             outs = eqn.primitive.bind(*carriers(eqn, ins), **eqn.params)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
             write(eqn, [_B(i1=o) for o in outs])
+        elif prim == "is_finite":
+            # Mosaic has no is_finite lowering (AWACS's eventset
+            # liveness hits it); x - x == 0 is the same i1 — NaN and
+            # +-Inf both subtract to NaN — built from prims it lowers
+            (x,) = carriers(eqn, ins)
+            d = lax.sub(x, x)
+            write(eqn, [_B(i1=lax.eq(d, jnp.zeros_like(d)))])
         elif prim == "select_n" and in_bool[0]:
             pred = ins[0].pred()
             cases = carriers(eqn, ins[1:])
@@ -193,20 +227,18 @@ def eval_bool32(jaxpr, consts, *args):
                 write(eqn, [eqn.primitive.bind(*ins, **eqn.params)])
         elif prim in ("reduce_or", "reduce_and") and in_bool[0]:
             # bind the reduction primitive directly: older jax has no
-            # lax.reduce_max/reduce_min function wrappers
+            # lax.reduce_max/reduce_min function wrappers.  Reduce in
+            # f32: Mosaic has no integer-reduction lowering (the
+            # eventset liveness any() hits it) and the carrier is
+            # exactly 0/1, so the float round-trip is lossless
             red_p = (
                 lax.reduce_max_p if prim == "reduce_or" else lax.reduce_min_p
             )
-            write(
-                eqn,
-                [
-                    _B(
-                        c32=red_p.bind(
-                            ins[0].carrier(), axes=eqn.params["axes"]
-                        )
-                    )
-                ],
+            red = red_p.bind(
+                ins[0].carrier().astype(jnp.float32),
+                axes=eqn.params["axes"],
             )
+            write(eqn, [_B(i1=lax.ne(red, jnp.zeros_like(red)))])
         elif prim == "while":
             write(eqn, _bind_while(eqn, carriers(eqn, ins), out_bool))
         elif prim == "cond":
@@ -223,6 +255,11 @@ def eval_bool32(jaxpr, consts, *args):
                 eqn,
                 [_B(c32=o) if b else o for o, b in zip(outs, out_bool)],
             )
+        elif prim == "device_put":
+            # staged by jnp.asarray/jnp.array around constants; device
+            # placement is meaningless inside the kernel (Mosaic has no
+            # lowering for it) — the value passes through unchanged
+            write(eqn, list(ins))
         elif prim in _STRUCTURAL and in_bool[0]:
             # structural ops act on the i32 carrier directly — binding on
             # a materialized i1 would re-emit the i1 broadcasts this

@@ -267,16 +267,245 @@ def _reduce_pick(mask, arr):
                    axis=tuple(range(k)), dtype=arr.dtype)
 
 
+# --- scan-over-rows table dispatch (docs/25_compile_wall.md) ----------------
+#
+# Dense one-hot dispatch materializes every access as full-table-width
+# ops.  That is the right trade for event slots and guard tables, but it
+# puts the process-table height P into every access's program text, and
+# on the kernel path Mosaic tile-unrolls those ``[P, Lb]`` vector ops —
+# AWACS (P=1001) is compile-prohibitive at the lane-block grid
+# (BENCH_NOTES round 5).  With ``CIMBA_TABLE_SCAN`` on, accesses to axes
+# strictly taller than ``CIMBA_TABLE_SCAN_BLOCK`` run a counted loop over
+# fixed-size row blocks instead: dynamic-slice one block (the loop
+# counter is unbatched, so vmap emits a slice, never a gather), apply the
+# SAME one-hot pick/write within the owning block, write the block back.
+# Emitted program text then references one ``[B, ...]`` block regardless
+# of table height, and results stay bitwise identical: reads accumulate
+# the same zeros the dense sum adds, writes put back non-matching rows
+# unchanged, and the block-ownership predicate keeps the clamped tail
+# block's overlap write-once while preserving the out-of-range no-op.
+# (One documented exception: blocked ``dadd``/``dadd2`` can wash a
+# ``-0.0`` result to ``+0.0`` in the tail block's overlap rows — only
+# when the added value is itself a signed zero.)
+#
+# The loop rides :func:`kfori`, so the XLA path lowers it as a scan and
+# the mega-kernel keeps the scalar-counter while form Mosaic needs.
+
+
+def _blk(n: int):
+    """``(block, n_blocks)`` when the scan engages on an axis of height
+    ``n`` (knob on AND the axis strictly taller than the block), else
+    ``None`` — small tables stay dense, which is both the perf answer
+    and the small-P structural-inertness contract."""
+    if n <= 1 or not config.table_scan_enabled():
+        return None
+    B = config.table_scan_block()
+    if n <= B:
+        return None
+    return B, -(-n // B)
+
+
+def _blk2(n0: int, n1: int):
+    """``(axis, block, n_blocks)`` for a 2-D table, blocking the taller
+    engaging axis (the engine's 2-D tables are ``[components, slots]``,
+    so axis 1 is the one that scales), else ``None``."""
+    for ax, n in ((1, n1), (0, n0)):
+        b = _blk(n)
+        if b is not None:
+            return (ax,) + b
+    return None
+
+
+def _blk_start(k, B: int, n: int):
+    """Unbatched i32 start row of block ``k``, clamped so the tail block
+    stays in range when ``B`` does not divide ``n`` (the resulting
+    overlap is kept write-once by :func:`_blk_own`)."""
+    return jnp.minimum(k * jnp.asarray(B, _I32), jnp.asarray(n - B, _I32))
+
+
+def _blk_own(i, k, B: int, start):
+    """Within-block index of row ``i`` under block ``k``'s ownership;
+    ``-1`` (no one-hot match) when block ``k`` does not own row ``i``.
+    Ownership is ``i div B == k`` with truncating division: every
+    out-of-range or gated-off index (the ``-1`` sentinel included) owns
+    no block, reproducing the dense helpers' no-op semantics."""
+    i = jnp.asarray(i, _I32)
+    own = lax.div(i, jnp.asarray(B, _I32)) == k
+    return jnp.where(own, i - start, jnp.asarray(-1, _I32))
+
+
+def _acc_pick(acc, mask, blk):
+    """Accumulate one block's one-hot pick into ``acc`` (OR for bool —
+    any() keeps bool — and the dense sum's add for everything else)."""
+    r = _reduce_pick(mask, blk)
+    return acc | r if blk.dtype == jnp.bool_ else acc + r
+
+
+def _scan_get1(arrs, i):
+    """Blocked ``dget`` over several same-height tables at ONE shared
+    index: a single block loop slices each table once per block and
+    applies one shared within-block one-hot."""
+    n = arrs[0].shape[0]
+    B, nb = _blk(n)
+    accs = tuple(jnp.zeros(a.shape[1:], a.dtype) for a in arrs)
+
+    def body(k, accs):
+        start = _blk_start(k, B, n)
+        m = _oh1(B, _blk_own(i, k, B, start))
+        return tuple(
+            _acc_pick(acc, m, lax.dynamic_slice_in_dim(a, start, B, 0))
+            for a, acc in zip(arrs, accs)
+        )
+
+    return list(kfori(0, nb, body, accs))
+
+
+def _scan_set1(arrs, i, vals, pred=True, add=False):
+    """Blocked ``dset``/``dadd`` over several same-height tables at ONE
+    shared (gated) index.  The gate always folds into the index here —
+    a blocked axis is wide by construction, and ``-1`` owns no block."""
+    n = arrs[0].shape[0]
+    B, nb = _blk(n)
+    if pred is not True:
+        i = _gate_idx(i, pred)
+
+    def body(k, arrs_k):
+        start = _blk_start(k, B, n)
+        m = _oh1(B, _blk_own(i, k, B, start))
+        outs = []
+        for a, v in zip(arrs_k, vals):
+            blk = lax.dynamic_slice_in_dim(a, start, B, 0)
+            if add:
+                me = _expand_mask(m, blk.shape, blk.ndim - 1)
+                v = jnp.asarray(v, a.dtype)
+                blk = blk + jnp.where(me, v, jnp.zeros((), a.dtype))
+            else:
+                blk = _masked_write(blk, m, v, True)
+            outs.append(lax.dynamic_update_slice_in_dim(a, blk, start, 0))
+        return tuple(outs)
+
+    return list(kfori(0, nb, body, tuple(arrs)))
+
+
+def _blk_oh2(n0: int, n1: int, i0, i1, ax: int, B: int, k, start):
+    """Within-block 2-D one-hot for block ``k`` of the blocked axis."""
+    if ax == 0:
+        return _oh2(B, n1, _blk_own(i0, k, B, start), i1)
+    return _oh2(n0, B, i0, _blk_own(i1, k, B, start))
+
+
+def _scan_get2(arr, i0, i1, ax: int, B: int, nb: int):
+    n = arr.shape[ax]
+    acc0 = jnp.zeros(arr.shape[2:], arr.dtype)
+
+    def body(k, acc):
+        start = _blk_start(k, B, n)
+        m = _blk_oh2(arr.shape[0], arr.shape[1], i0, i1, ax, B, k, start)
+        return _acc_pick(acc, m, lax.dynamic_slice_in_dim(arr, start, B, ax))
+
+    return kfori(0, nb, body, acc0)
+
+
+def _scan_set2(arr, i0, i1, v, pred, ax: int, B: int, nb: int, add=False):
+    n = arr.shape[ax]
+    if pred is not True:
+        if ax == 0:
+            i0 = _gate_idx(i0, pred)
+        else:
+            i1 = _gate_idx(i1, pred)
+
+    def body(k, a):
+        start = _blk_start(k, B, n)
+        m = _blk_oh2(arr.shape[0], arr.shape[1], i0, i1, ax, B, k, start)
+        blk = lax.dynamic_slice_in_dim(a, start, B, ax)
+        if add:
+            me = _expand_mask(m, blk.shape, blk.ndim - 2)
+            vv = jnp.asarray(v, a.dtype)
+            blk = blk + jnp.where(me, vv, jnp.zeros((), a.dtype))
+        else:
+            blk = _masked_write(blk, m, v, True)
+        return lax.dynamic_update_slice_in_dim(a, blk, start, ax)
+
+    return kfori(0, nb, body, arr)
+
+
+def _scan_exchange2(arr, i0, i1, v, do_write, pred, ax: int, B: int, nb: int):
+    n = arr.shape[ax]
+    if pred is not True:
+        if ax == 0:
+            i0 = _gate_idx(i0, pred)
+        else:
+            i1 = _gate_idx(i1, pred)
+    v = jnp.asarray(v, arr.dtype)
+
+    def body(k, carry):
+        item, a = carry
+        start = _blk_start(k, B, n)
+        m = _blk_oh2(arr.shape[0], arr.shape[1], i0, i1, ax, B, k, start)
+        blk = lax.dynamic_slice_in_dim(a, start, B, ax)
+        it = _reduce_pick(m, blk)
+        # the target row lives in exactly one block, so the owning
+        # block's pick IS the full read and non-owning blocks write
+        # back their rows bitwise-unchanged
+        wv = jnp.where(do_write, v, it)
+        blk = _masked_write(blk, m, wv, True)
+        a = lax.dynamic_update_slice_in_dim(a, blk, start, ax)
+        item = item | it if arr.dtype == jnp.bool_ else item + it
+        return item, a
+
+    item0 = jnp.zeros(arr.shape[2:], arr.dtype)
+    return kfori(0, nb, body, (item0, arr))
+
+
 def dget(arr, i):
     """``arr[i]`` (scalar if arr is 1-D, row if 2-D+) for a traced index."""
     if arr.shape[0] == 1:
         # single-member component table: the read is the row itself
         return lax.reshape(arr, arr.shape[1:])
+    if _blk(arr.shape[0]) is not None:
+        return _scan_get1([arr], i)[0]
     return _reduce_pick(_oh1(arr.shape[0], i), arr)
+
+
+def dget_tree(tree, i):
+    """:func:`dget` over every leaf of ``tree`` at ONE shared index.
+
+    Dense mode is exactly ``jax.tree.map(lambda a: dget(a, i), tree)``
+    (jaxpr character-identical to the historical per-leaf calls); scan
+    mode serves every leaf from a single block loop — the grouped form
+    is what keeps the blocked program's eqn count near the dense one's
+    at the many-fields-one-pid dispatcher sites."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    if (leaves and all(a.shape[0] == leaves[0].shape[0] for a in leaves)
+            and leaves[0].shape[0] > 1 and _blk(leaves[0].shape[0]) is not None):
+        outs = iter(_scan_get1(leaves, i))
+        return jax.tree.map(lambda _: next(outs), tree)
+    return jax.tree.map(lambda a: dget(a, i), tree)
+
+
+def dset_tree(tree, i, vals, pred=True):
+    """:func:`dset` over every leaf of ``tree`` at ONE shared gated
+    index (``vals`` is a matching tree of written values).  Dense mode
+    is exactly the per-leaf ``dset`` tree-map; scan mode shares one
+    block loop across the leaves (see :func:`dget_tree`)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    if (leaves and all(a.shape[0] == leaves[0].shape[0] for a in leaves)
+            and leaves[0].shape[0] > 1 and _blk(leaves[0].shape[0]) is not None):
+        vleaves = jax.tree.leaves(vals)
+        outs = iter(_scan_set1(leaves, i, vleaves, pred))
+        return jax.tree.map(lambda _: next(outs), tree)
+    return jax.tree.map(lambda a, v: dset(a, i, v, pred), tree, vals)
 
 
 def dget2(arr, i0, i1):
     """``arr[i0, i1]`` for traced indices."""
+    b2 = _blk2(arr.shape[0], arr.shape[1])
+    if b2 is not None:
+        return _scan_get2(arr, i0, i1, *b2)
     return _reduce_pick(_oh2(arr.shape[0], arr.shape[1], i0, i1), arr)
 
 
@@ -313,6 +542,8 @@ def _gate_idx(i, pred):
 
 def dset(arr, i, v, pred=True):
     """``arr.at[i].set(v)``, gated by ``pred`` (no-op where false)."""
+    if _blk(arr.shape[0]) is not None:
+        return _scan_set1([arr], i, [v], pred)[0]
     if pred is not True and arr.shape[0] >= _GATE_IDX_MIN:
         return _masked_write(arr, _oh1(arr.shape[0], _gate_idx(i, pred)), v, True)
     return _masked_write(arr, _oh1(arr.shape[0], i), v, pred)
@@ -321,6 +552,9 @@ def dset(arr, i, v, pred=True):
 def dset2(arr, i0, i1, v, pred=True):
     """``arr.at[i0, i1].set(v)``, gated by ``pred``."""
     n0, n1 = arr.shape[0], arr.shape[1]
+    b2 = _blk2(n0, n1)
+    if b2 is not None:
+        return _scan_set2(arr, i0, i1, v, pred, *b2)
     if pred is not True:
         # fold the gate into whichever axis actually compares (size-1
         # axes skip their compare in _oh2 and cannot carry the gate)
@@ -333,6 +567,8 @@ def dset2(arr, i0, i1, v, pred=True):
 
 def dadd(arr, i, v, pred=True):
     """``arr.at[i].add(v)``, gated by ``pred``."""
+    if _blk(arr.shape[0]) is not None:
+        return _scan_set1([arr], i, [v], pred, add=True)[0]
     if pred is not True and arr.shape[0] >= _GATE_IDX_MIN:
         i, pred = _gate_idx(i, pred), True
     mask = _oh1(arr.shape[0], i)
@@ -355,6 +591,9 @@ def dexchange2(arr, i0, i1, v, do_write, pred=True):
     pass, differing only in a scalar select of the value.
     """
     n0, n1 = arr.shape[0], arr.shape[1]
+    b2 = _blk2(n0, n1)
+    if b2 is not None:
+        return _scan_exchange2(arr, i0, i1, v, do_write, pred, *b2)
     if pred is not True:
         if n1 >= _GATE_IDX_MIN:
             i1, pred = _gate_idx(i1, pred), True
@@ -379,6 +618,9 @@ def set_col(arr, k: int, col):
 def dadd2(arr, i0, i1, v, pred=True):
     """``arr.at[i0, i1].add(v)``, gated by ``pred``."""
     n0, n1 = arr.shape[0], arr.shape[1]
+    b2 = _blk2(n0, n1)
+    if b2 is not None:
+        return _scan_set2(arr, i0, i1, v, pred, *b2, add=True)
     if pred is not True:
         if n1 >= _GATE_IDX_MIN:
             i1, pred = _gate_idx(i1, pred), True

@@ -209,6 +209,31 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             dtype = eqn.params["dtype"]
             out = lax.broadcasted_iota(dtype, shape + (1,), dim)
             write(eqn, [_Val(out, False)])
+        elif prim == "dynamic_slice":
+            op, *starts = ins
+            _check_unbatched_starts(prim, starts)
+            sizes = tuple(eqn.params["slice_sizes"])
+            lane = op.x.shape[-1]
+            out = lax.dynamic_slice(
+                op.x,
+                tuple(s.x for s in starts) + (jnp.zeros_like(starts[0].x),),
+                sizes + (lane,),
+            )
+            write(eqn, [_Val(out, op.batched)])
+        elif prim == "dynamic_update_slice":
+            op, upd, *starts = ins
+            _check_unbatched_starts(prim, starts)
+            if batched:
+                xop = _align(op, tuple(eqn.invars[0].aval.shape), L)
+                xup = _align(upd, tuple(eqn.invars[1].aval.shape), L)
+            else:
+                xop = op.x
+                xup = upd.x
+            out = lax.dynamic_update_slice(
+                xop, xup,
+                tuple(s.x for s in starts) + (jnp.zeros_like(starts[0].x),),
+            )
+            write(eqn, [_Val(out, batched)])
         elif prim == "dot_general":
             write(eqn, [_dot_general(eqn, ins, L)])
         elif prim == "while":
@@ -232,6 +257,21 @@ def eval_lanelast(jaxpr, consts, L, in_vals):
             )
 
     return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _check_unbatched_starts(prim, starts):
+    """Dynamic-slice starts must be UNBATCHED scalars under the lane-last
+    discipline: a per-lane start is a gather/scatter in disguise, which
+    Mosaic has no rule for.  The scan-over-rows table dispatch
+    (core/dyn.py) keys every slice on the unbatched block counter, so a
+    batched start reaching here is a programming error, not a layout to
+    support."""
+    if any(s.batched or jnp.ndim(s.x) for s in starts):
+        raise NotImplementedError(
+            f"lanelast: {prim} start indices must be unbatched scalars "
+            "(a per-lane start is a gather — slice on the unbatched "
+            "block counter instead; see core/dyn.py scan-over-rows)"
+        )
 
 
 def _dot_general(eqn, ins, L):

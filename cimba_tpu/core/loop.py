@@ -655,34 +655,42 @@ def _guard_wait(sim: Sim, p, gid, cmd: pr.Command, is_retry=False,
     g2, seq = gd.alloc_seq(sim.guards, gid, seq_override, pred)
     # membership IS pend_guard (dense guards): the pend bookkeeping below
     # is the whole enqueue; nothing can overflow (capacity = P)
+    # one grouped write: every pend field lands at the same pid under the
+    # same gate, so the scan-over-rows arm serves all nine from a single
+    # block loop (dense mode is the per-field dset sequence, unchanged)
+    (pend_tag, pend_f, pend_f2, pend_f3, pend_i, pend_pc, pend_guard,
+     pend_seq, pc) = dyn.dset_tree(
+        (sim.procs.pend_tag, sim.procs.pend_f, sim.procs.pend_f2,
+         sim.procs.pend_f3, sim.procs.pend_i, sim.procs.pend_pc,
+         sim.procs.pend_guard, sim.procs.pend_seq, sim.procs.pc),
+        p,
+        (cmd.tag, cmd.f, cmd.f2, cmd.f3, cmd.i, cmd.next_pc,
+         jnp.asarray(gid, _I), seq, cmd.next_pc),
+        pred,
+    )
     procs = sim.procs._replace(
-        pend_tag=dyn.dset(sim.procs.pend_tag, p, cmd.tag, pred),
-        pend_f=dyn.dset(sim.procs.pend_f, p, cmd.f, pred),
-        pend_f2=dyn.dset(sim.procs.pend_f2, p, cmd.f2, pred),
-        pend_f3=dyn.dset(sim.procs.pend_f3, p, cmd.f3, pred),
-        pend_i=dyn.dset(sim.procs.pend_i, p, cmd.i, pred),
-        pend_pc=dyn.dset(sim.procs.pend_pc, p, cmd.next_pc, pred),
-        pend_guard=dyn.dset(sim.procs.pend_guard, p, jnp.asarray(gid, _I), pred),
-        pend_seq=dyn.dset(sim.procs.pend_seq, p, seq, pred),
-        pc=dyn.dset(sim.procs.pc, p, cmd.next_pc, pred),
+        pend_tag=pend_tag, pend_f=pend_f, pend_f2=pend_f2, pend_f3=pend_f3,
+        pend_i=pend_i, pend_pc=pend_pc, pend_guard=pend_guard,
+        pend_seq=pend_seq, pc=pc,
     )
     return sim._replace(procs=procs, guards=g2)
 
 
 def _clear_pend(sim: Sim, p, pred=True) -> Sim:
+    pend_tag, pend_guard = dyn.dset_tree(
+        (sim.procs.pend_tag, sim.procs.pend_guard), p,
+        (pr.NO_PEND, -1), pred,
+    )
     return sim._replace(
-        procs=sim.procs._replace(
-            pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND, pred),
-            pend_guard=dyn.dset(sim.procs.pend_guard, p, -1, pred),
-        )
+        procs=sim.procs._replace(pend_tag=pend_tag, pend_guard=pend_guard)
     )
 
 
 def _record_row(acc: ts.StepAccum, row, t, v, pred=True) -> ts.StepAccum:
     """step_record on one row of a batched StepAccum, gated by ``pred``."""
-    one = jax.tree.map(lambda x: dyn.dget(x, row), acc)
+    one = dyn.dget_tree(acc, row)
     upd = ts.step_record(one, t, v)
-    return jax.tree.map(lambda a, u: dyn.dset(a, row, u, pred), acc, upd)
+    return dyn.dset_tree(acc, row, upd, pred)
 
 
 def _record_row_if(flags, acc, row, t, v, pred=True):
@@ -903,14 +911,10 @@ def _abort_wait(spec: ModelSpec, sim: Sim, p, sig, pred=True) -> Sim:
         # nothing can ever pend: no snapshot, no command-specific
         # cleanup — unwait is the whole abort
         return _unwait(spec, sim, p, pred)
-    pend = pr.Command(
-        dyn.dget(sim.procs.pend_tag, p),
-        dyn.dget(sim.procs.pend_f, p),
-        dyn.dget(sim.procs.pend_f2, p),
-        dyn.dget(sim.procs.pend_f3, p),
-        dyn.dget(sim.procs.pend_i, p),
-        dyn.dget(sim.procs.pend_pc, p),
-    )
+    pend = pr.Command(*dyn.dget_tree(
+        (sim.procs.pend_tag, sim.procs.pend_f, sim.procs.pend_f2,
+         sim.procs.pend_f3, sim.procs.pend_i, sim.procs.pend_pc), p,
+    ))
     # _abort_cleanup self-gates on pend.tag, so NO_PEND is a clean no-op
     return _abort_cleanup(
         spec, _unwait(spec, sim, p, pred), p, pend, sig, pred=pred
@@ -1091,18 +1095,21 @@ def spawn_process(sim: Sim, pt, at=None, prio=None):
     slot = dyn.first_true32(free).astype(_I)
     p = jnp.where(found, slot, 0)
     new_prio = jnp.asarray(pt.prio if prio is None else prio, _I)
+    (status, pc, prio, got, exit_sig, await_pid, await_evt, pend_tag,
+     pend_guard, locals_f, locals_i) = dyn.dset_tree(
+        (sim.procs.status, sim.procs.pc, sim.procs.prio, sim.procs.got,
+         sim.procs.exit_sig, sim.procs.await_pid, sim.procs.await_evt,
+         sim.procs.pend_tag, sim.procs.pend_guard, sim.procs.locals_f,
+         sim.procs.locals_i),
+        p,
+        (pr.RUNNING, pt.entry_pc, new_prio, 0.0, 0, -1, -1, pr.NO_PEND,
+         -1, 0.0, 0),
+        found,
+    )
     procs = sim.procs._replace(
-        status=dyn.dset(sim.procs.status, p, pr.RUNNING, found),
-        pc=dyn.dset(sim.procs.pc, p, pt.entry_pc, found),
-        prio=dyn.dset(sim.procs.prio, p, new_prio, found),
-        got=dyn.dset(sim.procs.got, p, 0.0, found),
-        exit_sig=dyn.dset(sim.procs.exit_sig, p, 0, found),
-        await_pid=dyn.dset(sim.procs.await_pid, p, -1, found),
-        await_evt=dyn.dset(sim.procs.await_evt, p, -1, found),
-        pend_tag=dyn.dset(sim.procs.pend_tag, p, pr.NO_PEND, found),
-        pend_guard=dyn.dset(sim.procs.pend_guard, p, -1, found),
-        locals_f=dyn.dset(sim.procs.locals_f, p, 0.0, found),
-        locals_i=dyn.dset(sim.procs.locals_i, p, 0, found),
+        status=status, pc=pc, prio=prio, got=got, exit_sig=exit_sig,
+        await_pid=await_pid, await_evt=await_evt, pend_tag=pend_tag,
+        pend_guard=pend_guard, locals_f=locals_f, locals_i=locals_i,
     )
     sim = sim._replace(procs=procs)
     t = sim.clock if at is None else jnp.asarray(at, _T)

@@ -367,9 +367,10 @@ def make_kernel_run(
             compiler_params=(
                 None
                 if interpret
-                else pltpu.CompilerParams(
-                    vmem_limit_bytes=_vmem_limit_bytes(lane_block)
-                )
+                else getattr(
+                    pltpu, "CompilerParams",
+                    getattr(pltpu, "TPUCompilerParams", None),
+                )(vmem_limit_bytes=_vmem_limit_bytes(lane_block))
             ),
             **grid_kwargs,
         )

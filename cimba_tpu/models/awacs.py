@@ -208,9 +208,15 @@ def build(n_targets: int, scoring: str = "nn"):
         # fold the position forward to now, then draw a new velocity
         # one-hot dynamic reads (dyn.dget): a raw traced-index gather has
         # no Mosaic lowering for the kernel path
-        dt = sim.clock - dyn.dget(sim.user["t_mark"], idx)
-        px = dyn.dget(sim.user["pos_x"], idx) + dyn.dget(sim.user["vel_x"], idx) * dt
-        py = dyn.dget(sim.user["pos_y"], idx) + dyn.dget(sim.user["vel_y"], idx) * dt
+        # grouped read: all five [N] columns at one pid, so the
+        # scan-over-rows arm serves them from a single block loop
+        t_mark, vel_x, vel_y, pos_x, pos_y = dyn.dget_tree(
+            (sim.user["t_mark"], sim.user["vel_x"], sim.user["vel_y"],
+             sim.user["pos_x"], sim.user["pos_y"]), idx,
+        )
+        dt = sim.clock - t_mark
+        px = pos_x + vel_x * dt
+        py = pos_y + vel_y * dt
         # soft-bounce: if outside the arena, head back toward the center.
         # Directions are selected as unit VECTORS, not heading angles:
         # cos/sin(arctan2(-y,-x)) in closed form is just -pos/|pos|, and
@@ -223,15 +229,19 @@ def build(n_targets: int, scoring: str = "nn"):
         vx = SPEED * jnp.where(outside, -px * inv_r, jnp.cos(heading))
         vy = SPEED * jnp.where(outside, -py * inv_r, jnp.sin(heading))
         u = sim.user
+        w_pos_x, w_pos_y, w_vel_x, w_vel_y, w_t_mark = dyn.dset_tree(
+            (u["pos_x"], u["pos_y"], u["vel_x"], u["vel_y"], u["t_mark"]),
+            idx, (px, py, vx, vy, sim.clock),
+        )
         sim = api.set_user(
             sim,
             {
                 **u,
-                "pos_x": dyn.dset(u["pos_x"], idx, px),
-                "pos_y": dyn.dset(u["pos_y"], idx, py),
-                "vel_x": dyn.dset(u["vel_x"], idx, vx),
-                "vel_y": dyn.dset(u["vel_y"], idx, vy),
-                "t_mark": dyn.dset(u["t_mark"], idx, sim.clock),
+                "pos_x": w_pos_x,
+                "pos_y": w_pos_y,
+                "vel_x": w_vel_x,
+                "vel_y": w_vel_y,
+                "t_mark": w_t_mark,
             },
         )
         sim, leg = api.draw(sim, cr.exponential, LEG_MEAN)

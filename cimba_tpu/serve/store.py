@@ -78,7 +78,9 @@ XLA_MIN_S_ENV = "CIMBA_PROGRAM_STORE_XLA_MIN_S"
 #: stores then invalidate loudly instead of deserializing garbage.
 #: 2: PR 17 added per-program ``footprint_bytes`` (the device
 #: scheduler's memory-aware admission reads it off hydrated programs
-#: without re-lowering, docs/24_device_scheduler.md).
+#: without re-lowering, docs/24_device_scheduler.md).  Per-program
+#: ``program_size`` (docs/25_compile_wall.md) is additive-optional —
+#: readers tolerate its absence, so it needed no bump.
 FORMAT = 2
 
 MANIFEST = "manifest.json"
@@ -927,7 +929,29 @@ class ProgramStore:
             "downgrades": downgrades,
         }
 
-        def emit(role, args_sig_args, compiled, compile_s, path=None):
+        def psize(fn, fn_args, lowered, lower_s):
+            """Program-size record for one saved program
+            (docs/25_compile_wall.md): the trace-only obs probe plus
+            the HLO text bytes off the ALREADY-lowered module (no
+            re-lower).  Sits next to ``footprint_bytes`` in the
+            manifest — device memory and program text are the two
+            sizes that gate a deploy.  Best-effort: a spec the probe
+            can't re-trace degrades to None, never a failed save."""
+            try:
+                from cimba_tpu.obs import program_size as _psz
+
+                d = _psz.measure(fn, *fn_args, lower=False).to_dict()
+                d["lower_s"] = round(lower_s, 4)
+                try:
+                    d["hlo_bytes"] = len(lowered.as_text().encode())
+                except Exception:
+                    d["hlo_bytes"] = None
+                return d
+            except Exception:
+                return None
+
+        def emit(role, args_sig_args, compiled, compile_s, path=None,
+                 size=None):
             sig = _args_sig_digest(args_sig_args)
             try:
                 payload = _se.serialize(compiled)
@@ -966,6 +990,8 @@ class ProgramStore:
                 fp = None
             if fp is not None:
                 rec["footprint_bytes"] = int(fp)
+            if size is not None:
+                rec["program_size"] = size
             if path is not None:
                 rec["path"] = path
             programs.append(rec)
@@ -997,14 +1023,21 @@ class ProgramStore:
                 )
                 args = (reps, seeds, t_stops, pw)
                 t0 = time.monotonic()
-                init_c = init_j.lower(*args).compile()
+                init_low = init_j.lower(*args)
+                t_init_low = time.monotonic() - t0
+                init_c = init_low.compile()
                 t_init = time.monotonic() - t0
-                emit("init", args, init_c, t_init)
+                emit("init", args, init_c, t_init,
+                     size=psize(init_j, args, init_low, t_init_low))
                 sims_aval = jax.eval_shape(init_j, *args)
                 t0 = time.monotonic()
-                chunk_c = chunk_j.lower(sims_aval).compile()
+                chunk_low = chunk_j.lower(sims_aval)
+                t_chunk_low = time.monotonic() - t0
+                chunk_c = chunk_low.compile()
                 t_chunk = time.monotonic() - t0
-                emit("chunk", (sims_aval,), chunk_c, t_chunk)
+                emit("chunk", (sims_aval,), chunk_c, t_chunk,
+                     size=psize(chunk_j, (sims_aval,), chunk_low,
+                                t_chunk_low))
                 for sp, pdig in folds:
                     from cimba_tpu.serve import cache as _pcache
 
@@ -1012,7 +1045,9 @@ class ProgramStore:
                     fold_j = _pcache._fold_program(with_metrics, sp)
                     try:
                         t0 = time.monotonic()
-                        fold_c = fold_j.lower(acc, sims_aval).compile()
+                        fold_low = fold_j.lower(acc, sims_aval)
+                        t_fold_low = time.monotonic() - t0
+                        fold_c = fold_low.compile()
                         t_fold = time.monotonic() - t0
                     except Exception as e:
                         # a path that doesn't exist on this model's Sim
@@ -1026,6 +1061,8 @@ class ProgramStore:
                     emit(
                         "fold", (acc, sims_aval), fold_c, t_fold,
                         path=pdig,
+                        size=psize(fold_j, (acc, sims_aval), fold_low,
+                                   t_fold_low),
                     )
 
         # the merge key carries the summary-path digest too: fold

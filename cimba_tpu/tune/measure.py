@@ -60,6 +60,11 @@ class Arm:
     run: Callable[[], Any]
     prepare: Optional[Callable[[], Any]] = None
     meta: Any = None
+    #: optional program-size record for this arm (the
+    #: ``obs.program_size`` dict — eqns / jaxpr_bytes / ...), carried
+    #: verbatim onto the result and report so compile cost rides next
+    #: to wall time (docs/25_compile_wall.md)
+    program_size: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -72,6 +77,7 @@ class ArmResult:
     payload: Any = None
     skip_reason: Optional[str] = None
     meta: Any = None
+    program_size: Optional[dict] = None
 
     def rate(self, units: Optional[float]) -> Optional[float]:
         """``units / best_wall`` (events, replications, ... — the
@@ -131,6 +137,7 @@ class MeasureReport:
                 "units": units,
                 "rate": a.rate(units),
                 "skip_reason": a.skip_reason,
+                "program_size": a.program_size,
             })
         return {
             "arms": arms,
@@ -175,7 +182,7 @@ def measure_arms(
     results = [
         ArmResult(
             name=a.name, status=OK, walls=[], best_wall=None,
-            compile_s=None, meta=a.meta,
+            compile_s=None, meta=a.meta, program_size=a.program_size,
         )
         for a in arms
     ]
@@ -190,8 +197,24 @@ def measure_arms(
             )
             continue
         if arm.prepare is not None:
+            # time inside try/finally: a prepare that RAISES (a caller
+            # aborting a hung compile via its own timeout) still gets
+            # its measured partial seconds attached to the skip record
+            # — "slow compile" and "hung compile" must stay
+            # distinguishable in the report (docs/25_compile_wall.md)
             t0 = time.perf_counter()
-            arm.prepare()
+            try:
+                arm.prepare()
+            except Exception as e:
+                results[i].compile_s = time.perf_counter() - t0
+                if i == baseline:
+                    raise
+                results[i].status = SKIPPED
+                results[i].skip_reason = (
+                    f"prepare raised after {results[i].compile_s:.1f}s: "
+                    f"{type(e).__name__}: {e}"
+                )
+                continue
             results[i].compile_s = time.perf_counter() - t0
             if (
                 compile_budget_s is not None

@@ -145,6 +145,13 @@ def save_tuned(store, spec, n_replications: int, report) -> Optional[dict]:
         )
         store._count("downgrades")
         return None
+    # the winner arm's compile/program-size numbers ride into the
+    # manifest (docs/25_compile_wall.md): a tuned entry that traded
+    # run-time for compile-time shows its price next to the speedup
+    win_row = next(
+        (r for r in report.arms if r.get("name") == report.winner_name),
+        None,
+    ) or {}
     rec = {
         "schedule": report.winner.to_json(),
         "schedule_digest": report.winner.digest(),
@@ -157,6 +164,8 @@ def save_tuned(store, spec, n_replications: int, report) -> Optional[dict]:
             "workload": report.workload,
             "speedup_frac": report.speedup_frac,
             "noise_floor_frac": report.noise_floor_frac,
+            "compile_s": win_row.get("compile_s"),
+            "program_size": win_row.get("program_size"),
         },
     }
 

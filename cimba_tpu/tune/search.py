@@ -168,6 +168,7 @@ def search_schedule(
     compile_budget_s: Optional[float] = None,
     min_gain: float = 0.0,
     strict_pin: bool = True,
+    probe_program_size: bool = True,
     program_cache=None,
     out_dir=None,
     on_round: Optional[Callable[[int], None]] = None,
@@ -237,6 +238,25 @@ def search_schedule(
         )
         return _block_result(st)
 
+    def probe_size(sched: Schedule) -> Optional[dict]:
+        """Trace-only program-size probe under the arm's trace-time
+        scope (docs/25_compile_wall.md) — eqn count / jaxpr bytes next
+        to each arm's wall numbers, so a compile-budget skip is
+        measured data, not a silent cut.  Never compiles; a model the
+        probe can't trace (exotic spec) degrades to None, not a
+        failed search."""
+        if not probe_program_size:
+            return None
+        from cimba_tpu.obs import program_size as _ps
+
+        try:
+            with sched.scope():
+                return _ps.chunk_program_size(
+                    spec, params, lanes=4, lower=False,
+                ).to_dict()
+        except Exception:
+            return None
+
     def make_arm(sched: Schedule) -> _measure.Arm:
         name = sched.label()
 
@@ -253,9 +273,10 @@ def search_schedule(
             return run_point(sched, warm=False)
 
         return _measure.Arm(name=name, run=run, prepare=prepare,
-                            meta=sched)
+                            meta=sched, program_size=probe_size(sched))
 
     arms = [make_arm(c) for c in candidates]
+    psizes = {a.name: a.program_size for a in arms}
     by_name = {c.label(): c for c in candidates}
     t0 = time.perf_counter()
 
@@ -385,6 +406,7 @@ def search_schedule(
             "walls_s": [],
             "best_wall_s": None,
             "compile_s": None,
+            "program_size": psizes.get(name),
             "events": None,
             "rate": None,
             "digest": None,

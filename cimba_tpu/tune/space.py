@@ -21,7 +21,11 @@ only speed:
   against a default-knob twin at the candidate's OWN geometry
   (:mod:`cimba_tpu.tune.search`);
 * ``lane_block`` — the Pallas kernel grid (``CIMBA_KERNEL_LANE_BLOCK``),
-  only meaningful where the kernel path is live.
+  only meaningful where the kernel path is live;
+* ``table_scan`` / ``table_block`` — scan-over-rows process-table
+  dispatch (docs/25_compile_wall.md): bitwise the dense access
+  (tests/test_table_scan.py), trades per-access work for O(1)-in-P
+  program text — a compile-time/run-time dial, not a results knob.
 
 Validity predicates prune instead of measuring: the hierarchical
 event-set is structurally inert whenever the model's event capacity is
@@ -45,12 +49,15 @@ __all__ = ["Schedule", "ScheduleSpace", "default_space"]
 #: changes so stale tuned entries invalidate loudly instead of
 #: resolving garbage knobs.  2: PR 17 added the device-scheduler knobs
 #: (``waves_per_device`` / ``preempt_quantum`` / ``mem_fraction``).
-SCHEDULE_FORMAT = 2
+#: 3: the table-scan dispatch knobs (``table_scan`` / ``table_block``
+#: — docs/25_compile_wall.md).
+SCHEDULE_FORMAT = 3
 
 #: the knob fields, in canonical order (the JSON/digest field set)
 _FIELDS = (
     "eventset_hier", "eventset_block", "pack",
     "chunk_steps", "wave_size", "lane_block",
+    "table_scan", "table_block",
     "waves_per_device", "preempt_quantum", "mem_fraction",
 )
 
@@ -95,6 +102,12 @@ class Schedule:
     chunk_steps: Optional[int] = None
     wave_size: Optional[int] = None
     lane_block: Optional[int] = None
+    # table-scan dispatch knobs (docs/25_compile_wall.md): scan-over-
+    # rows process-table access on/off plus the row-block size.  Trace-
+    # time, results bitwise either way (tests/test_table_scan.py) —
+    # pure program-size/compile-time trade
+    table_scan: Optional[bool] = None
+    table_block: Optional[int] = None
     # device-scheduler policy knobs (docs/24_device_scheduler.md):
     # concurrent waves per device, the preemption quantum (chunks
     # between preemption points), and the device-memory admission
@@ -132,8 +145,9 @@ class Schedule:
     @contextlib.contextmanager
     def scope(self):
         """Bind the trace-time knobs for the duration: the
-        ``config.EVENTSET_HIER`` / ``EVENTSET_BLOCK`` / ``XLA_PACK``
-        tri-states (set only for the fields this schedule carries)
+        ``config.EVENTSET_HIER`` / ``EVENTSET_BLOCK`` / ``XLA_PACK`` /
+        ``TABLE_SCAN`` / ``TABLE_SCAN_BLOCK`` tri-states (set only for
+        the fields this schedule carries)
         plus ``CIMBA_KERNEL_LANE_BLOCK`` for the kernel grid.  Restores
         the previous state on exit.  Like the dtype profile, these bind
         at TRACE time: programs already compiled keep their layout, and
@@ -145,7 +159,8 @@ class Schedule:
         from cimba_tpu import config
 
         prev = (config.EVENTSET_HIER, config.EVENTSET_BLOCK,
-                config.XLA_PACK)
+                config.XLA_PACK, config.TABLE_SCAN,
+                config.TABLE_SCAN_BLOCK)
         # the lane-block knob has no config tri-state — its documented
         # binding point IS the env var (core/pallas_run.py reads it via
         # env_raw), so this scope writes/restores the var itself; the
@@ -158,6 +173,10 @@ class Schedule:
                 config.EVENTSET_BLOCK = int(self.eventset_block)
             if self.pack is not None:
                 config.XLA_PACK = bool(self.pack)
+            if self.table_scan is not None:
+                config.TABLE_SCAN = bool(self.table_scan)
+            if self.table_block is not None:
+                config.TABLE_SCAN_BLOCK = int(self.table_block)
             if self.lane_block is not None:
                 os.environ["CIMBA_KERNEL_LANE_BLOCK"] = str(  # cimba: noqa(CHK005) — the binding site
                     int(self.lane_block)
@@ -165,7 +184,8 @@ class Schedule:
             yield self
         finally:
             (config.EVENTSET_HIER, config.EVENTSET_BLOCK,
-             config.XLA_PACK) = prev
+             config.XLA_PACK, config.TABLE_SCAN,
+             config.TABLE_SCAN_BLOCK) = prev
             if self.lane_block is not None:
                 if prev_lane is None:
                     os.environ.pop("CIMBA_KERNEL_LANE_BLOCK", None)
@@ -187,7 +207,12 @@ class Schedule:
         * the PR 2 inertness contract: the hierarchy is structurally
           inert unless ``event_cap`` is a >= 2x multiple of the block
           size — below that, both event-set knobs are dead for this
-          ``spec``.
+          ``spec``;
+        * ``table_block`` is dead when the table scan resolves off,
+          and both table-scan knobs are dead when no dyn-accessed
+          table axis of ``spec`` exceeds the effective block (the
+          core/dyn.py small-P inertness contract: a block covering the
+          whole axis traces the dense program character-identically).
         """
         from cimba_tpu import config
 
@@ -223,6 +248,38 @@ class Schedule:
             # program
             if cap < 2 * eff_block:
                 hier, block = None, None
+        tscan, tblock = self.table_scan, self.table_block
+        if tscan is not None and (
+            bool(tscan) == config.table_scan_enabled()
+        ):
+            tscan = None
+        if tblock is not None and (
+            int(tblock) == config.table_scan_block()
+        ):
+            tblock = None
+        eff_tscan = (
+            bool(tscan) if tscan is not None
+            else config.table_scan_enabled()
+        )
+        if not eff_tscan:
+            tblock = None
+        if spec is not None and eff_tscan:
+            eff_tblock = (
+                int(tblock) if tblock is not None
+                else config.table_scan_block()
+            )
+            # the tallest axis core/dyn.py can row-block for this
+            # spec: process tables [P], queue/pqueue rings, guard
+            # slots — the scan only engages when an axis exceeds the
+            # block, so below that every setting traces dense
+            tallest = max(
+                len(spec.proc_entry),
+                int(getattr(spec, "queue_cap_max", 0) or 0),
+                int(getattr(spec, "pqueue_cap_max", 0) or 0),
+                int(getattr(spec, "guard_cap", 0) or 0),
+            )
+            if tallest <= eff_tblock:
+                tscan, tblock = None, None
         # device-scheduler knobs: an arm binding the stock default IS
         # the default arm (host-side policy; never traced)
         wpd, quantum, memf = (
@@ -239,7 +296,8 @@ class Schedule:
             memf = None
         return dataclasses.replace(
             self, eventset_hier=hier, eventset_block=block,
-            pack=pack, chunk_steps=chunk, waves_per_device=wpd,
+            pack=pack, chunk_steps=chunk, table_scan=tscan,
+            table_block=tblock, waves_per_device=wpd,
             preempt_quantum=quantum, mem_fraction=memf,
         )
 
@@ -262,7 +320,7 @@ class Schedule:
         for f in _FIELDS:
             v = doc.get(f)
             if v is not None:
-                if f in ("eventset_hier", "pack"):
+                if f in ("eventset_hier", "pack", "table_scan"):
                     v = bool(v)
                 elif f == "mem_fraction":
                     v = float(v)
@@ -301,6 +359,8 @@ class ScheduleSpace:
     chunk_steps: Tuple = ()
     wave_size: Tuple = ()
     lane_block: Tuple = ()
+    table_scan: Tuple = ()
+    table_block: Tuple = ()
     waves_per_device: Tuple = ()
     preempt_quantum: Tuple = ()
     mem_fraction: Tuple = ()
@@ -361,14 +421,19 @@ def default_space(
     path); the device-scheduler policy knobs (``waves_per_device``,
     ``preempt_quantum`` — docs/24_device_scheduler.md) join only with
     ``device_sched=True``, since they are inert outside a
-    ``CIMBA_DEVICE_SCHED`` serve loop.  Axes that are structurally
-    inert for ``spec`` cost nothing:
+    ``CIMBA_DEVICE_SCHED`` serve loop.  The table-scan pair
+    (docs/25_compile_wall.md) is always in the grid — for small-table
+    models every setting collapses to the default arm, so it only
+    costs candidates where a table actually exceeds a block.  Axes
+    that are structurally inert for ``spec`` cost nothing:
     :meth:`ScheduleSpace.candidates` collapses them."""
     space = ScheduleSpace(
         eventset_hier=(True, False),
         eventset_block=(64, 128, 256),
         pack=(True, False),
         chunk_steps=(256, 1024, 4096),
+        table_scan=(True, False),
+        table_block=(64, 128, 256),
         lane_block=(8, 16, 32) if kernel else (),
         waves_per_device=(1, 2, 4) if device_sched else (),
         preempt_quantum=(4, 8, 16) if device_sched else (),

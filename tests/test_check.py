@@ -220,7 +220,8 @@ def test_program_lints_clean_on_shipped_model():
 
     findings, report = jaxprlint.check_programs(with_gates=False)
     assert findings == [], [f.format() for f in findings]
-    assert set(report["programs"]) == {"mm1/f64", "mm1/f32"}
+    assert set(report["programs"]) == {
+        "mm1/f64", "mm1/f32", "awacs/f64", "awacs/f32"}
 
 
 def test_donation_lint_fires_on_undonated_program():

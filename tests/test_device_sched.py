@@ -456,20 +456,20 @@ def test_device_sched_env_knob_resolves_service_default(
 
 
 # --------------------------------------------------------------------------
-# autotuner fold: Schedule format 2, canonical collapse, adoption
+# autotuner fold: Schedule format, canonical collapse, adoption
 # --------------------------------------------------------------------------
 
 
 def test_schedule_knobs_roundtrip_resolve_and_adoption(shared_cache):
     """The three scheduler knobs ride the tuned-schedule plane:
-    format-2 JSON round-trip, canonical collapse at the defaults,
+    versioned JSON round-trip, canonical collapse at the defaults,
     ``resolve_entry`` surfacing them in ``applied``/``block()``, and
     ``Service._adopt_sched_knobs`` taking them only where the
     constructor left None (explicit wins, first adoption sticks)."""
     from cimba_tpu.tune import registry as reg
     from cimba_tpu.tune import space
 
-    assert space.SCHEDULE_FORMAT == 2
+    assert space.SCHEDULE_FORMAT == 3
     s = space.Schedule(
         waves_per_device=4, preempt_quantum=16, mem_fraction=0.5,
     )

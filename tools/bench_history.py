@@ -13,12 +13,19 @@ checks the newest round against the previous one for a regression.
 Usage::
 
     python tools/bench_history.py [--dir .] [--cards DIR] [--tune DIR]
-        [--metric mm1_events_per_sec] [--max-regression 10]
+        [--compile] [--metric mm1_events_per_sec] [--max-regression 10]
 
 ``--tune DIR`` additionally collates the autotuner's TuneReport JSONs
 (``tunereport_*.json``, docs/21_autotune.md) into a per-(spec
 fingerprint, backend, workload-bucket) winner table beside the BENCH
 rounds, flagging groups whose winning schedule CHURNS across rounds.
+
+``--compile`` additionally collates the compile-wall lines
+(``bench.py --config compile_wall``, docs/25_compile_wall.md) into a
+per-(metric, table height) trend of compile wall seconds and program
+size across rounds, and flags a round whose scan-arm compile wall or
+equation count regressed beyond ``--max-regression`` percent — the
+compile-cost twin of the events/s regression check.
 
 Exit codes: 0 ok, 1 regression beyond ``--max-regression`` percent,
 2 nothing to collate.  Stdlib-only (no jax import) — safe in any CI
@@ -147,6 +154,65 @@ def print_tune_table(reports):
     return churn
 
 
+def print_compile_table(rounds, max_regression):
+    """Round-by-round compile-wall trend: one row per (metric, table
+    height) with dense/scan wall seconds, the speedup, and the scan
+    arm's equation count.  Returns the number of regressions — the
+    newest round's scan wall or eqn count growing beyond
+    ``max_regression`` percent over the previous round at the same
+    height (compile cost is a budget like any other;
+    docs/25_compile_wall.md)."""
+    groups = {}   # (metric, n_processes) -> {round: detail-with-value}
+    for n, _rc, lines in rounds:
+        for line in lines:
+            metric = line.get("metric") or ""
+            if "compile_wall" not in metric:
+                continue
+            det = dict(line.get("detail") or {})
+            det["speedup"] = line.get("value")
+            key = (metric, det.get("n_processes"))
+            groups.setdefault(key, {})[n] = det
+    if not groups:
+        print("\ncompile-wall trend: no compile_wall lines in any round")
+        return 0
+    print("\ncompile-wall trend (dense_s / scan_s / speedup / scan eqns):")
+    regressions = 0
+    for (metric, np_) in sorted(groups, key=str):
+        rows = groups[(metric, np_)]
+        print(f"  {metric} P={np_}")
+        for n in sorted(rows):
+            det = rows[n]
+            scan_ps = (det.get("program_size") or {}).get("scan") or {}
+            sp = det.get("speedup")
+            print(
+                f"    r{n}: {det.get('dense_wall_s', 0) or 0:.1f}s / "
+                f"{det.get('scan_wall_s', 0) or 0:.1f}s / "
+                + (f"{sp:.2f}x" if sp else "-")
+                + f" / {scan_ps.get('eqns', '-')}"
+            )
+        have = sorted(rows)
+        if len(have) >= 2:
+            prev, last = rows[have[-2]], rows[have[-1]]
+            for field, get in (
+                ("scan wall", lambda d: d.get("scan_wall_s")),
+                ("scan eqns", lambda d: (
+                    (d.get("program_size") or {}).get("scan") or {}
+                ).get("eqns")),
+            ):
+                pv, lv = get(prev), get(last)
+                if not pv or not lv:
+                    continue
+                growth = (lv - pv) / pv * 100.0
+                if growth > max_regression:
+                    regressions += 1
+                    print(
+                        f"    ** {field} REGRESSION: r{have[-2]} "
+                        f"{pv:.6g} -> r{have[-1]} {lv:.6g} "
+                        f"(+{growth:.1f}% > {max_regression:.0f}%) **"
+                    )
+    return regressions
+
+
 def _fmt_rate(v):
     if v is None:
         return "-"
@@ -171,6 +237,12 @@ def main(argv=None) -> int:
         help="also collate autotuner TuneReports (tunereport_*.json) "
         "from this directory: per-fingerprint winner table + "
         "winner-churn flags (docs/21_autotune.md)",
+    )
+    ap.add_argument(
+        "--compile", action="store_true",
+        help="also collate compile-wall lines (bench.py --config "
+        "compile_wall) into a per-table-height trend with its own "
+        "regression check (docs/25_compile_wall.md)",
     )
     ap.add_argument(
         "--metric", default="mm1_events_per_sec",
@@ -247,6 +319,12 @@ def main(argv=None) -> int:
     if args.tune:
         print_tune_table(load_tune_reports(args.tune))
 
+    compile_regressions = 0
+    if getattr(args, "compile"):
+        compile_regressions = print_compile_table(
+            rounds, args.max_regression
+        )
+
     if args.cards:
         cards = load_cards(args.cards)
         print(f"\nrun cards under {args.cards}: {len(cards)}")
@@ -266,7 +344,7 @@ def main(argv=None) -> int:
         print(
             f"\nregression check: <2 rounds carry {args.metric} — skipped"
         )
-        return 0
+        return 1 if compile_regressions else 0
     prev_n, last_n = have[-2], have[-1]
     prev_v, last_v = s[prev_n][0], s[last_n][0]
     drop_pct = (prev_v - last_v) / prev_v * 100.0
@@ -277,7 +355,7 @@ def main(argv=None) -> int:
         f"({-drop_pct:+.1f}%; threshold -{args.max_regression:.0f}%) "
         f"{verdict}"
     )
-    return 1 if verdict == "REGRESSION" else 0
+    return 1 if (verdict == "REGRESSION" or compile_regressions) else 0
 
 
 if __name__ == "__main__":

@@ -76,6 +76,70 @@ run_cell "static analysis" bash -c '
     -k "fixture or noqa or json_schema"
 '
 
+# compile wall smoke (docs/25_compile_wall.md): the scan-over-rows
+# table arm must stay BITWISE the dense arm on a tiny AWACS chunk, the
+# program_size probe must read FLAT equation counts across two engaged
+# table heights with the scan on (the O(1)-in-P contract), and JXL004
+# must fire on a deliberately unrolled program the way it would on a
+# real per-row regression
+run_cell "compile wall smoke" python - <<'EOF'
+import jax, jax.numpy as jnp, numpy as np
+from cimba_tpu import config
+from cimba_tpu.check import jaxprlint as jl
+from cimba_tpu.core import loop as cl
+from cimba_tpu.models import awacs
+from cimba_tpu.obs import program_size as ps
+
+# 1) tiny-P bitwise: scan arm == dense arm, every carry leaf
+spec, _ = awacs.build(16)
+def chunk(scan):
+    config.TABLE_SCAN, config.TABLE_SCAN_BLOCK = scan, 8
+    try:
+        sims = jax.vmap(
+            lambda r: cl.init_sim(spec, 2026, r, (2.0,))
+        )(jnp.arange(4))
+        out, live = jax.jit(cl.make_chunk(spec, max_steps=64))(sims)
+        return jax.tree.leaves(out) + [live]
+    finally:
+        config.TABLE_SCAN = config.TABLE_SCAN_BLOCK = None
+dense, scan = chunk(False), chunk(True)
+assert len(dense) == len(scan)
+for a, b in zip(dense, scan):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+# 2) O(1)-in-P: scan-on eqn counts FLAT across two engaged heights
+config.TABLE_SCAN, config.TABLE_SCAN_BLOCK = True, 8
+try:
+    sizes = {}
+    for n_t in (16, 48):
+        s, _ = awacs.build(n_t)
+        sizes[n_t] = ps.chunk_program_size(s, (2.0,), lanes=2,
+                                           lower=False).eqns
+finally:
+    config.TABLE_SCAN = config.TABLE_SCAN_BLOCK = None
+assert sizes[16] == sizes[48], sizes
+
+# 3) JXL004 fires on an unrolled (per-row) program, stays quiet on the
+# rolled form of the same computation
+def unrolled(x):
+    for i in range(64):          # the regression class JXL004 polices
+        x = x + jnp.float32(i)
+    return x
+def rolled(x):
+    return jax.lax.fori_loop(
+        0, 64, lambda i, x: x + jnp.astype(i, jnp.float32), x)
+n_bad = sum(jl.collect_primitives(
+    jax.make_jaxpr(unrolled)(jnp.float32(0))).values())
+n_ok = sum(jl.collect_primitives(
+    jax.make_jaxpr(rolled)(jnp.float32(0))).values())
+budget = n_ok + 8
+bad = jl.size_findings(n_bad, "fixture/unrolled", budget)
+assert len(bad) == 1 and bad[0].rule == "JXL004", (n_bad, budget, bad)
+assert jl.size_findings(n_ok, "fixture/rolled", budget) == []
+print("compile wall smoke OK: bitwise", len(dense), "leaves |",
+      f"scan-on eqns flat {sizes} | JXL004 fired at {n_bad} > {budget}")
+EOF
+
 # perf smoke: the CPU proxy must clear a floor (catches a 5x stepper or
 # sampler regression; the real perf tracking runs on TPU via bench.py)
 run_cell "perf smoke" python - <<'EOF'

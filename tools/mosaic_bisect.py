@@ -121,7 +121,7 @@ def _stage(n):
 
             topo = topologies.get_topology_desc("v5e:2x2", "tpu")
             sh = NamedSharding(Mesh([topo.devices[0]], "x"), P())
-            with jax.enable_x64(False):
+            with config.x64_scope(False):
                 leaves, treedef = jax.tree.flatten(sims)
                 leaves = [jnp.moveaxis(l, 0, -1) for l in leaves]
                 chunk_fn, _ = krun.build_chunk_call(leaves, treedef)
