@@ -6,7 +6,7 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,mmc,mg1,sweep,tandem,tune,jobshop,awacs,compile_wall}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,serve_refill,serve_fused,mmc,mg1,sweep,tandem,tune,jobshop,awacs,compile_wall}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
@@ -1697,6 +1697,316 @@ def bench_serve_refill():
     )
 
 
+def bench_serve_fused():
+    """Cross-spec wave fusion vs per-spec exact-class dispatch at the
+    SAME adversarial offered load (docs/26_wave_fusion.md): K small
+    DISTINCT models (same fusion shape class, different block
+    programs), each driven closed-loop by its own tenant client —
+    submit, wait, submit — so at most ONE request per spec is ever
+    outstanding.  That shape is maximally adversarial for exact-class
+    dispatch: a wave can never pack two requests (no same-class
+    sibling exists to claim, and the strict-priority boundary valve
+    blocks foreign-class splices), so every unfused wave strands at
+    R/max_wave occupancy and pays full birth overhead per request.
+    Fuse-on packs all K tenants into one resident branch-dispatch
+    superprogram wave and splices each next request into the lanes
+    its predecessor just retired.  Measured through
+    ``tune.measure.measure_arms`` (fuse-off is the baseline arm; its
+    self-twin gives the noise floor).  Acceptance: fused mean lane
+    occupancy >= 1.5x unfused and events/s >= 1.3x at the same
+    offered load, ZERO program-cache misses during the timed rounds
+    (a fixed-order primer sequence binds the fusion roster and warms
+    the identical bundle ladder every round), every completed
+    request's digest bitwise-equal to its direct solo run, and the
+    fused superprogram's equation count sublinear in the members'
+    solo sum (the JXL004 fused budget,
+    ``check.jaxprlint.fused_size_findings``)."""
+    import dataclasses as _dc
+    import threading as _threading
+
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.check import jaxprlint as _jxl
+    from cimba_tpu.core import api, cmd
+    from cimba_tpu.core.model import Model
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.obs import program_size as _ps
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.tune import measure as _tm
+
+    accel = _accel()
+    wave = int(os.environ.get(
+        "CIMBA_BENCH_FUSED_WAVE", str(4096 if accel else 16)
+    ))
+    # chunk small relative to trajectory length: occupancy is sampled
+    # at refill boundaries (every refill_every chunks), so each wave
+    # must cross many boundaries during its life
+    chunk = int(os.environ.get(
+        "CIMBA_BENCH_FUSED_CHUNK", str(256 if accel else 4)
+    ))
+    # K distinct specs; each request asks for wave/K lanes, so an
+    # unfused wave stranded with one tenant's request pads 1-1/K of
+    # its lanes — the adversarial shape fusion exists for
+    n_specs = int(os.environ.get("CIMBA_BENCH_FUSED_SPECS", "4"))
+    req_r = max(wave // n_specs, 1)
+    t_stop = float(os.environ.get(
+        "CIMBA_BENCH_FUSED_TSTOP", str(2048.0 if accel else 48.0)
+    ))
+    n_requests = int(os.environ.get("CIMBA_BENCH_FUSED_REQS", "48"))
+    per_spec = max(n_requests // n_specs, 1)
+    repeats = int(os.environ.get("CIMBA_BENCH_FUSED_REPEATS", "3"))
+    prof = _bench_profile()
+
+    def _build_spec(i):
+        # distinct model IDENTITY (different trace-time hold constant
+        # = different block program), same fusion shape class
+        step = 0.5 + 0.25 * i
+        m = Model(f"fz{i}", event_cap=1, guard_cap=2)
+
+        @m.block
+        def work(sim, p, sig):
+            done = api.clock(sim) > t_stop
+            return sim, cmd.select(
+                done, cmd.exit_(), cmd.hold(step, next_pc=work.pc)
+            )
+
+        m.process("w", entry=work)
+        return m.build()
+
+    with _cfg.profile(prof):
+        import jax
+
+        from cimba_tpu.stats import summary as _sm
+
+        def clock_path(sims):
+            return jax.vmap(lambda c: _sm.add(_sm.empty(), c))(
+                sims.clock
+            )
+
+        specs = [_build_spec(i) for i in range(n_specs)]
+        cache = serve.ProgramCache()
+
+        def requests():
+            return [
+                serve.Request(
+                    s, (), req_r, seed=11 + i, wave_size=req_r,
+                    chunk_steps=chunk, summary_path=clock_path,
+                )
+                for i, s in enumerate(specs)
+            ]
+
+        def load_round(fuse, per, collect=None):
+            """One closed-loop round: K tenant threads, one spec
+            each, ``per`` sequential submit->wait requests; returns
+            (wall_s, total_events, stats)."""
+            svc = serve.Service(
+                max_wave=wave, cache=cache, refill=True,
+                refill_every=1, horizon_bucket=None, fuse=fuse,
+                fuse_max_specs=n_specs, on_chunk=_heartbeat,
+            )
+            errs: list = []
+            ev = [0] * n_specs
+            try:
+                # primer: one request per spec, sequentially, in a
+                # FIXED order — binds the fusion roster s0<s1<...
+                # identically every round, so prepare and timed
+                # rounds trace the same bundle ladder ({s0,s1},
+                # {s0..s2}, ...) and the timed rounds compile nothing
+                for i, r in enumerate(requests()):
+                    svc.submit(_dc.replace(
+                        r, label=f"primer:{r.spec.name}"
+                    )).result(600)
+
+                def tenant(i, r):
+                    try:
+                        for j in range(per):
+                            res = svc.submit(_dc.replace(
+                                r, label=f"{r.spec.name}#{j}"
+                            )).result(600)
+                            ev[i] += int(res.total_events)
+                            if collect is not None:
+                                collect(i, res)
+                            _heartbeat()
+                    except Exception as e:  # surfaced after join
+                        errs.append(e)
+
+                ths = [
+                    _threading.Thread(target=tenant, args=(i, r))
+                    for i, r in enumerate(requests())
+                ]
+                t0 = time.perf_counter()
+                for th in ths:
+                    th.start()
+                for th in ths:
+                    th.join()
+                wall = time.perf_counter() - t0
+                stats = svc.stats()
+            finally:
+                svc.shutdown()
+            if errs:
+                raise errs[0]
+            return wall, sum(ev), stats
+
+        payloads: dict = {}
+        results: dict = {}
+        misses_at_first_run: dict = {}
+
+        def make_arm(name, fuse, program_size=None):
+            def prepare():
+                load_round(fuse, 2)
+
+            def run():
+                misses_at_first_run.setdefault(
+                    "misses", cache.stats()["misses"]
+                )
+                got = payloads.setdefault(name, [])
+                kept = results.setdefault(name, [])
+                got.append(load_round(
+                    fuse, per_spec,
+                    collect=lambda i, r: kept.append((i, r)),
+                ))
+                return got[-1]
+
+            return _tm.Arm(
+                name=name, run=run, prepare=prepare,
+                program_size=program_size,
+            )
+
+        # program size as a first-class cost (docs/25): the fused
+        # superprogram vs the sum of its members' solo programs —
+        # the JXL004 sublinearity budget is the price ceiling the
+        # occupancy win is bought under
+        solo_sizes = [
+            _ps.chunk_program_size(
+                s, (), lanes=4, max_steps=chunk, lower=False
+            )
+            for s in specs
+        ]
+        fused_size = _ps.fused_program_size(
+            specs, (), lanes=4, max_steps=chunk, lower=False
+        )
+        size_findings = _jxl.fused_size_findings(
+            fused_size.eqns, [s.eqns for s in solo_sizes],
+            "serve_fused",
+        )
+        assert not size_findings, (
+            "fused superprogram over the JXL004 sublinearity budget",
+            [f.message for f in size_findings],
+        )
+        fused_size_detail = {
+            "fused": fused_size.to_dict(),
+            "solo_eqns": [s.eqns for s in solo_sizes],
+            "sublinearity": (
+                fused_size.eqns
+                / max(sum(s.eqns for s in solo_sizes), 1)
+            ),
+            "budget_factor": _jxl.FUSED_EQN_FACTOR,
+        }
+
+        arms = [
+            make_arm("fuse_off", False),
+            make_arm("fuse_on", True, program_size=fused_size_detail),
+        ]
+        mreport = _tm.measure_arms(
+            arms, repeats=repeats, baseline=0, on_round=_heartbeat,
+        )
+        compiled_in_timed = (
+            cache.stats()["misses"] - misses_at_first_run["misses"]
+            if misses_at_first_run else None
+        )
+        assert compiled_in_timed == 0, (
+            "programs compiled during the timed fused rounds",
+            compiled_in_timed, cache.stats(),
+        )
+        # per-spec digest anchors vs direct solo runs — fusion is
+        # invisible to results, branch-dispatched or not
+        direct_digest = {}
+        for i, r in enumerate(requests()):
+            direct_digest[i] = _audit.stream_result_digest(
+                ex.run_experiment_stream(
+                    r.spec, r.params, r.n_replications,
+                    wave_size=r.wave_size, chunk_steps=r.chunk_steps,
+                    seed=r.seed, t_end=r.t_end,
+                    summary_path=clock_path, program_cache=cache,
+                    on_wave=_heartbeat, on_chunk=_heartbeat,
+                )
+            )
+        digest_checked = digest_equal = 0
+        arm_detail = {}
+        for name, rounds in payloads.items():
+            for i, res in results.get(name, ()):
+                digest_checked += 1
+                digest_equal += (
+                    _audit.stream_result_digest(res)
+                    == direct_digest[i]
+                )
+            # per-round (wall, events, stats); events are identical
+            # every round (same requests, deterministic trajectories)
+            best = min(rounds, key=lambda r: r[0])
+            arm_detail[name] = {
+                "rounds": len(rounds),
+                "walls_s": [round(r[0], 6) for r in rounds],
+                "best_wall_s": best[0],
+                "total_events": best[1],
+                "events_per_sec": best[1] / best[0] if best[0] else 0.0,
+                "occupancy_mean": max(
+                    r[2]["lane_occupancy"]["occupancy_mean"]
+                    for r in rounds
+                ),
+                "fusion": rounds[-1][2]["fusion"],
+                "refill": rounds[-1][2]["refill"],
+            }
+    on_d = arm_detail.get("fuse_on", {})
+    off_d = arm_detail.get("fuse_off", {})
+    occ_ratio = (
+        on_d.get("occupancy_mean", 0.0) / off_d["occupancy_mean"]
+        if off_d.get("occupancy_mean") else None
+    )
+    ev_ratio = (
+        on_d.get("events_per_sec", 0.0) / off_d["events_per_sec"]
+        if off_d.get("events_per_sec") else None
+    )
+    rate = on_d.get("events_per_sec", 0.0)
+    assert digest_checked and digest_equal == digest_checked, (
+        "fused results drifted from their solo digests",
+        digest_equal, digest_checked,
+    )
+    assert occ_ratio is not None and occ_ratio >= 1.5, (
+        "fused occupancy below the 1.5x acceptance floor", occ_ratio,
+    )
+    assert ev_ratio is not None and ev_ratio >= 1.3, (
+        "fused events/s below the 1.3x acceptance floor", ev_ratio,
+    )
+    _line(
+        "serve_fused_events_per_sec",
+        rate,
+        rate / BASELINE_EVENTS_PER_SEC,
+        {
+            "path": "serve_wave_fusion",
+            "profile": prof,
+            "requests": n_requests,
+            "tenants": n_specs,
+            "requests_per_tenant": per_spec,
+            "n_specs": n_specs,
+            "replications_per_request": req_r,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "measure": mreport.to_json(),
+            "fusion": {
+                "arms": arm_detail,
+                "occupancy_ratio_on_vs_off": occ_ratio,
+                "events_ratio_on_vs_off": ev_ratio,
+                "compiles_in_timed_rounds": compiled_in_timed,
+                "digest_anchors": {
+                    "checked": digest_checked, "equal": digest_equal,
+                },
+                "program_size": fused_size_detail,
+            },
+            "program_cache": cache.stats(),
+        },
+    )
+
+
 def bench_serve_preempt():
     """The preemptive device scheduler vs run-to-completion dispatch
     at the SAME offered load (docs/24_device_scheduler.md): one long
@@ -3031,13 +3341,15 @@ def bench_tune():
         Schedule(chunk_steps=4096),
     ]
 
-    def one(name, spec, params, reps, warm_params, t_end=None):
+    def one(name, spec, params, reps, warm_params, t_end=None,
+            candidates=None, runner=None):
         _heartbeat()
         rep = _tune.search_schedule(
             spec, params, reps,
-            candidates=cands, seed=2026, t_end=t_end,
+            candidates=candidates if candidates is not None else cands,
+            seed=2026, t_end=t_end,
             warm_params=warm_params, repeats=repeats, budget_s=budget,
-            out_dir=out_dir, workload_label=name,
+            out_dir=out_dir, workload_label=name, runner=runner,
             on_round=lambda r: _heartbeat(),
         )
         _heartbeat()
@@ -3083,6 +3395,87 @@ def bench_tune():
             t_end=float(os.environ.get(
                 "CIMBA_BENCH_TUNE_PROBE_T", str(_tprobe.DEFAULT_T_END)
             )),
+        )
+        # third workload: the device-scheduler policy knobs
+        # (docs/24_device_scheduler.md), invisible to the direct
+        # stream path — the serve-backed runner hook races each
+        # candidate through the same preempt-shaped contention load
+        # (one long low-priority background + an urgent burst).  The
+        # bitwise pin rides the serve contract: per-request results
+        # never depend on scheduling policy, so every arm's merged
+        # payload digests equal and only the wall moves.  A "tuned"
+        # decision persists waves_per_device/preempt_quantum/
+        # mem_fraction into the store manifest like any other knob,
+        # and Service adopts them at submit time.
+        from cimba_tpu import serve as _serve
+
+        ds_wave = 1024 if _accel() else 16
+        ds_chunk = 256 if _accel() else 32
+        ds_r = max(ds_wave // 4, 1)
+        n_ds = 2000 if _accel() else 50
+        bg_objs, ur_objs = 100 * n_ds, 2 * n_ds
+        ds_cache = _serve.ProgramCache()
+        ds_cands = [
+            Schedule(),
+            Schedule(waves_per_device=2),
+            Schedule(waves_per_device=4),
+            Schedule(preempt_quantum=1),
+            Schedule(preempt_quantum=8),
+            Schedule(mem_fraction=0.6),
+        ]
+
+        class _Merged:
+            """StreamResult-shaped merge of one contention round, in
+            submission order — what the pin digests and the rate
+            counts events from."""
+
+            def __init__(self, results):
+                self.summary = tuple(r.summary for r in results)
+                self.n_failed = sum(int(r.n_failed) for r in results)
+                self.total_events = sum(
+                    int(r.total_events) for r in results
+                )
+                self.metrics = None
+
+        def _ds_req(n_obj, seed, t_end, prio, label):
+            return _serve.Request(
+                spec, mm1.params(n_obj), ds_r, seed=seed, t_end=t_end,
+                wave_size=ds_r, chunk_steps=ds_chunk, priority=prio,
+                label=label,
+            )
+
+        def ds_runner(sched, warm=False):
+            svc = _serve.Service(
+                max_wave=ds_wave, cache=ds_cache, device_sched=True,
+                waves_per_device=sched.waves_per_device,
+                preempt_quantum=sched.preempt_quantum,
+                mem_fraction=sched.mem_fraction,
+                refill_every=2, horizon_bucket=16.0, pad_waves=False,
+                on_chunk=_heartbeat,
+            )
+            try:
+                bg = svc.submit(_ds_req(bg_objs, 1, 60000.0, 0, "bg"))
+                # urgents must land against a RUNNING wave or there
+                # is no scheduling decision to measure
+                deadline = time.monotonic() + 120
+                while (svc.stats()["lane_occupancy"]["lanes_in_wave"]
+                       == 0 and time.monotonic() < deadline):
+                    time.sleep(0.002)
+                urs = [
+                    svc.submit(_ds_req(
+                        ur_objs, 11 + i % 3, 60.0, 10, f"ur{i}"
+                    ))
+                    for i in range(6)
+                ]
+                results = [h.result(600) for h in urs]
+                results.append(bg.result(600))
+            finally:
+                svc.shutdown()
+            return _Merged(results)
+
+        rep_ds, detail["workloads"]["device_sched"] = one(
+            "device_sched", spec, mm1.params(bg_objs), ds_r,
+            None, candidates=ds_cands, runner=ds_runner,
         )
     best = max(
         detail["workloads"].values(), key=lambda w: w["speedup_frac"],
@@ -3198,6 +3591,7 @@ CONFIGS = {
     "serve_mixed": bench_serve_mixed,
     "serve_preempt": bench_serve_preempt,
     "serve_refill": bench_serve_refill,
+    "serve_fused": bench_serve_fused,
     "mmc": bench_mmc,
     "mg1": bench_mg1,
     "sweep": bench_sweep,

@@ -287,6 +287,22 @@ GATES: Tuple[Gate, ...] = (
         ambient_env={"CIMBA_DEVICE_SCHED": "1"},
         off_env={"CIMBA_DEVICE_SCHED": "0"},
     ),
+    Gate(
+        name="wave_fuse",
+        env=("CIMBA_WAVE_FUSE",),
+        program="chunk",
+        # cross-spec wave fusion (docs/26_wave_fusion.md) is, like
+        # refill, a HOST-side packing policy: the knob selects whether
+        # the serve dispatcher groups compatible-shape specs into
+        # fused waves, and must never bind into a traced chunk
+        # program — a single-spec wave runs the SAME chunk program
+        # whether fusion is on or off (the fused superprogram is a
+        # separate compile on the merged spec, and only forms when a
+        # wave actually spans >1 exact class).  No ON arm: no
+        # chunk-program state to flip.
+        ambient_env={"CIMBA_WAVE_FUSE": "1"},
+        off_env={"CIMBA_WAVE_FUSE": "0"},
+    ),
 )
 
 

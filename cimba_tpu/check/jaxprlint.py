@@ -44,8 +44,10 @@ from cimba_tpu.check import Finding
 
 __all__ = [
     "BANNED_PRIMITIVES", "GATHER_BUDGET", "EQN_BUDGET",
+    "FUSED_EQN_FACTOR",
     "donation_findings", "purity_findings", "weak_type_findings",
-    "size_findings", "check_programs", "collect_primitives",
+    "size_findings", "fused_size_findings", "check_programs",
+    "collect_primitives",
 ]
 
 #: primitives that must never appear in a chunk program (host
@@ -68,6 +70,16 @@ GATHER_BUDGET: Dict[str, int] = {}
 #: multiplies the count by table height) cannot.  Raise only with a
 #: program_size measurement justifying the new floor.
 EQN_BUDGET: Dict[str, int] = {"mm1": 11000, "awacs": 6000}
+
+#: JXL004 sublinearity factor for fused superprograms
+#: (docs/26_wave_fusion.md): a K-member fused chunk program's equation
+#: count must stay under this fraction of the SUM of the K members'
+#: solo counts — the members share ONE copy of the machinery (event
+#: heap, guards, queues; the bulk of every chunk program) and only
+#: their block tables concatenate, so the merged program must be far
+#: sublinear in K.  Linear growth here means the machinery duplicated
+#: per member — the compile wall fusion exists to avoid.
+FUSED_EQN_FACTOR = 0.6
 
 _ALIAS_MARKER = re.compile(r"tf\.aliasing_output")
 
@@ -191,6 +203,33 @@ def size_findings(
                 "over table rows or an unrolled scan, or raise "
                 "check.jaxprlint.EQN_BUDGET with a program_size "
                 "measurement justifying the new floor"
+            ),
+        )]
+    return []
+
+
+def fused_size_findings(
+    fused_eqns: int, solo_eqns, label: str,
+) -> List[Finding]:
+    """JXL004 for one fused superprogram: the merged chunk program's
+    equation count against ``FUSED_EQN_FACTOR`` x the sum of its
+    members' solo counts (``solo_eqns`` — one entry per member).  The
+    budget is derived, not tabled: it scales with whatever the members
+    actually cost, so the pinned claim is pure SUBLINEARITY."""
+    budget = int(sum(int(n) for n in solo_eqns) * FUSED_EQN_FACTOR)
+    n = int(fused_eqns)
+    if n > budget:
+        return [Finding(
+            rule="JXL004", path=f"program:{label}", line=0,
+            message=(
+                f"fused superprogram has {n} jaxpr equations — over "
+                f"{FUSED_EQN_FACTOR}x the {sum(int(x) for x in solo_eqns)}"
+                "-eqn sum of its members' solo programs (budget "
+                f"{budget}).  Fusion must share one machinery copy "
+                "and concatenate only block tables "
+                "(docs/26_wave_fusion.md); near-linear growth means "
+                "per-member duplication — the compile wall fusion "
+                "exists to avoid"
             ),
         )]
     return []

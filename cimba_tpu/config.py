@@ -201,6 +201,19 @@ ENV_KNOBS = {
         doc="row-block height for the scan-over-rows table dispatch "
             "(sublane-friendly multiple; axes <= the block stay dense)",
     ),
+    "CIMBA_WAVE_FUSE": dict(
+        default="", trace_gate=True,
+        doc="cross-spec wave fusion (docs/26_wave_fusion.md): =1 makes "
+            "Service(fuse=None) pack compatible-shape DIFFERENT specs "
+            "into one fused wave whose init/refill lax.switch each "
+            "lane through its own member's model on a per-lane "
+            "spec-id column.  Off (the default) every wave stays "
+            "single-class and traces the character-identical "
+            "historical programs (the 'wave_fuse' gate in "
+            "check/gates.py pins ambient inertness); on, a member "
+            "lane's trajectory is bitwise its solo per-spec wave's "
+            "(core/fuse.py has the argument)",
+    ),
     "CIMBA_DEVICE_SCHED": dict(
         default="", trace_gate=True,
         doc="preemptive device scheduler "

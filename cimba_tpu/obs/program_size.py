@@ -86,6 +86,27 @@ def measure(fn, *avals, lower: bool = True) -> ProgramSize:
     )
 
 
+def fused_program_size(
+    specs, params=(), *, lanes: int = 4, max_steps: int = 64,
+    profile: Optional[str] = None, seed: int = 2026, lower: bool = True,
+) -> ProgramSize:
+    """Probe the fused superprogram of ``specs``
+    (docs/26_wave_fusion.md): merge them through
+    :func:`cimba_tpu.core.fuse.fuse_specs` and measure the merged
+    spec's chunk program.  This is THE number the fusion trade buys
+    its occupancy with — the JXL004 sublinearity budget
+    (:func:`cimba_tpu.check.jaxprlint.fused_size_findings`) holds it
+    under a fraction of the members' solo-program sum (the machinery
+    is shared; only the block tables concatenate)."""
+    from cimba_tpu.core import fuse as _fuse
+
+    fused = _fuse.fuse_specs(specs)
+    return chunk_program_size(
+        fused.spec, params, lanes=lanes, max_steps=max_steps,
+        profile=profile, seed=seed, lower=lower,
+    )
+
+
 def chunk_program_size(
     spec, params=(), *, lanes: int = 4, max_steps: int = 64,
     profile: Optional[str] = None, seed: int = 2026, lower: bool = True,

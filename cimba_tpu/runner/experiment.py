@@ -430,6 +430,57 @@ def _refill_program(spec: ModelSpec, mesh: Optional[Mesh]):
     return jax.jit(refill, donate_argnums=(0,))
 
 
+def _fused_init_program(fused, mesh: Optional[Mesh]):
+    """The fused twin of :func:`_init_program`: ``init(reps, seeds,
+    t_stops, sids, params) -> batched Sim`` with a per-lane ``sids``
+    (spec-id) column switching each lane's ``init_sim`` through its own
+    member spec (:func:`cimba_tpu.core.fuse.make_fused_init`,
+    docs/26_wave_fusion.md).  Fused waves ALWAYS materialize the
+    horizon column — the refill splice and lane reclamation need it —
+    which is bitwise-safe (``t_stop=t_end`` reproduces the static
+    cond's decisions and no result reads the leaf)."""
+    from cimba_tpu.core.fuse import make_fused_init
+
+    init = make_fused_init(fused)
+    if mesh is not None:
+        init = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(REP_AXIS), P(REP_AXIS), P(REP_AXIS), P(REP_AXIS),
+                P(REP_AXIS),
+            ),
+            out_specs=P(REP_AXIS),
+            check_vma=False,
+        )(init)
+    return jax.jit(init)
+
+
+def _fused_refill_program(fused, mesh: Optional[Mesh]):
+    """The fused twin of :func:`_refill_program`: ``refill(sims, mask,
+    reps, seeds, t_stops, sids, params) -> sims``
+    (:func:`cimba_tpu.core.fuse.make_fused_refill`), jitted with the
+    batched Sim DONATED like its solo twin.  One program serves every
+    member of the fusion class, so a boundary splice admitting ANY
+    member is a cached dispatch, never a compile
+    (docs/26_wave_fusion.md)."""
+    from cimba_tpu.core.fuse import make_fused_refill
+
+    refill = make_fused_refill(fused)
+    if mesh is not None:
+        refill = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(REP_AXIS), P(REP_AXIS), P(REP_AXIS), P(REP_AXIS),
+                P(REP_AXIS), P(REP_AXIS), P(REP_AXIS),
+            ),
+            out_specs=P(REP_AXIS),
+            check_vma=False,
+        )(refill)
+    return jax.jit(refill, donate_argnums=(0,))
+
+
 def _live_program(spec: ModelSpec, mesh: Optional[Mesh]):
     """One compiled per-lane liveness readback: ``live(sims) ->
     bool[L]`` (:func:`cimba_tpu.core.loop.make_lanes_live`) — NOT

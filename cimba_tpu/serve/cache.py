@@ -441,6 +441,197 @@ def get_refill_programs(
     return _get_or_create(programs, key, build)[:2]
 
 
+# -- the fusion rung (docs/26_wave_fusion.md) --------------------------------
+#
+# The class ladder grows a SECOND rung above the exact compatibility
+# class: a **fusion class** groups compatible-shape specs
+# (core/fuse.fusion_shape_key + a shared Sim-structure signature) so
+# cross-spec requests can share ONE compiled superprogram.  The merged
+# spec is a real ModelSpec, so its chunk program, store entries and
+# program-size probes ride the existing machinery unchanged; only init
+# and refill need fused twins (a per-lane spec-id switch).
+
+
+def fusion_order_key(spec) -> str:
+    """Canonical member ordering for fused bundles: members sort by the
+    VALUE-based ``stable_spec_fingerprint`` digest (docs/15), so the
+    same member SET always builds the same merged table — and hence the
+    same compiled superprogram — regardless of arrival order.  A spec
+    that resists value fingerprinting falls back to an in-process key
+    (name + id): deterministic within the process, which is all the
+    ordering needs (programs cache per process)."""
+    cached = getattr(spec, "_cimba_fusion_order", None)
+    if cached is not None:
+        return cached
+    import hashlib
+
+    from cimba_tpu.serve import store as _pstore
+
+    try:
+        key = "s:" + hashlib.sha256(
+            repr(_pstore.stable_spec_fingerprint(spec)).encode("utf-8")
+        ).hexdigest()
+    except Exception:
+        key = f"u:{spec.name}:{id(spec):x}"
+    try:
+        object.__setattr__(spec, "_cimba_fusion_order", key)
+    except (AttributeError, TypeError):
+        pass
+    return key
+
+
+def sim_structure_sig(
+    programs: MutableMapping,
+    spec,
+    params,
+    n_replications: int,
+    with_metrics: bool,
+    *,
+    mesh,
+    pack,
+) -> tuple:
+    """The full Sim STRUCTURE signature of one lane of this request —
+    treedef plus per-leaf (lane-row shape, dtype) from ``eval_shape``
+    over the init program (no device work).  The fusion class embeds it
+    so two specs only ever share a fused wave when their lanes' pytrees
+    are identical — a structure mismatch lands in a different fusion
+    class instead of exploding inside ``lax.switch`` at trace time
+    (docs/26_wave_fusion.md).  Memoized beside the programs it guards."""
+    key = ("sim_sig",) + program_class_key(
+        spec, with_metrics, mesh=mesh, pack=pack,
+    ) + (_params_sig(params, n_replications),)
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from cimba_tpu.core.loop import init_sim
+        from cimba_tpu.runner import experiment as ex
+
+        def one_lane():
+            reps = jnp.arange(0, 1)
+            seeds = ex._seed_column(0, 1)
+            ts = ex._horizon_column(None, 1)
+            pw = ex._slice_params(params, n_replications, 0, 1)
+            return jax.vmap(
+                lambda r, s, t, q: init_sim(spec, s, r, q, t_stop=t)
+            )(reps, seeds, ts, pw)
+
+        sim = jax.eval_shape(one_lane)
+        leaves, treedef = jax.tree.flatten(sim)
+        sig = (
+            str(treedef),
+            tuple(
+                (tuple(l.shape[1:]), str(l.dtype)) for l in leaves
+            ),
+        )
+        return (sig, spec)  # pins the fingerprint's ids while cached
+
+    return _get_or_create(programs, key, build)[0]
+
+
+def _params_sig(params, n_replications: int) -> tuple:
+    """The params-row tree signature (treedef + per-lane leaf shapes and
+    dtypes) — the same signature ``request_class_key`` embeds, shared
+    here so the fusion class keys it identically."""
+    import jax
+
+    from cimba_tpu.runner import experiment as ex
+
+    row = jax.eval_shape(
+        lambda: ex._slice_params(params, n_replications, 0, 1)
+    )
+    leaves, treedef = jax.tree.flatten(row)
+    return (
+        str(treedef),
+        tuple((tuple(l.shape[1:]), str(l.dtype)) for l in leaves),
+    )
+
+
+def get_fused(programs: MutableMapping, specs) -> "object":
+    """The cached fused bundle (:class:`cimba_tpu.core.fuse.FusedSpec`)
+    for an ORDERED member tuple.  Caching the bundle — not just its
+    programs — is load-bearing: :func:`cimba_tpu.core.fuse.fuse_specs`
+    creates fresh rebasing wrappers per call, so an uncached re-fuse
+    would mint a fresh merged fingerprint and recompile everything.
+    One bundle per member tuple makes the merged spec's fingerprint
+    stable for the life of the cache entry (which pins every member)."""
+    from cimba_tpu.core import fuse as _fuse
+
+    specs = tuple(specs)
+    key = ("fuse_bundle",) + tuple(spec_fingerprint(s) for s in specs)
+
+    def build():
+        return (_fuse.fuse_specs(specs),)
+
+    return _get_or_create(programs, key, build)[0]
+
+
+def get_fused_wave_programs(
+    programs: MutableMapping,
+    fused,
+    *,
+    mesh,
+    pack,
+    chunk_steps: int,
+    with_metrics: bool,
+):
+    """The fused wave's compiled pair: ``(finit_j, chunk_j)``.  The
+    chunk program is the ORDINARY :func:`get_programs` entry for the
+    merged superspec (block dispatch is already a per-lane pc switch,
+    so the merged table needs no special chunk program — and the store,
+    warmers and program-size probes all see a normal spec); only init
+    is fused (``runner.experiment._fused_init_program`` — the per-lane
+    spec-id switch, docs/26_wave_fusion.md)."""
+    init_key = ("fused_init",) + program_class_key(
+        fused.spec, with_metrics, mesh=mesh, pack=pack,
+    )
+
+    def build():
+        from cimba_tpu.runner import experiment as ex
+
+        return (
+            ex._fused_init_program(fused, mesh),
+            fused,  # pins members + merged fingerprints while cached
+        )
+
+    finit_j = _get_or_create(programs, init_key, build)[0]
+    _, chunk_j = get_programs(
+        programs, fused.spec, mesh=mesh, pack=pack,
+        chunk_steps=chunk_steps, with_metrics=with_metrics,
+    )
+    return finit_j, chunk_j
+
+
+def get_fused_refill_programs(
+    programs: MutableMapping,
+    fused,
+    *,
+    mesh,
+    pack,
+    with_metrics: bool,
+):
+    """The fused refill plane's compiled pair: ``(frefill_j, live_j)``
+    — the spec-id-switched lane splice and the per-lane liveness
+    readback.  Liveness is member-independent (``make_cond`` reads
+    horizon/done/err, never the block table), so the merged spec's
+    ordinary live program serves every member's lanes."""
+    key = ("fused_refill",) + program_class_key(
+        fused.spec, with_metrics, mesh=mesh, pack=pack,
+    )
+
+    def build():
+        from cimba_tpu.runner import experiment as ex
+
+        return (
+            ex._fused_refill_program(fused, mesh),
+            ex._live_program(fused.spec, mesh),
+            fused,  # pins members + merged fingerprints while cached
+        )
+
+    return _get_or_create(programs, key, build)[:2]
+
+
 #: conservative working-set multiplier when no measured program
 #: footprint is available: the chunk program donates its carry, so the
 #: steady state holds roughly input + output + XLA temps — 3x the lane
@@ -618,6 +809,25 @@ def _fold_program(with_metrics: bool, summary_path):
     return jax.jit(fold)
 
 
+def get_gather(programs: MutableMapping):
+    """The jitted lane-gather the fold sites slice waves with: ONE
+    compiled program per (Sim structure, index shape) instead of a
+    per-leaf eager dispatch chain — a wave Sim is ~40 leaves, and 40
+    eager ``x[idx]`` dispatches cost ~1 ms each on a loaded host, so
+    the gather (not the fold, which is already jitted) was the serve
+    dispatcher's per-retirement wall.  Pure integer indexing: the
+    gathered leaves are bitwise the eager slice, so every fold
+    downstream stays bitwise its direct call's."""
+    def build():
+        import jax
+
+        return jax.jit(
+            lambda sims, idx: jax.tree.map(lambda x: x[idx], sims)
+        )
+
+    return _get_or_create(programs, ("gather",), build)
+
+
 def stream_acc(spec, with_metrics: bool):
     """A zeroed accumulator tuple for :func:`get_fold`'s program:
     ``(Summary, n_failed i64, total_events i64[, Metrics])``."""
@@ -730,10 +940,16 @@ def warm(
     from cimba_tpu.runner import experiment as ex
 
     if manifest is None:
-        return ex.run_experiment_stream(
+        res = ex.run_experiment_stream(
             spec, params, wave_size, wave_size=wave_size,
             program_cache=cache, **stream_kwargs,
         )
+        # the serve fold sites slice waves through the jitted lane
+        # gather — a once-per-cache program the direct stream path
+        # never builds; prime it so the warmed service's first
+        # retirement is a cache hit, not a compile
+        get_gather(cache)
+        return res
 
     from cimba_tpu.obs import metrics as _metrics
     from cimba_tpu.serve import store as _pstore
@@ -823,4 +1039,5 @@ def warm(
                 ex._slice_params(params, n, 0, n),
             )
             fold_j(stream_acc(spec, with_metrics), sims0)
+    get_gather(cache)
     return st

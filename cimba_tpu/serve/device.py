@@ -544,10 +544,18 @@ class DeviceScheduler:
         self._update_gauges()
 
     def _maybe_restore(self) -> None:
-        """Restore the oldest-preempted wave when a slot AND budget
-        free up.  With NO running wave the head restores
-        unconditionally (it fit when admitted; holding it back could
-        deadlock the device idle)."""
+        """Restore the most-urgent preempted wave when a slot AND
+        budget free up — priority order (max live-member priority),
+        NOT eviction order: an urgent wave preempted under earlier
+        pressure must come back before a background wave that merely
+        got evicted first.  Ties break deterministically by
+        ``fmix64(batch_no)`` (the obs/audit.py host mixer — arbitrary
+        but stable, so equal-priority restore order is reproducible
+        and owes nothing to list position).  With NO running wave the
+        pick restores unconditionally (it fit when admitted; holding
+        it back could deadlock the device idle)."""
+        from cimba_tpu.obs.audit import _fmix64_host
+
         running = self._running()
         if len(running) >= self.waves_per_device():
             return
@@ -556,7 +564,12 @@ class DeviceScheduler:
         ]
         if not preempted:
             return
-        task = preempted[0]
+        task = max(
+            preempted,
+            key=lambda t: (
+                t.priority(), _fmix64_host(t.wave.batch_no),
+            ),
+        )
         if running:
             used = sum(t.footprint for t in running)
             if used + task.footprint > self.budget_bytes():

@@ -131,6 +131,41 @@ def request_class_key(request, with_metrics: bool, *, mesh,
     return (pck, sig, horizon_bucket_of(request.t_end, horizon_bucket))
 
 
+def fusion_class_key(request, with_metrics: bool, *, cache, mesh,
+                     horizon_bucket) -> tuple:
+    """The SECOND rung of the class ladder — what may share a **fused**
+    wave (docs/26_wave_fusion.md): the spec's structural-geometry key
+    with the model identity erased (``core.fuse.fusion_shape_key``),
+    the full one-lane Sim STRUCTURE signature (user state, metrics and
+    trace leaves, dtypes — so ``lax.switch`` branch structures can
+    never mismatch), the params-row signature, the resolved trace-time
+    globals, the mesh, and the horizon bucket.  Everything the exact
+    class treats as per-lane data stays per-lane data here; what the
+    exact class keys by *model identity* this key keys by model
+    *shape*.  Raises :class:`cimba_tpu.core.fuse.FusionError` for
+    structurally unfusable specs (spawn pools, boundary blocks) —
+    callers treat that as "exact class only"."""
+    from cimba_tpu import config as _config
+    from cimba_tpu.core import fuse as _fuse
+    from cimba_tpu.obs import trace as _trace
+
+    shape = _fuse.fusion_shape_key(request.spec)
+    sim_sig = _pcache.sim_structure_sig(
+        cache, request.spec, request.params, request.n_replications,
+        with_metrics, mesh=mesh, pack=request.pack,
+    )
+    psig = _pcache._params_sig(request.params, request.n_replications)
+    return (
+        shape, sim_sig, psig,
+        _config.active_profile(), bool(with_metrics),
+        request.pack if request.pack is not None
+        else _config.xla_pack_enabled(),
+        _trace.enabled(),
+        _config.eventset_hier_enabled(), _config.eventset_block(),
+        mesh, horizon_bucket_of(request.t_end, horizon_bucket),
+    )
+
+
 @dataclass
 class Request:
     """One experiment request — the arguments of a direct
@@ -200,6 +235,7 @@ class _Entry:
         "cancelled", "in_flight", "submit_t", "first_dispatch_t",
         "deadline_at", "done", "result", "exc", "result_digest",
         "trace", "span_root", "span_queue", "span_wave",
+        "fuse_cls", "spec_fp",
     )
 
     def __init__(self, request, seq, cls, eff_wave, with_metrics):
@@ -233,6 +269,12 @@ class _Entry:
         self.span_root = None
         self.span_queue = None
         self.span_wave = None
+        # wave fusion (docs/26_wave_fusion.md): the fusion-class key
+        # and the spec's in-memory fingerprint — both None unless the
+        # service has fusion on AND the spec is fusable AND it joined
+        # the class's member roster at submit
+        self.fuse_cls = None
+        self.spec_fp = None
 
 
 class ResultHandle:
@@ -303,6 +345,14 @@ _DEVSCHED_COUNTERS = (
     "mem_rejects",
 )
 
+#: wave-fusion counters (docs/26_wave_fusion.md) — grouped in
+#: ``stats()["fusion"]``: batches/waves actually dispatched through a
+#: fused superprogram, the lanes they carried, and submits whose spec
+#: could not join a fusion class (unfusable structure or a full roster)
+_FUSION_COUNTERS = (
+    "fused_batches", "fused_waves", "fused_lanes", "fusion_rejects",
+)
+
 
 class _RefillSlot:
     """One request slot's lane ownership inside a refill-driven wave:
@@ -330,6 +380,7 @@ class _RefillWave:
     __slots__ = (
         "cls", "slots", "free", "L", "batch_no", "no_admit",
         "init_j", "chunk_j", "refill_j", "live_j", "pad_row",
+        "fused", "sid_of",
     )
 
     def __init__(self, cls, no_admit):
@@ -344,6 +395,16 @@ class _RefillWave:
         self.refill_j = None
         self.live_j = None
         self.pad_row = None
+        # wave fusion (docs/26_wave_fusion.md): the FusedSpec bundle
+        # this wave was born with (None = an ordinary single-spec
+        # wave) and the member-fingerprint -> spec_id map the boundary
+        # controller admits against.  The member set is FIXED at
+        # birth: only specs in ``sid_of`` may splice in later (one
+        # compiled superprogram per wave — a splice is never a
+        # compile), so a roster that grew after birth reaches lanes
+        # only through the next wave.
+        self.fused = None
+        self.sid_of = None
 
 
 class Service:
@@ -419,7 +480,7 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
-    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples, _waves_live, _est_free_mem, _waves_per_device, _preempt_quantum, _mem_fraction, _mem_budget_bytes
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples, _waves_live, _est_free_mem, _waves_per_device, _preempt_quantum, _mem_fraction, _mem_budget_bytes, _fuse_roster, _fuse_max_specs
 
     def __init__(
         self,
@@ -438,6 +499,8 @@ class Service:
         telemetry=None,
         refill: Optional[bool] = None,
         refill_every: Optional[int] = None,
+        fuse: Optional[bool] = None,
+        fuse_max_specs: Optional[int] = None,
         device_sched: Optional[bool] = None,
         waves_per_device: Optional[int] = None,
         preempt_quantum: Optional[int] = None,
@@ -475,6 +538,39 @@ class Service:
         self.refill_every = max(
             int(poll_every if refill_every is None else refill_every), 1
         )
+        # cross-spec wave fusion (docs/26_wave_fusion.md): None defers
+        # to the CIMBA_WAVE_FUSE env knob (unset = off — the historical
+        # one-spec-per-wave packer, byte for byte; the 'wave_fuse'
+        # trace gate pins this).  A host-side dispatch policy like
+        # refill/device_sched: ON, cross-spec requests of one fusion
+        # class share a compiled superprogram whose per-lane spec-id
+        # column switches each lane through its own model's blocks.
+        # ``fuse_max_specs`` left None adopts a tuned schedule's value
+        # at submit time, else tune.space.DEFAULT_FUSE_MAX_SPECS.
+        self._fuse_unset = (
+            fuse is None and _config.env_raw("CIMBA_WAVE_FUSE") == ""
+        )
+        self.fuse = (
+            _config.env_raw("CIMBA_WAVE_FUSE") == "1" if fuse is None
+            else bool(fuse)
+        )
+        self._fuse_max_specs = (
+            None if fuse_max_specs is None else int(fuse_max_specs)
+        )
+        if self._fuse_max_specs is not None and self._fuse_max_specs < 2:
+            raise ValueError(
+                f"fuse_max_specs must be >= 2 (a fusion needs two "
+                f"members to exist): {fuse_max_specs}"
+            )
+        # the fusion rosters: fusion-class key -> {spec fingerprint:
+        # spec}, insertion-ordered, capped at the effective
+        # fuse_max_specs.  The roster BINDS AT FIRST SIGHT: the first
+        # fuse_max_specs distinct specs of a class are its members for
+        # the service's life, so every fused wave of the class runs the
+        # SAME superprogram (stable bundle -> zero steady-state
+        # compiles); later distinct specs serve unfused.  Guarded by
+        # the service lock.
+        self._fuse_roster: dict = {}
         # the preemptive device scheduler (docs/24_device_scheduler.md):
         # None defers to the CIMBA_DEVICE_SCHED env knob (unset = off).
         # On, the dispatcher thread delegates to
@@ -544,6 +640,8 @@ class Service:
         for o in _REFILL_COUNTERS:
             self._counters[o] = 0
         for o in _DEVSCHED_COUNTERS:
+            self._counters[o] = 0
+        for o in _FUSION_COUNTERS:
             self._counters[o] = 0
         # per-chunk live-lane occupancy samples: (live, lanes_in_wave)
         # pairs appended at every chunk boundary — ``live`` is a host
@@ -660,6 +758,28 @@ class Service:
 
         with_metrics = _metrics.enabled()
         cls = self._class_key(request, with_metrics)
+        # tuned fuse knobs adopt BEFORE the fusion class binds (a
+        # schedule flipping fusion on must affect this very request);
+        # device-sched knobs keep their historical adoption gate
+        if rs.schedule is not None:
+            with self._lock:
+                if self.device_sched:
+                    self._adopt_sched_knobs(rs.schedule)
+                self._adopt_fuse_knobs(rs.schedule)
+        # the fusion-class key (docs/26_wave_fusion.md) computes OUTSIDE
+        # the lock — its Sim-structure signature eval_shapes on a cold
+        # cache — and the roster binds under the lock below
+        fuse_cls = None
+        if self.fuse:
+            from cimba_tpu.core import fuse as _fuse_mod
+
+            try:
+                fuse_cls = fusion_class_key(
+                    request, with_metrics, cache=self.cache,
+                    mesh=self.mesh, horizon_bucket=self.horizon_bucket,
+                )
+            except _fuse_mod.FusionError:
+                fuse_cls = None
         with self._lock:
             if self._closed:
                 raise ServiceClosed(
@@ -674,10 +794,10 @@ class Service:
                 self._sched_sources.get(rs.source, 0) + 1
             )
             self._schedules[label] = rs.block()
-            if self.device_sched and rs.schedule is not None:
-                self._adopt_sched_knobs(rs.schedule)
             entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
+            if self.fuse:
+                self._bind_fusion(entry, fuse_cls)
             self._outstanding += 1
         rec = self._tel.spans if self._tel is not None else None
         if rec is not None:
@@ -844,6 +964,19 @@ class Service:
             }
             for k in _DEVSCHED_COUNTERS:
                 out["device_sched"][k] = self._counters[k]
+            # the fusion rung (docs/26_wave_fusion.md): which fusion
+            # classes formed, how full each roster is, and how much
+            # traffic actually dispatched fused vs was rejected
+            out["fusion"] = {
+                "enabled": self.fuse,
+                "fuse_max_specs": self._eff_fuse_max(),
+                "classes": len(self._fuse_roster),
+                "roster_sizes": sorted(
+                    len(r) for r in self._fuse_roster.values()
+                ),
+            }
+            for k in _FUSION_COUNTERS:
+                out["fusion"][k] = self._counters[k]
             occ_samples = list(self._occ_samples)
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
@@ -1136,6 +1269,69 @@ class Service:
                 and sched.mem_fraction is not None:
             self._mem_fraction = float(sched.mem_fraction)
 
+    # cimba-check: assume-held
+    def _adopt_fuse_knobs(self, sched) -> None:
+        """Adopt a tuned schedule's wave-fusion knobs
+        (docs/26_wave_fusion.md): ``fuse`` fills in only when BOTH the
+        constructor and the ``CIMBA_WAVE_FUSE`` env left it unset
+        (explicit policy always wins), ``fuse_max_specs`` when the
+        constructor left it None — and as with the device-scheduler
+        knobs, the first adopted value sticks.  Caller holds the
+        service lock."""
+        if self._fuse_unset and getattr(sched, "fuse", None) is not None:
+            self.fuse = bool(sched.fuse)
+            self._fuse_unset = False
+        if self._fuse_max_specs is None \
+                and getattr(sched, "fuse_max_specs", None) is not None \
+                and int(sched.fuse_max_specs) >= 2:
+            self._fuse_max_specs = int(sched.fuse_max_specs)
+
+    # cimba-check: assume-held
+    def _eff_fuse_max(self) -> int:
+        """The effective roster cap — the constructor/adopted value,
+        else the ``tune.space`` default."""
+        if self._fuse_max_specs is not None:
+            return self._fuse_max_specs
+        from cimba_tpu.tune import space as _tspace
+
+        return _tspace.DEFAULT_FUSE_MAX_SPECS
+
+    # cimba-check: assume-held
+    def _bind_fusion(self, entry: _Entry, fuse_cls) -> None:
+        """Bind one admitted entry to its fusion class: join (or match)
+        the class roster — first ``fuse_max_specs`` distinct specs win,
+        for the service's life — and stamp the entry's fusion identity.
+        A spec that cannot fuse (``fuse_cls=None``) or arrives at a
+        full roster counts a ``fusion_rejects`` and serves through its
+        exact class unchanged.  Caller holds the service lock."""
+        if fuse_cls is None:
+            self._counters["fusion_rejects"] += 1
+            return
+        fp = _pcache.spec_fingerprint(entry.request.spec)
+        roster = self._fuse_roster.setdefault(fuse_cls, {})
+        if fp not in roster:
+            if len(roster) >= self._eff_fuse_max():
+                self._counters["fusion_rejects"] += 1
+                return
+            roster[fp] = entry.request.spec
+        entry.fuse_cls = fuse_cls
+        entry.spec_fp = fp
+
+    def _fused_bundle(self, fuse_cls):
+        """The cached FusedSpec bundle for a class's CURRENT roster —
+        members in canonical (stable-fingerprint) order, so any arrival
+        order of the same member set shares one superprogram.  Requires
+        >= 2 roster members (a single-member class serves exact —
+        fusing it would shadow the historical program for no gain);
+        returns None otherwise.  Dispatcher thread only."""
+        with self._lock:
+            roster = self._fuse_roster.get(fuse_cls)
+            specs = () if roster is None else tuple(roster.values())
+        if len(specs) < 2:
+            return None
+        specs = tuple(sorted(specs, key=_pcache.fusion_order_key))
+        return _pcache.get_fused(self.cache, specs)
+
     def _loop(self) -> None:
         if self.device_sched:
             # the preemptive device scheduler
@@ -1217,14 +1413,19 @@ class Service:
                 # delivered)
                 self._serve_refill_wave(entry)
                 continue
-            slots, members = self._pack(entry)
+            slots, members, fused = self._pack(entry)
             try:
                 # the fold is inside the guard too: a summary_path whose
                 # SHAPE preflights fine but whose fold-trace raises (e.g.
                 # a non-Summary statistic fed to the Pébay merge) must
                 # fail the REQUESTS, never kill the dispatcher thread —
                 # a dead dispatcher hangs every outstanding future
-                sims = self._run_batch(slots)
+                # (the fused kwarg is only passed when set, so the
+                # retry tests' _run_batch seams keep their signature)
+                sims = (
+                    self._run_batch(slots) if fused is None
+                    else self._run_batch(slots, fused=fused)
+                )
                 self._fold_slots(slots, sims)
             except Exception as e:
                 self._batch_failed(members, e)
@@ -1237,7 +1438,13 @@ class Service:
         bitwise the direct call's), then greedily fill remaining lanes
         with queued requests of the SAME compatibility class in
         priority order (the bucket-fill policy: seed/params/R/horizon
-        mixes pack, docs/14_wave_packing.md).  The lead arrives
+        mixes pack, docs/14_wave_packing.md) — and, with fusion on and
+        the lead roster-bound, with queued requests of the lead's
+        FUSION class (docs/26_wave_fusion.md: distinct specs, one
+        switch-dispatch superprogram; returns the bundle as a third
+        result, None when the packed members stay single-spec — a
+        homogeneous wave dispatches the historical exact-class program
+        even with fusion on).  The lead arrives
         already CLAIMED (in_flight, set by the loop under the service
         lock); fill candidates are claimed here the same way — one that
         was cancelled in the gap between leaving the queue and the
@@ -1272,7 +1479,14 @@ class Service:
                 if e.deadline_at is not None and now > e.deadline_at:
                     dropped.append(e)
                     return True
-                if e.solo or e.cls != lead.cls:
+                if e.solo:
+                    return False
+                if e.cls != lead.cls and not (
+                    lead.fuse_cls is not None
+                    and e.fuse_cls == lead.fuse_cls
+                ):
+                    # neither the exact class nor (fusion on, both
+                    # roster-bound) the lead's fusion class
                     return False
                 p = plan(e)
                 if not p:
@@ -1305,6 +1519,13 @@ class Service:
             self._counters["waves"] += len(slots)
             self._counters["lanes_dispatched"] += total
             self._counters["lanes_padded"] += padded
+            # a wave is FUSED only when its members actually span more
+            # than one exact class (distinct specs); roster membership
+            # guarantees the bundle below covers every packed member
+            needs_fuse = any(m.cls != lead.cls for m in members)
+            if needs_fuse:
+                self._counters["fused_batches"] += 1
+                self._counters["fused_lanes"] += total
             k = len(members)
             self._occupancy[k] = self._occupancy.get(k, 0) + 1
             self._depth_samples.append((
@@ -1324,9 +1545,12 @@ class Service:
                     batch=batch_no,
                     members=len(members), lanes=total, padded=padded,
                 )
-        return slots, members
+        fused = (
+            self._fused_bundle(lead.fuse_cls) if needs_fuse else None
+        )
+        return slots, members, fused
 
-    def _run_batch(self, slots):
+    def _run_batch(self, slots, fused=None):
         """Dispatch ONE packed wave: init the concatenated lanes —
         per-slot replication indices, seed columns, horizon columns,
         and parameter rows, plus the dead pad lanes that quantize the
@@ -1334,7 +1558,18 @@ class Service:
         The wave runs at the LEAD's ``chunk_steps`` (chunking is
         trajectory-invariant, so mates with other budgets still get
         bitwise-exact results).  Separated out as the failure-injection
-        seam for the retry tests."""
+        seam for the retry tests.
+
+        ``fused`` (a FusedSpec bundle) switches the wave onto the
+        fusion superprogram (docs/26_wave_fusion.md): a per-slot
+        spec-id column joins the lane data, init dispatches each lane
+        through its member's own model, the chunk program is the
+        merged spec's ordinary one, and the horizon column is ALWAYS
+        materialized (bitwise-inert — ``t_stop=t_end`` reproduces the
+        static cond and no result reads the leaf).  Folds are
+        untouched: each request's slot still folds its own lanes
+        through its own fold program, so results stay bitwise the solo
+        run's."""
         import jax
         import jax.numpy as jnp
 
@@ -1363,21 +1598,47 @@ class Service:
                 "and its dispatch — the compatibility key binds at "
                 "submit time; resubmit after settling the globals"
             )
-        init_j, chunk_j = _pcache.get_programs(
-            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
-            chunk_steps=req.chunk_steps, with_metrics=lead.with_metrics,
-        )
+        if fused is None:
+            init_j, chunk_j = _pcache.get_programs(
+                self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+                chunk_steps=req.chunk_steps,
+                with_metrics=lead.with_metrics,
+            )
+            sid_of = None
+        else:
+            init_j, chunk_j = _pcache.get_fused_wave_programs(
+                self.cache, fused, mesh=self.mesh, pack=req.pack,
+                chunk_steps=req.chunk_steps,
+                with_metrics=lead.with_metrics,
+            )
+            sid_of = {
+                _pcache.spec_fingerprint(s): k
+                for k, s in enumerate(fused.members)
+            }
         # each member's summary_path preflights against ITS params
         # shapes (paths may differ — every request folds its own slice
         # through its own fold program); fingerprint-cached, so a warm
-        # cache skips the re-trace
+        # cache skips the re-trace.  On the fused path the member's
+        # spec-id is pinned into an adapter so the preflight traces the
+        # member's OWN init branch (the preflight key is per member
+        # fingerprint either way).
         seen: set = set()
         for e, _, n in slots:
             if id(e) in seen:
                 continue
             seen.add(id(e))
+            if fused is None:
+                member_init = init_j
+            else:
+                sid = self._entry_sid(sid_of, e)
+
+                def member_init(r, s, t, p, _f=init_j, _sid=sid):
+                    return _f(
+                        r, s, t, jnp.full(r.shape, _sid, jnp.int32), p,
+                    )
+
             _pcache.preflight_summary_path(
-                self.cache, e.request.spec, init_j,
+                self.cache, e.request.spec, member_init,
                 e.request.summary_path, e.request.params,
                 e.request.n_replications, n, e.with_metrics,
             )
@@ -1386,13 +1647,20 @@ class Service:
         seeds = [
             ex._seed_column(e.request.seed, n) for e, _, n in slots
         ]
-        if pad == 0 and all(
+        sids = (
+            None if fused is None else [
+                jnp.full((n,), self._entry_sid(sid_of, e), jnp.int32)
+                for e, _, n in slots
+            ]
+        )
+        if fused is None and pad == 0 and all(
             e.request.t_end is None for e, _, n in slots
         ):
             # unpadded all-run-to-completion wave: omit the t_stop leaf
             # entirely, like the direct stream path — the chunk cond
             # then skips the per-event horizon check (same program key;
-            # jit re-specializes per pytree structure)
+            # jit re-specializes per pytree structure).  Fused waves
+            # always carry the column (one program per class).
             t_stops = None
         else:
             t_stops = [
@@ -1414,6 +1682,10 @@ class Service:
             reps.append(jnp.zeros((pad,), reps[0].dtype))
             seeds.append(ex._seed_column(0, pad))
             t_stops.append(jnp.full((pad,), -jnp.inf, t_stops[0].dtype))
+            if sids is not None:
+                # dead lanes dispatch no events; member 0's init runs
+                # on them only to produce a valid (masked-off) row
+                sids.append(jnp.zeros((pad,), jnp.int32))
             row0 = ex._slice_params(
                 req.params, req.n_replications, 0, 1
             )
@@ -1424,6 +1696,7 @@ class Service:
         if len(reps) == 1:
             reps_cat, seed_cat, pw_cat = reps[0], seeds[0], pws[0]
             ts_cat = None if t_stops is None else t_stops[0]
+            sid_cat = None if sids is None else sids[0]
         else:
             reps_cat = jnp.concatenate(reps, axis=0)
             seed_cat = jnp.concatenate(seeds, axis=0)
@@ -1431,10 +1704,16 @@ class Service:
                 None if t_stops is None
                 else jnp.concatenate(t_stops, axis=0)
             )
+            sid_cat = (
+                None if sids is None else jnp.concatenate(sids, axis=0)
+            )
             pw_cat = jax.tree.map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *pws
             )
-        sims = init_j(reps_cat, seed_cat, ts_cat, pw_cat)
+        sims = (
+            init_j(reps_cat, seed_cat, ts_cat, pw_cat) if fused is None
+            else init_j(reps_cat, seed_cat, ts_cat, sid_cat, pw_cat)
+        )
         on_chunk = self._on_chunk
         tel = self._tel
         if tel is not None:
@@ -1465,12 +1744,20 @@ class Service:
         # size/miss accounting ("a warmed service adds no program
         # entries" is a pinned contract); dispatcher-thread only, and
         # each entry pins its spec (the class key embeds function ids)
-        ent = self._live_cache.get(lead.cls)
+        live_key = (
+            lead.cls if fused is None
+            else ("fused",) + tuple(
+                _pcache.spec_fingerprint(s) for s in fused.members
+            )
+        )
+        ent = self._live_cache.get(live_key)
         if ent is None:
             from cimba_tpu.runner import experiment as ex
 
-            ent = (ex._live_program(req.spec, self.mesh), req.spec)
-            self._live_cache[lead.cls] = ent
+            live_spec = req.spec if fused is None else fused.spec
+            pin = req.spec if fused is None else fused
+            ent = (ex._live_program(live_spec, self.mesh), pin)
+            self._live_cache[live_key] = ent
         live_j = ent[0]
         wave_lanes = total + pad
         every = self.refill_every
@@ -1597,8 +1884,21 @@ class Service:
             entry.request.n_replications - entry.next_lo,
         )
 
+    @staticmethod
+    def _entry_sid(sid_of: dict, entry: _Entry) -> int:
+        """The entry's lane spec-id in a fused wave.  An entry claimed
+        through the EXACT tier may predate its fusion binding
+        (``spec_fp=None`` — e.g. submitted before a tuned schedule
+        flipped fusion on); its exact class still pins the same spec as
+        a roster member, so the fingerprint lookup cannot miss."""
+        fp = entry.spec_fp
+        if fp is None:
+            fp = _pcache.spec_fingerprint(entry.request.spec)
+        return sid_of[fp]
+
     def _claim_compatible(self, cls, budget: int, now: float, *,
-                          strict_priority: bool) -> list:
+                          strict_priority: bool, fuse_cls=None,
+                          fuse_members=None) -> list:
         """The ONE queue scan both refill claim sites use (initial
         fill and boundary admission — one definition, so the paths
         cannot drift): take same-class entries, ONE whole slot each,
@@ -1614,10 +1914,30 @@ class Service:
         jump the queue; with foreign work waiting, the wave stops
         admitting, drains, and retires (the same bound the plain
         dispatcher has).  Returns ``[(entry, n)]`` — NOT yet claimed;
-        the caller marks ``in_flight`` under the service lock."""
+        the caller marks ``in_flight`` under the service lock.
+
+        ``fuse_cls`` widens compatibility to the wave's FUSION class
+        (docs/26_wave_fusion.md): an entry of a different exact class
+        still packs when its fusion class matches and — when
+        ``fuse_members`` (the wave's member-fingerprint map) is given —
+        its spec is one of the wave's superprogram members.  A
+        fusion-class entry whose spec is NOT a member is foreign (it
+        would need a different compiled superprogram): under
+        strict_priority it trips the same fairness valve any other
+        class does, so a stale fused wave drains instead of starving a
+        grown roster."""
         planned: list = []
         dropped: list = []
         state = {"budget": int(budget), "blocked": False}
+
+        def compatible(e: _Entry) -> bool:
+            if e.cls == cls:
+                return True
+            if fuse_cls is None or e.fuse_cls != fuse_cls:
+                return False
+            if fuse_members is None:
+                return True
+            return e.spec_fp is not None and e.spec_fp in fuse_members
 
         def want(e: _Entry) -> bool:
             if e.done.is_set():
@@ -1627,7 +1947,7 @@ class Service:
                 return True
             if state["blocked"]:
                 return False
-            if e.solo or e.cls != cls or e.cancelled:
+            if e.solo or not compatible(e) or e.cancelled:
                 if strict_priority:
                     state["blocked"] = True
                 return False
@@ -1657,12 +1977,29 @@ class Service:
         Pad lanes are born into the free pool: reclaimable capacity,
         not dead weight."""
         wave = _RefillWave(lead.cls, bool(lead.solo))
+        # a refill wave is born FUSED whenever the lead's fusion class
+        # has >= 2 roster members (docs/26_wave_fusion.md): even a
+        # wave whose initial slots are single-spec runs the class
+        # superprogram, so later boundary splices can admit ANY member
+        # without retracing.  The member set — and hence the compiled
+        # program — is frozen at birth (wave.sid_of).
+        if not lead.solo and lead.fuse_cls is not None:
+            wave.fused = self._fused_bundle(lead.fuse_cls)
+            if wave.fused is not None:
+                wave.sid_of = {
+                    _pcache.spec_fingerprint(s): k
+                    for k, s in enumerate(wave.fused.members)
+                }
         budget = self.max_wave - self._refill_slot_size(lead)
         planned: list = []
         if budget > 0 and not lead.solo:
             planned = self._claim_compatible(
                 lead.cls, budget, time.monotonic(),
                 strict_priority=False,
+                fuse_cls=(
+                    lead.fuse_cls if wave.fused is not None else None
+                ),
+                fuse_members=wave.sid_of,
             )
         members = [lead]
         with self._lock:
@@ -1706,6 +2043,9 @@ class Service:
             self._counters["waves"] += len(slots)
             self._counters["lanes_dispatched"] += total
             self._counters["lanes_padded"] += pad
+            if wave.fused is not None:
+                self._counters["fused_waves"] += 1
+                self._counters["fused_lanes"] += total
             k = len(members)
             self._occupancy[k] = self._occupancy.get(k, 0) + 1
             self._depth_samples.append((
@@ -1749,30 +2089,47 @@ class Service:
 
         lead = wave.slots[0].entry
         req = lead.request
-        wave.init_j, wave.chunk_j = _pcache.get_programs(
-            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
-            chunk_steps=req.chunk_steps, with_metrics=lead.with_metrics,
-        )
-        wave.refill_j, wave.live_j = _pcache.get_refill_programs(
-            self.cache, req.spec, mesh=self.mesh, pack=req.pack,
-            with_metrics=lead.with_metrics,
-        )
-        for s in wave.slots:
-            _pcache.preflight_summary_path(
-                self.cache, s.entry.request.spec, wave.init_j,
-                s.entry.request.summary_path, s.entry.request.params,
-                s.entry.request.n_replications, s.n,
-                s.entry.with_metrics,
+        if wave.fused is None:
+            wave.init_j, wave.chunk_j = _pcache.get_programs(
+                self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+                chunk_steps=req.chunk_steps,
+                with_metrics=lead.with_metrics,
             )
+            wave.refill_j, wave.live_j = _pcache.get_refill_programs(
+                self.cache, req.spec, mesh=self.mesh, pack=req.pack,
+                with_metrics=lead.with_metrics,
+            )
+        else:
+            # the fusion superprogram set (docs/26_wave_fusion.md):
+            # spec-id-switched init/refill, the merged spec's ordinary
+            # chunk/live programs — one compiled set per fusion class,
+            # shared by every member
+            wave.init_j, wave.chunk_j = _pcache.get_fused_wave_programs(
+                self.cache, wave.fused, mesh=self.mesh, pack=req.pack,
+                chunk_steps=req.chunk_steps,
+                with_metrics=lead.with_metrics,
+            )
+            wave.refill_j, wave.live_j = (
+                _pcache.get_fused_refill_programs(
+                    self.cache, wave.fused, mesh=self.mesh,
+                    pack=req.pack, with_metrics=lead.with_metrics,
+                )
+            )
+        for s in wave.slots:
+            self._preflight_wave_member(wave, s.entry, s.n)
         wave.pad_row = ex._slice_params(
             req.params, req.n_replications, 0, 1
         )
-        reps, seeds, t_stops, pws = [], [], [], []
+        reps, seeds, t_stops, sids, pws = [], [], [], [], []
         for s in wave.slots:
             e = s.entry
             reps.append(jnp.arange(s.lo, s.lo + s.n))
             seeds.append(ex._seed_column(e.request.seed, s.n))
             t_stops.append(ex._horizon_column(e.request.t_end, s.n))
+            if wave.fused is not None:
+                sids.append(jnp.full(
+                    (s.n,), self._entry_sid(wave.sid_of, e), jnp.int32,
+                ))
             pws.append(ex._slice_params(
                 e.request.params, e.request.n_replications, s.lo, s.n
             ))
@@ -1781,12 +2138,15 @@ class Service:
             reps.append(jnp.zeros((pad,), reps[0].dtype))
             seeds.append(ex._seed_column(0, pad))
             t_stops.append(jnp.full((pad,), -jnp.inf, t_stops[0].dtype))
+            if wave.fused is not None:
+                sids.append(jnp.zeros((pad,), jnp.int32))
             pws.append(jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (pad,) + x.shape[1:]),
                 wave.pad_row,
             ))
         if len(reps) == 1:
             cat = (reps[0], seeds[0], t_stops[0], pws[0])
+            sid_cat = sids[0] if sids else None
         else:
             cat = (
                 jnp.concatenate(reps, axis=0),
@@ -1796,7 +2156,37 @@ class Service:
                     lambda *xs: jnp.concatenate(xs, axis=0), *pws
                 ),
             )
-        return wave.init_j(*cat)
+            sid_cat = (
+                jnp.concatenate(sids, axis=0) if sids else None
+            )
+        if wave.fused is None:
+            return wave.init_j(*cat)
+        return wave.init_j(cat[0], cat[1], cat[2], sid_cat, cat[3])
+
+    def _preflight_wave_member(self, wave: _RefillWave, entry: _Entry,
+                               n: int) -> None:
+        """Preflight one member's ``summary_path`` against the wave's
+        init program — on a fused wave through a spec-id adapter, so
+        the trace runs the member's OWN init branch (the preflight
+        cache key is per member fingerprint either way)."""
+        import jax.numpy as jnp
+
+        if wave.fused is None:
+            member_init = wave.init_j
+        else:
+            sid = self._entry_sid(wave.sid_of, entry)
+            init_j = wave.init_j
+
+            def member_init(r, s, t, p, _f=init_j, _sid=sid):
+                return _f(
+                    r, s, t, jnp.full(r.shape, _sid, jnp.int32), p,
+                )
+
+        _pcache.preflight_summary_path(
+            self.cache, entry.request.spec, member_init,
+            entry.request.summary_path, entry.request.params,
+            entry.request.n_replications, n, entry.with_metrics,
+        )
 
     def _fold_refill_slot(self, s: _RefillSlot, sims) -> None:
         """Retire one slot: gather its lanes (ascending lane order ==
@@ -1810,8 +2200,7 @@ class Service:
         fold_j = _pcache.get_fold(
             self.cache, e.with_metrics, e.request.summary_path,
         )
-        idx = jnp.asarray(s.lanes)
-        sl = jax.tree.map(lambda x: x[idx], sims)
+        sl = _pcache.get_gather(self.cache)(sims, jnp.asarray(s.lanes))
         if e.acc is None:
             e.acc = _pcache.stream_acc(e.request.spec, e.with_metrics)
         e.acc = fold_j(e.acc, sl)
@@ -1932,8 +2321,17 @@ class Service:
             # into this wave) stops the refill instead of being
             # starved behind an endlessly-refilled wave; the wave
             # then drains and retires like a plain one
+            # a fused wave admits any MEMBER spec of its frozen birth
+            # roster (wave.sid_of) — later-grown roster entries are
+            # foreign here, so the same strict_priority valve drains
+            # the wave and the next one picks up the grown roster
             planned = self._claim_compatible(
                 wave.cls, len(wave.free), now, strict_priority=True,
+                fuse_cls=(
+                    wave.slots[0].entry.fuse_cls
+                    if wave.fused is not None else None
+                ),
+                fuse_members=wave.sid_of,
             )
             free_sorted = sorted(wave.free)
             with self._lock:
@@ -1973,12 +2371,7 @@ class Service:
                     )
                     rec.end(sp)
             for s in admitted:
-                e = s.entry
-                _pcache.preflight_summary_path(
-                    self.cache, e.request.spec, wave.init_j,
-                    e.request.summary_path, e.request.params,
-                    e.request.n_replications, s.n, e.with_metrics,
-                )
+                self._preflight_wave_member(wave, s.entry, s.n)
 
         with self._lock:
             # the scrapeable free-lane headroom tracks the pool across
@@ -2002,6 +2395,7 @@ class Service:
             (L,), -np.inf,
             np.asarray(ex._horizon_column(None, 1)).dtype,
         )
+        sids = np.zeros((L,), np.int32)
         if kills:
             mask[np.asarray(kills)] = True
         pw = jax.tree.map(
@@ -2017,6 +2411,8 @@ class Service:
             ts[idx] = np.asarray(
                 ex._horizon_column(e.request.t_end, 1)
             )[0]
+            if wave.fused is not None:
+                sids[idx] = self._entry_sid(wave.sid_of, e)
             rows = ex._slice_params(
                 e.request.params, e.request.n_replications, s.lo, s.n
             )
@@ -2024,9 +2420,17 @@ class Service:
             pw = jax.tree.map(
                 lambda b, r, j=jidx: b.at[j].set(r), pw, rows
             )
+        if wave.fused is None:
+            return wave.refill_j(
+                sims, jnp.asarray(mask), jnp.asarray(reps),
+                jnp.asarray(seeds), jnp.asarray(ts), pw,
+            )
+        # the fused refill takes the per-lane spec-id column too:
+        # killed lanes re-seed as sid-0 pads (t_stop=-inf keeps them
+        # dead), admitted lanes as their member's own init branch
         return wave.refill_j(
             sims, jnp.asarray(mask), jnp.asarray(reps),
-            jnp.asarray(seeds), jnp.asarray(ts), pw,
+            jnp.asarray(seeds), jnp.asarray(ts), jnp.asarray(sids), pw,
         )
 
     def _fold_slots(self, slots, sims) -> None:
@@ -2039,7 +2443,7 @@ class Service:
         (the fold traces user code); acc and next_lo advance together
         per slot, so a retry after a mid-batch failure resumes exactly
         at the first unfolded slot."""
-        import jax
+        import jax.numpy as jnp
 
         off = 0
         for entry, lo, n in slots:
@@ -2047,8 +2451,8 @@ class Service:
                 self.cache, entry.with_metrics,
                 entry.request.summary_path,
             )
-            sl = jax.tree.map(
-                lambda x, off=off, n=n: x[off: off + n], sims
+            sl = _pcache.get_gather(self.cache)(
+                sims, jnp.arange(off, off + n)
             )
             if entry.acc is None:
                 entry.acc = _pcache.stream_acc(

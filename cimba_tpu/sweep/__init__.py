@@ -26,10 +26,15 @@ from cimba_tpu.sweep.adaptive import (
     replication_means,
     round_seed,
 )
-from cimba_tpu.sweep.engine import SweepResult, run_sweep
+from cimba_tpu.sweep.engine import (
+    SweepResult,
+    run_fused_sweeps,
+    run_sweep,
+)
 from cimba_tpu.sweep.grid import SweepGrid
 
 __all__ = [
     "SweepGrid", "SweepResult", "HalfwidthTarget",
     "replication_means", "round_seed", "run_sweep",
+    "run_fused_sweeps",
 ]

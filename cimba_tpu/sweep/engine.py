@@ -63,7 +63,7 @@ import numpy as np
 from cimba_tpu.sweep.adaptive import HalfwidthTarget, round_seed
 from cimba_tpu.sweep.grid import SweepGrid
 
-__all__ = ["SweepResult", "run_sweep"]
+__all__ = ["SweepResult", "run_sweep", "run_fused_sweeps"]
 
 
 @dataclass
@@ -663,3 +663,81 @@ def run_sweep(
         metrics=metrics,
         audit=audit_card,
     )
+
+
+def run_fused_sweeps(
+    points,
+    *,
+    reps_per_cell: int,
+    seed: int = 0,
+    service=None,
+    fuse_max_specs: Optional[int] = None,
+    max_wave: int = 4096,
+    serve_timeout: float = 600.0,
+    **kw,
+) -> list:
+    """Run several DISTINCT-model sweeps through one shared
+    fuse-enabled service, so their cells pack into cross-spec fused
+    waves (docs/26_wave_fusion.md) instead of each model degenerating
+    to its own mostly-padded waves.
+
+    ``points`` is a sequence of ``(spec, grid)`` pairs; each runs as a
+    serve-backed :func:`run_sweep` with the SAME ``reps_per_cell`` /
+    ``seed`` / forwarded ``**kw``, concurrently, against one
+    :class:`~cimba_tpu.serve.service.Service` with ``fuse=True`` —
+    compatible-shape specs land in one fusion class and their
+    (cell, round) requests splice into shared superprogram waves;
+    shape-incompatible specs simply serve unfused (fusion never
+    changes results, only packing).  Returns the per-point
+    :class:`SweepResult` list in ``points`` order — every per-cell
+    result stays bitwise the direct fixed-R call's, exactly as the
+    serve-backed single-sweep contract pins.
+
+    Pass ``service=`` to reuse a caller-owned service (its ``fuse``
+    setting then governs; the per-call knobs are ignored) — e.g. to
+    fuse sweep traffic with live serving traffic."""
+    import threading
+
+    points = list(points)
+    if not points:
+        return []
+    owned = service is None
+    if owned:
+        from cimba_tpu.serve.service import Service
+
+        service = Service(
+            max_wave=max_wave, fuse=True,
+            fuse_max_specs=fuse_max_specs,
+        )
+    results: list = [None] * len(points)
+    errors: list = [None] * len(points)
+
+    def one(i, spec, grid):
+        try:
+            results[i] = run_sweep(
+                spec, grid, reps_per_cell=reps_per_cell, seed=seed,
+                service=service, serve_timeout=serve_timeout,
+                max_wave=max_wave, **kw,
+            )
+        except BaseException as e:  # re-raised on the caller thread
+            errors[i] = e
+
+    try:
+        threads = [
+            threading.Thread(
+                target=one, args=(i, s, g), daemon=True,
+                name=f"fused-sweep-{i}",
+            )
+            for i, (s, g) in enumerate(points)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        if owned:
+            service.shutdown(wait=True)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results

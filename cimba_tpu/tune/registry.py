@@ -333,7 +333,7 @@ class ResolvedEntry:
         if self.schedule is not None:
             for f in ("eventset_hier", "eventset_block", "lane_block",
                       "waves_per_device", "preempt_quantum",
-                      "mem_fraction"):
+                      "mem_fraction", "fuse", "fuse_max_specs"):
                 v = getattr(self.schedule, f)
                 if v is not None:
                     knobs[f] = v
@@ -407,6 +407,12 @@ def resolve_entry(
             applied["preempt_quantum"] = int(sched.preempt_quantum)
         if sched.mem_fraction is not None:
             applied["mem_fraction"] = float(sched.mem_fraction)
+        # wave-fusion policy knobs (docs/26_wave_fusion.md): same
+        # service-level adoption path (Service._adopt_fuse_knobs)
+        if sched.fuse is not None:
+            applied["fuse"] = bool(sched.fuse)
+        if sched.fuse_max_specs is not None:
+            applied["fuse_max_specs"] = int(sched.fuse_max_specs)
     if source == "tuned" and not applied:
         # a tuned entry existed but every one of its knobs lost to an
         # explicit kwarg/ambient override — the run is the caller's

@@ -173,6 +173,7 @@ def search_schedule(
     out_dir=None,
     on_round: Optional[Callable[[int], None]] = None,
     workload_label: Optional[str] = None,
+    runner: Optional[Callable] = None,
 ) -> TuneReport:
     """Search the schedule space for ``(spec, params, R)`` and return a
     :class:`TuneReport` (written to ``out_dir`` when given).  The
@@ -181,7 +182,17 @@ def search_schedule(
     warms each arm's compiled shapes outside the timed rounds.  The
     report's winner is only persisted by the caller
     (:func:`cimba_tpu.tune.registry.save_tuned`) — searching and
-    adopting are separate decisions."""
+    adopting are separate decisions.
+
+    ``runner(schedule, warm=...)`` replaces the direct stream call as
+    the measured workload — the hook serve-backed searches use for
+    knobs the direct path never exercises (``waves_per_device`` /
+    ``preempt_quantum`` / ``mem_fraction`` / ``fuse``, which live in
+    the Service dispatcher, not the chunk program).  It must return a
+    StreamResult-shaped payload (``summary``/``n_failed``/
+    ``total_events``/``metrics`` — tuples of per-request results are
+    fine; the digest walks leaves), deterministic for a given
+    schedule so the bitwise pin holds across arms."""
     import jax
 
     from cimba_tpu.obs import audit as _audit
@@ -224,6 +235,8 @@ def search_schedule(
             else base_wave
 
     def run_point(sched: Schedule, warm: bool):
+        if runner is not None:
+            return _block_result(runner(sched, warm=warm))
         p = warm_params if (warm and warm_params is not None) else params
         st = ex.run_experiment_stream(
             spec, p, R,
@@ -377,6 +390,13 @@ def search_schedule(
             # untimed default-knob twin at this wave geometry: the
             # merge order follows the wave partition, so the bitwise
             # reference must share it
+            if runner is not None:
+                pin_digests[w] = _audit.stream_result_digest(
+                    _block_result(
+                        runner(Schedule(wave_size=w), warm=False)
+                    )
+                )
+                return pin_digests[w]
             st = ex.run_experiment_stream(
                 spec, params, R, wave_size=w, seed=seed, t_end=t_end,
                 mesh=mesh,

@@ -50,8 +50,9 @@ __all__ = ["Schedule", "ScheduleSpace", "default_space"]
 #: resolving garbage knobs.  2: PR 17 added the device-scheduler knobs
 #: (``waves_per_device`` / ``preempt_quantum`` / ``mem_fraction``).
 #: 3: the table-scan dispatch knobs (``table_scan`` / ``table_block``
-#: — docs/25_compile_wall.md).
-SCHEDULE_FORMAT = 3
+#: — docs/25_compile_wall.md).  4: the wave-fusion knobs (``fuse`` /
+#: ``fuse_max_specs`` — docs/26_wave_fusion.md).
+SCHEDULE_FORMAT = 4
 
 #: the knob fields, in canonical order (the JSON/digest field set)
 _FIELDS = (
@@ -59,6 +60,7 @@ _FIELDS = (
     "chunk_steps", "wave_size", "lane_block",
     "table_scan", "table_block",
     "waves_per_device", "preempt_quantum", "mem_fraction",
+    "fuse", "fuse_max_specs",
 )
 
 #: device-scheduler knob defaults (docs/24_device_scheduler.md) — ONE
@@ -69,6 +71,13 @@ _FIELDS = (
 DEFAULT_WAVES_PER_DEVICE = 2
 DEFAULT_PREEMPT_QUANTUM = 8
 DEFAULT_MEM_FRACTION = 0.8
+
+#: wave-fusion roster cap default (docs/26_wave_fusion.md) — the same
+#: ONE-definition rule: ``serve.Service`` resolves ``fuse_max_specs=
+#: None`` against this, and :meth:`Schedule.canonical` collapses an
+#: explicit equal setting.  4 keeps the fused superprogram's size
+#: growth comfortably under the JXL004 sublinearity budget.
+DEFAULT_FUSE_MAX_SPECS = 4
 
 #: schedule fields that change the *geometry* of a run (wave partition
 #: / chunk boundaries) rather than the traced step program — the
@@ -117,6 +126,14 @@ class Schedule:
     waves_per_device: Optional[int] = None
     preempt_quantum: Optional[int] = None
     mem_fraction: Optional[float] = None
+    # wave-fusion policy knobs (docs/26_wave_fusion.md): cross-spec
+    # fused-wave packing on/off plus the per-class member roster cap.
+    # Host-side packing policy consumed by serve.Service when its own
+    # constructor knobs are left None — member lanes are bitwise their
+    # solo runs either way; the searched trade is occupancy versus
+    # fused-program size (obs/program_size.py prices it)
+    fuse: Optional[bool] = None
+    fuse_max_specs: Optional[int] = None
 
     def knobs(self) -> dict:
         """The non-default fields only (what this schedule binds)."""
@@ -294,11 +311,23 @@ class Schedule:
             quantum = None
         if memf is not None and float(memf) == DEFAULT_MEM_FRACTION:
             memf = None
+        # wave-fusion knobs: fusion defaults OFF (the CIMBA_WAVE_FUSE
+        # ambient default), so an explicit fuse=False is the default
+        # arm; the roster cap is dead when fusion resolves off, and
+        # the stock cap is the default arm when it resolves on
+        fuse, fmax = self.fuse, self.fuse_max_specs
+        if fuse is not None and not bool(fuse):
+            fuse = None
+        if fuse is None:
+            fmax = None
+        elif fmax is not None and int(fmax) == DEFAULT_FUSE_MAX_SPECS:
+            fmax = None
         return dataclasses.replace(
             self, eventset_hier=hier, eventset_block=block,
             pack=pack, chunk_steps=chunk, table_scan=tscan,
             table_block=tblock, waves_per_device=wpd,
             preempt_quantum=quantum, mem_fraction=memf,
+            fuse=fuse, fuse_max_specs=fmax,
         )
 
     # -- persistence ---------------------------------------------------------
@@ -320,7 +349,8 @@ class Schedule:
         for f in _FIELDS:
             v = doc.get(f)
             if v is not None:
-                if f in ("eventset_hier", "pack", "table_scan"):
+                if f in ("eventset_hier", "pack", "table_scan",
+                         "fuse"):
                     v = bool(v)
                 elif f == "mem_fraction":
                     v = float(v)
@@ -364,6 +394,8 @@ class ScheduleSpace:
     waves_per_device: Tuple = ()
     preempt_quantum: Tuple = ()
     mem_fraction: Tuple = ()
+    fuse: Tuple = ()
+    fuse_max_specs: Tuple = ()
 
     def axes(self) -> dict:
         """The non-empty axes, name -> value tuple."""
@@ -410,6 +442,7 @@ class ScheduleSpace:
 
 def default_space(
     spec=None, *, kernel: bool = False, device_sched: bool = False,
+    fuse: bool = False,
 ) -> ScheduleSpace:
     """The stock search space over the dispatch knobs of
     docs/11_dispatch_cost.md: hierarchical event-set on/off with a
@@ -421,7 +454,11 @@ def default_space(
     path); the device-scheduler policy knobs (``waves_per_device``,
     ``preempt_quantum`` — docs/24_device_scheduler.md) join only with
     ``device_sched=True``, since they are inert outside a
-    ``CIMBA_DEVICE_SCHED`` serve loop.  The table-scan pair
+    ``CIMBA_DEVICE_SCHED`` serve loop (``mem_fraction`` joins them —
+    the admission fraction is only live under the scheduler); the
+    wave-fusion pair (``fuse`` / ``fuse_max_specs`` —
+    docs/26_wave_fusion.md) joins only with ``fuse=True``, since
+    fusion is inert on single-spec workloads.  The table-scan pair
     (docs/25_compile_wall.md) is always in the grid — for small-table
     models every setting collapses to the default arm, so it only
     costs candidates where a table actually exceeds a block.  Axes
@@ -437,5 +474,8 @@ def default_space(
         lane_block=(8, 16, 32) if kernel else (),
         waves_per_device=(1, 2, 4) if device_sched else (),
         preempt_quantum=(4, 8, 16) if device_sched else (),
+        mem_fraction=(0.6, 0.8) if device_sched else (),
+        fuse=(True, False) if fuse else (),
+        fuse_max_specs=(2, 4, 8) if fuse else (),
     )
     return space

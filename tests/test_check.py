@@ -215,6 +215,7 @@ def test_gate_sweep_catches_a_lying_gate():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ci.sh "static analysis" runs the full check battery (program lints included) every pass
 def test_program_lints_clean_on_shipped_model():
     from cimba_tpu.check import jaxprlint
 

@@ -315,6 +315,68 @@ def test_preempt_during_refill_ownership_survives(tiny, shared_cache):
 
 
 # --------------------------------------------------------------------------
+# restore order: priority, not eviction order
+# --------------------------------------------------------------------------
+
+
+def test_two_evicted_waves_restore_in_priority_order(tiny, shared_cache):
+    """With TWO preempted waves parked, the freed slot goes to the
+    higher-priority one — priority order (max live-member priority),
+    NOT eviction order: the mid-priority wave evicted LAST still comes
+    back before the background wave evicted first.  Observed through
+    completion order under ``waves_per_device=1``: the background
+    cannot even restore until the mid wave retires."""
+    spec, cache = tiny, shared_cache
+    svc = _GatedSched(
+        max_wave=8, cache=cache, pad_waves=False, waves_per_device=1,
+    )
+    try:
+        bg = svc.submit(_req(
+            spec, 4, seed=20, t_end=300.0, priority=0, label="bg",
+        ))
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        # bucket ladder (16.0): 300 / 40 / 6 are three distinct
+        # classes, so each request is its own wave and the priority
+        # ladder forces two stacked preemptions
+        mid = svc.submit(_req(
+            spec, 4, seed=21, t_end=40.0, priority=5, label="mid",
+        ))
+        deadline = time.monotonic() + 120
+        while (svc.stats()["device_sched"]["preemptions"] < 1
+               and time.monotonic() < deadline):
+            svc.step()
+            time.sleep(0.01)
+        assert svc.stats()["device_sched"]["preemptions"] >= 1
+        ur = svc.submit(_req(
+            spec, 4, seed=22, t_end=6.0, priority=10, label="ur",
+        ))
+        svc.open_boundaries()
+        r_ur = ur.result(300)
+        r_mid = mid.result(300)
+        bg_done_at_mid = bg.done()
+        r_bg = bg.result(300)
+        st = svc.stats()["device_sched"]
+    finally:
+        _release_all(svc)
+        svc.shutdown()
+    assert st["sched_waves_started"] == 3, st
+    assert st["preemptions"] >= 2 and st["restores"] >= 2, st
+    # priority order: mid (restored ahead of bg) finished while the
+    # first-evicted background was still unfinished
+    assert not bg_done_at_mid
+    _assert_results_equal(
+        r_ur, _direct(spec, 4, cache, seed=22, t_end=6.0)
+    )
+    _assert_results_equal(
+        r_mid, _direct(spec, 4, cache, seed=21, t_end=40.0)
+    )
+    _assert_results_equal(
+        r_bg, _direct(spec, 4, cache, seed=20, t_end=300.0)
+    )
+
+
+# --------------------------------------------------------------------------
 # memory-aware admission: structured backpressure
 # --------------------------------------------------------------------------
 
@@ -469,7 +531,7 @@ def test_schedule_knobs_roundtrip_resolve_and_adoption(shared_cache):
     from cimba_tpu.tune import registry as reg
     from cimba_tpu.tune import space
 
-    assert space.SCHEDULE_FORMAT == 3
+    assert space.SCHEDULE_FORMAT == 4
     s = space.Schedule(
         waves_per_device=4, preempt_quantum=16, mem_fraction=0.5,
     )

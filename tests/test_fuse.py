@@ -1,0 +1,460 @@
+"""Cross-spec wave fusion (docs/26_wave_fusion.md).
+
+Contracts pinned here:
+
+* **fused lanes are bitwise their solo runs, both dtype profiles**:
+  three DISTINCT tiny specs (same fusion shape class, different block
+  programs) packed into ONE branch-dispatch superprogram wave each
+  digest-match their direct per-spec solo calls under f64 AND f32;
+* **cross-spec refill splice**: a member request QUEUED AFTER a fused
+  wave started splices into lanes freed by another member's horizon
+  death — no recompile, every member bitwise;
+* **superspec structure**: member 0's block functions ride the merged
+  table verbatim (base 0 needs no wrapper), later members' entry pcs
+  rebase by their table offset, and a single-member "fusion"
+  degenerates to the original functions;
+* **rejection taxonomy**: spawn pools (``start=False``), kernel
+  ``boundary_pcs`` and shape-class mismatches raise
+  :class:`~cimba_tpu.core.fuse.FusionError` — at class formation,
+  never inside ``lax.switch`` at trace time;
+* **schedule format 4**: ``fuse`` / ``fuse_max_specs`` canonicalize
+  (explicit off IS the default arm; the roster cap dies when fusion
+  resolves off and at the stock cap) and round-trip the persistence
+  format;
+* **JXL004 sublinearity**: the fused superprogram's equation count
+  stays under ``FUSED_EQN_FACTOR`` x the members' summed solo counts —
+  the machinery is shared, only block tables concatenate;
+* **the jitted lane gather** (`serve.cache.get_gather`) is bitwise the
+  eager per-leaf slice it replaced (the serve fold-site perf fix);
+* **run_fused_sweeps**: distinct-model sweeps through one shared
+  fuse-enabled service stay bitwise their direct fixed-R twins.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import config, serve, sweep
+from cimba_tpu.core import api, cmd, fuse
+from cimba_tpu.core.model import Model
+from cimba_tpu.obs import audit
+from cimba_tpu.obs.program_size import chunk_program_size, fused_program_size
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.stats import summary as sm
+from cimba_tpu.tune.space import (
+    DEFAULT_FUSE_MAX_SPECS, Schedule, default_space,
+)
+
+
+def _fz_spec(i, t_stop=12.0):
+    """Member i of the fusion class: a distinct trace-time hold
+    constant = a distinct model identity, same fusion shape class."""
+    step = 0.5 + 0.25 * i
+    m = Model(f"fz{i}", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(step, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+@pytest.fixture(scope="module")
+def fz3():
+    """ONE spec-triple for the module (cache keys pin function
+    identities; sharing the objects pays each compile once)."""
+    return tuple(_fz_spec(i) for i in range(3))
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+def _req(spec, R, *, seed, t_end=None, **kw):
+    return serve.Request(
+        spec, (), R, seed=seed, t_end=t_end, wave_size=R,
+        chunk_steps=4, summary_path=_clock_path, label=spec.name, **kw,
+    )
+
+
+def _direct(spec, R, cache, *, seed, t_end=None):
+    return ex.run_experiment_stream(
+        spec, (), R, wave_size=R, chunk_steps=4, seed=seed,
+        t_end=t_end, summary_path=_clock_path, program_cache=cache,
+    )
+
+
+class _Gated(serve.Service):
+    """Fused service with deterministic control points (the
+    test_refill idiom): ``pack_gate`` holds the first wave until every
+    racing request is queued, ``release`` holds chunk boundaries, and
+    ``started`` flips at the first boundary."""
+
+    def __init__(self, **kw):
+        self.pack_gate = threading.Event()
+        self.started = threading.Event()
+        self.release = threading.Event()
+        kw.setdefault("fuse", True)
+        kw.setdefault("horizon_bucket", None)
+        kw.setdefault("refill", True)
+        kw.setdefault("refill_every", 1)
+        super().__init__(**kw)
+
+    def _serve_refill_wave(self, lead):
+        assert self.pack_gate.wait(120), "pack gate never opened"
+        return super()._serve_refill_wave(lead)
+
+    def _refill_boundary(self, wave, n, sims, final=False):
+        self.started.set()
+        assert self.release.wait(120), "boundary gate never opened"
+        return super()._refill_boundary(wave, n, sims, final=final)
+
+
+# --------------------------------------------------------------------------
+# fused wave == solo runs, bitwise, both dtype profiles
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["f64", "f32"])
+def test_fused_wave_bitwise_vs_solo(profile):
+    """The headline contract: three distinct-spec requests share ONE
+    fused superprogram wave (batch occupancy 3, full roster), and each
+    request's result digest equals its direct per-spec solo call's —
+    on both dtype profiles (the spec-id switch selects values, never
+    perturbs them)."""
+    with config.profile(profile):
+        specs = [_fz_spec(i) for i in range(3)]
+        cache = pc.ProgramCache(capacity=64)
+        svc = _Gated(
+            max_wave=16, cache=cache, fuse_max_specs=3,
+            pad_waves=False,
+        )
+        out = {}
+        try:
+            def client(i, spec):
+                out[i] = svc.submit(_req(spec, 4, seed=11 + i)).result(300)
+
+            ts = [
+                threading.Thread(target=client, args=(i, s))
+                for i, s in enumerate(specs)
+            ]
+            [t.start() for t in ts]
+            deadline = threading.Event()
+            while svc.stats()["outstanding"] < 3:
+                deadline.wait(0.005)
+            svc.pack_gate.set()
+            svc.release.set()
+            [t.join() for t in ts]
+            st = svc.stats()
+        finally:
+            svc.pack_gate.set()
+            svc.release.set()
+            svc.shutdown()
+        fu = st["fusion"]
+        assert fu["enabled"] and fu["fused_waves"] >= 1, fu
+        assert fu["roster_sizes"] == [3], fu
+        assert st["batch_occupancy"].get(3) == 1, st["batch_occupancy"]
+        for i, spec in enumerate(specs):
+            assert audit.stream_result_digest(out[i]) == (
+                audit.stream_result_digest(
+                    _direct(spec, 4, cache, seed=11 + i)
+                )
+            ), spec.name
+
+
+# --------------------------------------------------------------------------
+# cross-spec refill splice
+# --------------------------------------------------------------------------
+
+
+def test_fused_refill_cross_spec_splice(fz3, shared_cache):
+    """A short-horizon member's lanes die mid-wave; a THIRD member's
+    request that never fit the wave (max_wave bounds it out) splices
+    into the freed lanes through the spec-id-switched refill program —
+    no recompile, all three members bitwise their solo runs.  All
+    members are submitted before the wave is born: the wave's fused
+    bundle binds the class roster at birth, so only a member the
+    superprogram already dispatches can board mid-flight."""
+    a, b, c = fz3
+    cache = shared_cache
+    svc = _Gated(
+        max_wave=8, cache=cache, fuse_max_specs=3, pad_waves=False,
+    )
+    try:
+        lead = svc.submit(_req(a, 4, seed=1, t_end=10.0))
+        short = svc.submit(_req(b, 4, seed=2, t_end=3.0))
+        # queued third member: 4+4 lanes fill max_wave, so it can only
+        # board via the fused refill splice when short's lanes die
+        queued = svc.submit(_req(c, 4, seed=3, t_end=5.0))
+        svc.pack_gate.set()
+        assert svc.started.wait(120)
+        svc.release.set()
+        r_lead = lead.result(300)
+        r_short = short.result(300)
+        r_queued = queued.result(300)
+        st = svc.stats()
+    finally:
+        svc.pack_gate.set()
+        svc.release.set()
+        svc.shutdown()
+    fu = st["fusion"]
+    assert fu["fused_waves"] >= 1 and fu["fused_lanes"] >= 8, fu
+    assert sorted(fu["roster_sizes"]) == [3], fu
+    assert st["refill"]["refill_admissions"] >= 1, st["refill"]
+    assert st["refill"]["lanes_refilled"] >= 4, st["refill"]
+    for res, spec, seed, t_end in (
+        (r_lead, a, 1, 10.0), (r_short, b, 2, 3.0),
+        (r_queued, c, 3, 5.0),
+    ):
+        assert audit.stream_result_digest(res) == (
+            audit.stream_result_digest(
+                _direct(spec, 4, cache, seed=seed, t_end=t_end)
+            )
+        ), spec.name
+
+
+# --------------------------------------------------------------------------
+# superspec structure
+# --------------------------------------------------------------------------
+
+
+def test_fuse_specs_structure(fz3):
+    """Member 0's block functions ride the merged table verbatim;
+    member k's twin carries entry pcs rebased by its table offset; the
+    degenerate single-member fusion keeps the original functions."""
+    a, b, c = fz3
+    fused = fuse.fuse_specs([a, b, c])
+    assert fused.n_members == 3
+    assert fused.bases == (0, len(a.blocks), len(a.blocks) + len(b.blocks))
+    # member 0 verbatim: identical function objects, no wrapper
+    assert fused.spec.blocks[: len(a.blocks)] == tuple(a.blocks)
+    for k, (s, base) in enumerate(zip((a, b, c), fused.bases)):
+        np.testing.assert_array_equal(
+            np.asarray(fused.rebased[k].proc_entry),
+            np.asarray(s.proc_entry) + base,
+        )
+        assert fused.rebased[k].blocks == fused.spec.blocks
+    assert fused.spec.name == "fused(fz0+fz1+fz2)"
+    solo = fuse.fuse_specs([a])
+    assert solo.spec.blocks == tuple(a.blocks)
+    assert solo.bases == (0,)
+
+
+def test_get_fused_caches_bundle(fz3, shared_cache):
+    """Re-fusing mints fresh rebasing wrappers (a fresh fingerprint —
+    a recompile); the cache returns ONE bundle per ordered member
+    tuple so the merged fingerprint is stable."""
+    a, b, c = fz3
+    f1 = pc.get_fused(shared_cache, (a, b, c))
+    f2 = pc.get_fused(shared_cache, (a, b, c))
+    assert f1 is f2
+    assert pc.get_fused(shared_cache, (b, a, c)) is not f1
+
+
+# --------------------------------------------------------------------------
+# rejection taxonomy
+# --------------------------------------------------------------------------
+
+
+def _spawn_pool_spec():
+    m = Model("fz_pool", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        return sim, cmd.select(
+            api.clock(sim) > 4.0, cmd.exit_(),
+            cmd.hold(1.0, next_pc=work.pc),
+        )
+
+    m.process("w", entry=work)
+    m.process("pool", entry=work, start=False)
+    return m.build()
+
+
+def _boundary_spec():
+    m = Model("fz_bnd", event_cap=1, guard_cap=2)
+
+    @m.boundary_block
+    def phys(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=work.pc)
+
+    @m.block
+    def work(sim, p, sig):
+        return sim, cmd.select(
+            api.clock(sim) > 4.0, cmd.exit_(),
+            cmd.hold(1.0, next_pc=phys.pc),
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def test_fusion_rejections(fz3):
+    """Spawn pools, boundary protocols and shape mismatches are
+    FusionError at class formation — named, structured, never a trace
+    crash."""
+    a = fz3[0]
+    with pytest.raises(fuse.FusionError, match="spawn pool"):
+        fuse.fusion_shape_key(_spawn_pool_spec())
+    with pytest.raises(fuse.FusionError, match="boundary_pcs"):
+        fuse.fusion_shape_key(_boundary_spec())
+    fat = Model("fz_fat", event_cap=4, guard_cap=2)
+
+    @fat.block
+    def work(sim, p, sig):
+        return sim, cmd.hold(1.0, next_pc=work.pc)
+
+    fat.process("w", entry=work)
+    with pytest.raises(fuse.FusionError, match="shape-compatible"):
+        fuse.fuse_specs([a, fat.build()])
+    with pytest.raises(fuse.FusionError, match="empty"):
+        fuse.fuse_specs([])
+
+
+# --------------------------------------------------------------------------
+# schedule format 4
+# --------------------------------------------------------------------------
+
+
+def test_schedule_format4_canonical_and_roundtrip(fz3):
+    """``fuse`` / ``fuse_max_specs`` canonicalize: explicit off IS the
+    default arm, the roster cap dies when fusion resolves off and
+    collapses at the stock cap; live values round-trip the persistence
+    format; the axes join ``default_space`` only on request."""
+    c = Schedule(fuse=False, fuse_max_specs=8).canonical()
+    assert c.fuse is None and c.fuse_max_specs is None
+    c = Schedule(fuse=None, fuse_max_specs=8).canonical()
+    assert c.fuse_max_specs is None
+    c = Schedule(fuse=True, fuse_max_specs=DEFAULT_FUSE_MAX_SPECS)
+    assert c.canonical().fuse is True
+    assert c.canonical().fuse_max_specs is None
+    live = Schedule(fuse=True, fuse_max_specs=3)
+    assert live.canonical() == live
+    back = Schedule.from_json(live.to_json())
+    assert back == live
+    spec = fz3[0]
+    on = default_space(spec, fuse=True)
+    assert on.fuse == (True, False) and on.fuse_max_specs == (2, 4, 8)
+    off = default_space(spec)
+    assert off.fuse == () and off.fuse_max_specs == ()
+    arms = on.candidates(spec)
+    assert any(a.fuse for a in arms)
+    # no candidate carries a roster cap without fusion resolving on
+    assert all(a.fuse for a in arms if a.fuse_max_specs is not None)
+
+
+# --------------------------------------------------------------------------
+# JXL004 sublinearity
+# --------------------------------------------------------------------------
+
+
+def test_fused_program_size_sublinear(fz3):
+    """The acceptance pin at K=4: the fused superprogram's equation
+    count stays under ``FUSED_EQN_FACTOR`` (0.6) x the members' summed
+    solo counts (machinery is shared; only block tables concatenate) —
+    and the lint fires on a near-linear count."""
+    from cimba_tpu.check.jaxprlint import (
+        FUSED_EQN_FACTOR, fused_size_findings,
+    )
+
+    members = tuple(fz3) + (_fz_spec(3),)
+    solo = [
+        chunk_program_size(s, lanes=4, max_steps=8, lower=False).eqns
+        for s in members
+    ]
+    fused = fused_program_size(
+        members, lanes=4, max_steps=8, lower=False
+    ).eqns
+    assert fused_size_findings(fused, solo, "fz4") == []
+    assert fused <= FUSED_EQN_FACTOR * sum(solo), (fused, solo)
+    linear = fused_size_findings(sum(solo), solo, "fz4")
+    assert len(linear) == 1 and linear[0].rule == "JXL004"
+
+
+# --------------------------------------------------------------------------
+# the jitted lane gather
+# --------------------------------------------------------------------------
+
+
+def test_get_gather_bitwise_vs_eager(shared_cache):
+    """The fold sites' compiled lane gather returns leaves bitwise the
+    eager per-leaf slice it replaced, and caches to ONE program."""
+    import jax.numpy as jnp
+
+    g1 = pc.get_gather(shared_cache)
+    assert pc.get_gather(shared_cache) is g1
+    sims = {
+        "a": jnp.arange(24, dtype=jnp.int32).reshape(8, 3),
+        "b": jnp.linspace(0.0, 1.0, 8),
+    }
+    idx = jnp.asarray([5, 0, 2])
+    got = g1(sims, idx)
+    want = jax.tree.map(lambda x: x[idx], sims)
+    for x, y in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# fused sweeps
+# --------------------------------------------------------------------------
+
+
+def _sweepable_spec(name, bias):
+    """A param-carrying member: the hold time is the cell's row value
+    plus a trace-time bias (the model identity)."""
+    m = Model(name, event_cap=1, guard_cap=2)
+
+    @m.user_state
+    def user_init(params):
+        (step,) = params
+        return {"step": step}
+
+    @m.block
+    def work(sim, p, sig):
+        return sim, cmd.hold(sim.user["step"] + bias, next_pc=work.pc)
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def test_run_fused_sweeps_bitwise_vs_direct():
+    """Two distinct-model sweeps through ONE shared fuse-enabled
+    service: every per-cell pooled result stays bitwise its direct
+    fixed-R twin's (fusion changes packing, never results)."""
+    points = []
+    for name, bias in (("fsw_a", 0.25), ("fsw_b", 0.75)):
+        spec = _sweepable_spec(name, bias)
+        grid = sweep.SweepGrid(
+            {"step": (0.5, 1.0)},
+            lambda step: (np.float64(step),),
+            name=name,
+        )
+        points.append((spec, grid))
+    kw = dict(
+        reps_per_cell=4, seed=3, t_end=10.0, chunk_steps=4,
+        summary_path=_clock_path,
+    )
+    fused = sweep.run_fused_sweeps(points, max_wave=16, **kw)
+    for (spec, grid), got in zip(points, fused):
+        want = sweep.run_sweep(spec, grid, **kw)
+        for x, y in zip(
+            jax.tree.leaves(
+                (got.summaries, got.n_failed, got.total_events)
+            ),
+            jax.tree.leaves(
+                (want.summaries, want.n_failed, want.total_events)
+            ),
+        ):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

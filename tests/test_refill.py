@@ -467,6 +467,7 @@ def test_plain_path_lane_occupancy_rebuilt_from_live_readback(
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ci.sh "static analysis" sweeps the refill gate's off/ambient identity (check/gates.py) every pass
 def test_refill_gate_off_is_pr14_baseline():
     """The ``refill`` gate in the check/gates.py registry: CIMBA_REFILL
     never binds into a traced chunk program — explicit-off, ambient-set,

@@ -168,6 +168,7 @@ def test_grid_validates_axes_and_structure():
 # --- fixed-R: bitwise vs per-cell direct stream calls -----------------------
 
 
+@pytest.mark.slow  # ci.sh "sweep smoke" pins fixed-R engine cells bitwise vs direct every pass
 def test_fixed_r_cells_bitwise_direct_stream(tiny, shared_cache):
     """Every engine cell — whole waves, ragged tails, multiple cells
     packed into one physical wave — bitwise the direct

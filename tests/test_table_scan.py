@@ -110,6 +110,7 @@ def test_jaxpr_structure():
     assert live != dense
 
 
+@pytest.mark.slow  # ci.sh "compile wall smoke" pins flat engaged eqn counts + JXL004 firing every pass
 def test_eqn_count_flat_and_sublinear_in_p():
     # scan-on equation counts are FLAT across engaged heights...
     sizes = {}

@@ -223,6 +223,7 @@ def test_measure_arms_budgets_record_skips():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ci.sh "tune smoke" runs the full search/pin/persist loop every pass
 def test_search_pins_arms_bitwise_and_writes_report(
     probe_spec, tmp_path,
 ):
@@ -382,6 +383,7 @@ def tuned_store_env(tuned_store, monkeypatch):
     return tuned_store
 
 
+@pytest.mark.slow  # ci.sh "tune smoke" resolves a persisted winner in a clean subprocess, bitwise vs default, every pass
 def test_stream_resolution_bitwise_and_run_card(
     probe_spec, tuned_store_env, monkeypatch,
 ):

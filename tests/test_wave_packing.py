@@ -135,6 +135,10 @@ def test_per_lane_seed_packs_and_is_bitwise_vs_solo(tiny, shared_cache):
     # wave uses — seed is NOT part of the program key)
     d1 = _direct(spec, 4, cache, seed=1)
     d2 = _direct(spec, 4, cache, seed=2)
+    # the serve fold sites slice waves through the once-per-cache
+    # jitted lane gather the direct path never builds; prime it so the
+    # miss ledger below measures only per-spec program sharing
+    pc.get_gather(cache)
     misses_before = cache.stats()["misses"]
     svc = _Gated(max_wave=16, cache=cache)
     try:

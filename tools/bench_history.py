@@ -13,7 +13,8 @@ checks the newest round against the previous one for a regression.
 Usage::
 
     python tools/bench_history.py [--dir .] [--cards DIR] [--tune DIR]
-        [--compile] [--metric mm1_events_per_sec] [--max-regression 10]
+        [--compile] [--fused] [--metric mm1_events_per_sec]
+        [--max-regression 10]
 
 ``--tune DIR`` additionally collates the autotuner's TuneReport JSONs
 (``tunereport_*.json``, docs/21_autotune.md) into a per-(spec
@@ -26,6 +27,13 @@ per-(metric, table height) trend of compile wall seconds and program
 size across rounds, and flags a round whose scan-arm compile wall or
 equation count regressed beyond ``--max-regression`` percent — the
 compile-cost twin of the events/s regression check.
+
+``--fused`` additionally collates the wave-fusion lines (``bench.py
+--config serve_fused``, docs/26_wave_fusion.md) into a per-round
+trend of the fused arm's events/s with the on-vs-off occupancy and
+events ratios and the superprogram's sublinearity, and flags a round
+whose ratios dropped beyond ``--max-regression`` percent or whose
+sublinearity crossed the JXL004 budget.
 
 Exit codes: 0 ok, 1 regression beyond ``--max-regression`` percent,
 2 nothing to collate.  Stdlib-only (no jax import) — safe in any CI
@@ -213,6 +221,71 @@ def print_compile_table(rounds, max_regression):
     return regressions
 
 
+def print_fused_table(rounds, max_regression):
+    """Round-by-round wave-fusion trend: the ``serve_fused`` lines
+    (docs/26_wave_fusion.md) as one row per round with the fused arm's
+    events/s, the on-vs-off occupancy and events ratios, and the
+    superprogram's measured sublinearity (fused eqns / sum of solo
+    eqns).  Returns the number of regressions — the newest round's
+    occupancy or events ratio dropping beyond ``max_regression``
+    percent of the previous round's, or its sublinearity crossing the
+    JXL004 budget factor the bench pins.  Fusion is a PERF feature
+    whose wins are exactly these two ratios, so the trend check guards
+    them the way the headline metric check guards raw events/s."""
+    rows = {}   # round -> (value, fusion-detail)
+    for n, _rc, lines in rounds:
+        for line in lines:
+            if "serve_fused" not in (line.get("metric") or ""):
+                continue
+            det = line.get("detail") or {}
+            rows[n] = (line.get("value"), det.get("fusion") or {})
+    if not rows:
+        print("\nwave-fusion trend: no serve_fused lines in any round")
+        return 0
+    print("\nwave-fusion trend (fused ev/s / occ ratio / ev ratio "
+          "/ sublinearity):")
+    regressions = 0
+    for n in sorted(rows):
+        v, fu = rows[n]
+        ps = fu.get("program_size") or {}
+        sub = ps.get("sublinearity")
+        occ, ev = (
+            fu.get("occupancy_ratio_on_vs_off"),
+            fu.get("events_ratio_on_vs_off"),
+        )
+        print(
+            f"  r{n}: {_fmt_rate(v)} ev/s / "
+            + (f"{occ:.2f}x" if occ else "-") + " / "
+            + (f"{ev:.2f}x" if ev else "-") + " / "
+            + (f"{sub:.3f}" if sub is not None else "-")
+        )
+        budget = ps.get("budget_factor")
+        if sub is not None and budget is not None and sub > budget:
+            regressions += 1
+            print(
+                f"    ** SUBLINEARITY over JXL004 budget: "
+                f"{sub:.3f} > {budget} **"
+            )
+    have = sorted(rows)
+    if len(have) >= 2:
+        prev, last = rows[have[-2]][1], rows[have[-1]][1]
+        for field in (
+            "occupancy_ratio_on_vs_off", "events_ratio_on_vs_off",
+        ):
+            pv, lv = prev.get(field), last.get(field)
+            if not pv or not lv:
+                continue
+            drop = (pv - lv) / pv * 100.0
+            if drop > max_regression:
+                regressions += 1
+                print(
+                    f"    ** {field} REGRESSION: r{have[-2]} "
+                    f"{pv:.3f} -> r{have[-1]} {lv:.3f} "
+                    f"(-{drop:.1f}% > {max_regression:.0f}%) **"
+                )
+    return regressions
+
+
 def _fmt_rate(v):
     if v is None:
         return "-"
@@ -243,6 +316,12 @@ def main(argv=None) -> int:
         help="also collate compile-wall lines (bench.py --config "
         "compile_wall) into a per-table-height trend with its own "
         "regression check (docs/25_compile_wall.md)",
+    )
+    ap.add_argument(
+        "--fused", action="store_true",
+        help="also collate wave-fusion lines (bench.py --config "
+        "serve_fused) into a per-round occupancy/events-ratio trend "
+        "with its own regression check (docs/26_wave_fusion.md)",
     )
     ap.add_argument(
         "--metric", default="mm1_events_per_sec",
@@ -322,6 +401,10 @@ def main(argv=None) -> int:
     compile_regressions = 0
     if getattr(args, "compile"):
         compile_regressions = print_compile_table(
+            rounds, args.max_regression
+        )
+    if args.fused:
+        compile_regressions += print_fused_table(
             rounds, args.max_regression
         )
 

@@ -540,6 +540,108 @@ print("preempt smoke OK:", {k: ds[k] for k in (
     "| cache misses 0 after warm | urgent finished first")
 EOF
 
+# fusion smoke (docs/26_wave_fusion.md): 3 threaded clients on 3
+# DISTINCT tiny specs (same fusion shape class, different block
+# programs) — with fuse on they must share ONE branch-dispatch
+# superprogram wave (batch occupancy 3, fused_waves >= 1), every
+# result must be bitwise its direct per-spec solo call, and the warmed
+# round must add ZERO program-cache misses (fused dispatch reuses the
+# bundle ladder, never re-compiles)
+run_cell "fusion smoke" python - <<'EOF'
+import threading
+import jax
+from cimba_tpu import serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.obs import audit
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.stats import summary as sm
+
+
+def build_spec(i):
+    # distinct trace-time hold constant = distinct model identity,
+    # same fusion shape class
+    step = 0.5 + 0.25 * i
+    m = Model(f"fz{i}", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > 12.0
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(step, next_pc=work.pc))
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def clock_path(sims):
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+specs = [build_spec(i) for i in range(3)]
+cache = serve.ProgramCache()
+
+
+class _Gated(serve.Service):
+    """Hold the first wave until all three clients are queued, so the
+    fused pack is deterministic, not a race against the dispatcher."""
+
+    def __init__(self, **kw):
+        self.gate = threading.Event()
+        super().__init__(**kw)
+
+    def _serve_refill_wave(self, lead):
+        assert self.gate.wait(600)
+        return super()._serve_refill_wave(lead)
+
+
+def round_():
+    svc = _Gated(max_wave=16, cache=cache, refill=True, refill_every=1,
+                 horizon_bucket=None, fuse=True, fuse_max_specs=3,
+                 pad_waves=False)
+    out = {}
+    try:
+        def client(i, spec):
+            out[i] = svc.submit(serve.Request(
+                spec, (), 4, seed=11 + i, wave_size=4, chunk_steps=4,
+                summary_path=clock_path, label=spec.name,
+            )).result(600)
+        ts = [threading.Thread(target=client, args=(i, s))
+              for i, s in enumerate(specs)]
+        [t.start() for t in ts]
+        while svc.stats()["outstanding"] < 3:
+            threading.Event().wait(0.005)
+        svc.gate.set()
+        [t.join() for t in ts]
+        return out, svc.stats()
+    finally:
+        svc.gate.set()
+        svc.shutdown()
+
+
+round_()                                   # warm: compiles everything
+misses_warm = cache.stats()["misses"]
+out, stats = round_()                      # measured round
+assert cache.stats()["misses"] == misses_warm, (
+    "fused round compiled after warm", cache.stats())
+fu = stats["fusion"]
+assert fu["enabled"] and fu["fused_waves"] >= 1, fu
+assert fu["roster_sizes"] == [3], fu
+# the three distinct-spec requests shared ONE fused wave
+assert stats["batch_occupancy"].get(3) == 1, stats["batch_occupancy"]
+for i, spec in enumerate(specs):
+    direct = ex.run_experiment_stream(
+        spec, (), 4, wave_size=4, chunk_steps=4, seed=11 + i,
+        summary_path=clock_path, program_cache=cache,
+    )
+    assert (audit.stream_result_digest(out[i])
+            == audit.stream_result_digest(direct)), spec.name
+print("fusion smoke OK: fused_waves", fu["fused_waves"],
+      "roster", fu["roster_sizes"],
+      "| occupancy", stats["batch_occupancy"],
+      "| bitwise vs direct | cache misses 0 after warm")
+EOF
+
 # sweep smoke: the many-scenario engine (docs/16_sweeps.md) — an easy
 # cell must provably stop >= 1 round before a hard cell under adaptive
 # stopping, and fixed-R engine cells must be BITWISE the direct
