@@ -6,7 +6,7 @@ a 64-core Threadripper 3970X ~= 375M events/s aggregate (~2.1 events per
 object).  ``vs_baseline`` is the ratio of this machine's events/s to that
 aggregate; the north star is >= 10.
 
-``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,serve_refill,serve_fused,mmc,mg1,sweep,tandem,tune,jobshop,awacs,compile_wall}``
+``--config {mm1,mm1_stream,mm1_single,serve,serve_cold,serve_fleet,serve_mixed,serve_refill,serve_fused,serve_qos,mmc,mg1,sweep,tandem,tune,jobshop,awacs,compile_wall}``
 runs one named config (``serve`` is the open-loop serving-layer load,
 docs/13_serving.md; ``serve_cold`` measures cold-start time-to-first-
 result with and without a hydrated AOT program store,
@@ -15,7 +15,10 @@ docs/15_program_store.md; ``serve_fleet`` is the multi-process fleet —
 same offered load, plus a kill-9-mid-load chaos arm,
 docs/20_fleet.md; ``serve_mixed`` is the heterogeneous-traffic
 mix measuring wave-packing occupancy and padding waste,
-docs/14_wave_packing.md; ``sweep`` races fixed-R against adaptive-R
+docs/14_wave_packing.md; ``serve_qos`` is the adversarial
+multi-tenant flood measuring victim-tail protection under
+weighted-fair lane shares + rate limits, docs/27_qos.md;
+``sweep`` races fixed-R against adaptive-R
 sequential stopping on the M/G/1 grid, docs/16_sweeps.md; ``tandem``
 is the two-station Jackson network over its scenario grid; ``tune``
 runs the schedule-autotuner search on mm1 + the step probe and
@@ -2007,6 +2010,251 @@ def bench_serve_fused():
     )
 
 
+def bench_serve_qos():
+    """The multi-tenant QoS plane under an adversarial flood
+    (docs/27_qos.md), measured through ``tune.measure.measure_arms``:
+    a ``flood`` tenant offers 2x the victims' combined arrival rate at
+    the SAME request shape (same compiled program, same compatibility
+    class — tenancy is never part of the class key), and the victim
+    tenant's tail is the metric.  Three arms: ``noflood`` (victims
+    alone, qos off — the reference), ``flood_qos_off`` (the damage),
+    ``flood_qos_on`` (weighted-fair DRR shares + the flood tenant's
+    token-bucket rate limit + lane quota).  The acceptance story: with
+    qos ON under flood, victim p99 <= 1.3x and goodput >= 0.9x the
+    no-flood reference, the flooder is throttled via structured
+    ``RetryAfter`` (the client honors ``delay_s`` and tallies
+    throttles per tenant), ZERO program-cache misses during the timed
+    rounds, and every delivered result's digest bitwise-equal to its
+    direct solo run — fairness shaping is invisible to results."""
+    from cimba_tpu import config as _cfg
+    from cimba_tpu import serve
+    from cimba_tpu.models import mm1
+    from cimba_tpu.obs import audit as _audit
+    from cimba_tpu.qos import TenantPolicy, TenantRegistry
+    from cimba_tpu.runner import experiment as ex
+    from cimba_tpu.tune import measure as _tm
+
+    accel = _accel()
+    wave = int(os.environ.get(
+        "CIMBA_BENCH_QOS_WAVE", str(2048 if accel else 32)
+    ))
+    _, N = _scale(0, 2000 if accel else 50)
+    chunk = int(os.environ.get(
+        "CIMBA_BENCH_QOS_CHUNK", str(256 if accel else 32)
+    ))
+    # requests are wave/8 lanes each: the flood's 2-request lane quota
+    # then caps it at a quarter of the wave, leaving the victims
+    # near-full parallelism when qos is on
+    req_r = max(int(os.environ.get(
+        "CIMBA_BENCH_QOS_REQ_R", str(max(wave // 8, 1))
+    )), 1)
+    n_victim = int(os.environ.get("CIMBA_BENCH_QOS_VICTIMS", "12"))
+    clients = int(os.environ.get("CIMBA_BENCH_SERVE_CLIENTS", "4"))
+    iat = float(os.environ.get("CIMBA_BENCH_QOS_IAT", "0.002"))
+    repeats = int(os.environ.get("CIMBA_BENCH_QOS_REPEATS", "2"))
+    flood_rate = float(os.environ.get(
+        "CIMBA_BENCH_QOS_FLOOD_RATE", "10.0"
+    ))
+    prof = _bench_profile()
+    with _cfg.profile(prof):
+        spec, _ = mm1.build(record=False)
+        cache = serve.ProgramCache()
+
+        def req(seed, n, r=req_r):
+            return serve.Request(
+                spec, mm1.params(n), r, seed=seed,
+                wave_size=r, chunk_steps=chunk,
+            )
+
+        # the flood shares the victims' params SIGNATURE (one compiled
+        # program, one compatibility class — tenancy never splits the
+        # class) but runs 10x the trajectory length at 2x the victims'
+        # combined arrival weight: lanes it grabs stay held long, which
+        # is exactly the hog a fair share has to arbitrate
+        def templates(flood):
+            base = [
+                serve.RequestTemplate(
+                    "victim_short", req(11, 2 * N), 1.0,
+                    tenant="victim",
+                ),
+                serve.RequestTemplate(
+                    "victim_mid", req(22, 6 * N), 1.0,
+                    tenant="victim",
+                ),
+            ]
+            if flood:
+                base.append(serve.RequestTemplate(
+                    "flood", req(33, 20 * N), 4.0, tenant="flood",
+                ))
+            return base
+
+        def registry():
+            # fresh per round: token buckets are per-service state
+            return TenantRegistry([
+                TenantPolicy("victim", weight=4.0,
+                             deadline_class=60.0),
+                TenantPolicy(
+                    "flood", weight=1.0, rate=flood_rate, burst=2,
+                    lane_quota=2 * req_r,
+                ),
+            ])
+
+        def load_round(flood, qos, n_reqs, iat_s):
+            svc = serve.Service(
+                max_wave=wave, cache=cache, refill=True,
+                refill_every=2, horizon_bucket=None,
+                qos=qos, tenants=registry(), on_chunk=_heartbeat,
+            )
+            try:
+                report = serve.run_mixed_load(
+                    svc, templates(flood), n_reqs,
+                    n_clients=clients, inter_arrival_s=iat_s,
+                )
+                stats = svc.stats()
+            finally:
+                svc.shutdown()
+            return report, stats
+
+        payloads: dict = {}
+        # misses snapshot at the FIRST timed run (after every prepare
+        # leg) — the round-1 run is the one most likely to compile
+        misses_at_first_run: dict = {}
+
+        def make_arm(name, flood, qos):
+            # victims see the same offered stream in every arm: under
+            # flood the 1:1:4 mix gives victims 1/3 of 3*n_victim
+            # requests, so the no-flood arm stretches its arrival
+            # spacing 3x to keep victim inter-arrival identical
+            n_reqs = 3 * n_victim if flood else n_victim
+            iat_s = iat if flood else 3.0 * iat
+
+            def prepare():
+                load_round(flood, qos, min(6, n_reqs), 0.0)
+
+            def run():
+                misses_at_first_run.setdefault(
+                    "misses", cache.stats()["misses"]
+                )
+                payloads[name] = load_round(flood, qos, n_reqs, iat_s)
+                return payloads[name]
+
+            return _tm.Arm(name=name, run=run, prepare=prepare)
+
+        arms = [
+            make_arm("noflood", False, False),
+            make_arm("flood_qos_off", True, False),
+            make_arm("flood_qos_on", True, True),
+        ]
+        mreport = _tm.measure_arms(
+            arms, repeats=repeats, baseline=0, on_round=_heartbeat,
+        )
+        compiled_in_timed = (
+            cache.stats()["misses"] - misses_at_first_run["misses"]
+            if misses_at_first_run else None
+        )
+        assert compiled_in_timed == 0, (
+            "programs compiled during the timed qos rounds",
+            compiled_in_timed, cache.stats(),
+        )
+        # per-template digest anchors vs direct solo runs — delivered
+        # results are bitwise their solo twins, throttled or fair-
+        # shared or not
+        direct_digest = {}
+        for t in templates(True):
+            r = t.request
+            direct_digest[t.name] = _audit.stream_result_digest(
+                ex.run_experiment_stream(
+                    r.spec, r.params, r.n_replications,
+                    wave_size=r.wave_size, chunk_steps=r.chunk_steps,
+                    seed=r.seed, t_end=r.t_end, program_cache=cache,
+                    on_wave=_heartbeat, on_chunk=_heartbeat,
+                )
+            )
+        digest_checked = digest_equal = 0
+        arm_detail = {}
+        for name, (report, stats) in payloads.items():
+            for i, res in report.results:
+                digest_checked += 1
+                digest_equal += (
+                    _audit.stream_result_digest(res)
+                    == direct_digest[report.template_names[i]]
+                )
+            arm_detail[name] = {
+                "completed": report.n_completed,
+                "errors": dict(report.errors),
+                "wall_s": report.wall_s,
+                "latency": report.latency_percentiles(),
+                "per_template": report.per_template(),
+                "per_tenant": report.per_tenant(),
+                "throttles_by_tenant": dict(
+                    report.throttles_by_tenant
+                ),
+                "qos_tenants": stats["qos"]["tenants"],
+            }
+    assert digest_checked and digest_equal == digest_checked, (
+        "qos-shaped results drifted from their solo digests",
+        digest_equal, digest_checked,
+    )
+    ref = arm_detail["noflood"]["per_tenant"]["victim"]
+    on_v = arm_detail["flood_qos_on"]["per_tenant"]["victim"]
+    off_v = arm_detail["flood_qos_off"]["per_tenant"].get("victim", {})
+    p99_ratio_on = (
+        on_v["p99_s"] / ref["p99_s"] if ref.get("p99_s") else None
+    )
+    p99_ratio_off = (
+        off_v.get("p99_s", 0.0) / ref["p99_s"]
+        if ref.get("p99_s") else None
+    )
+    flood_throttles = arm_detail["flood_qos_on"][
+        "throttles_by_tenant"
+    ].get("flood", 0)
+    # the acceptance contract (docs/27_qos.md): protection + shaping
+    assert flood_throttles > 0, (
+        "the flooding tenant was never throttled with qos on",
+        arm_detail["flood_qos_on"]["throttles_by_tenant"],
+    )
+    assert p99_ratio_on is not None and p99_ratio_on <= 1.3, (
+        "victim p99 under flood with qos on exceeded 1.3x the "
+        "no-flood reference", p99_ratio_on,
+    )
+    assert on_v["goodput"] >= 0.9 * ref["goodput"], (
+        "victim goodput under flood with qos on fell below 0.9x the "
+        "no-flood reference", on_v["goodput"], ref["goodput"],
+    )
+    _line(
+        "serve_qos_victim_p99_ratio",
+        p99_ratio_on,
+        None,
+        {
+            "path": "serve_qos_fair_share",
+            "profile": prof,
+            "victims_per_round": n_victim,
+            "clients": clients,
+            "inter_arrival_s": iat,
+            "objects_per_replication": N,
+            "replications_per_request": req_r,
+            "chunk_steps": chunk,
+            "max_wave": wave,
+            "flood_rate_per_s": flood_rate,
+            "measure": mreport.to_json(),
+            "qos": {
+                "arms": arm_detail,
+                "victim_p99_ratio_qos_on": p99_ratio_on,
+                "victim_p99_ratio_qos_off": p99_ratio_off,
+                "victim_goodput_qos_on": on_v["goodput"],
+                "victim_goodput_ref": ref["goodput"],
+                "flood_throttles_qos_on": flood_throttles,
+                "compiles_in_timed_rounds": compiled_in_timed,
+                "digest_anchors": {
+                    "checked": digest_checked, "equal": digest_equal,
+                },
+            },
+            "program_cache": cache.stats(),
+        },
+        unit="ratio",
+    )
+
+
 def bench_serve_preempt():
     """The preemptive device scheduler vs run-to-completion dispatch
     at the SAME offered load (docs/24_device_scheduler.md): one long
@@ -3590,6 +3838,7 @@ CONFIGS = {
     "serve_fleet": bench_serve_fleet,
     "serve_mixed": bench_serve_mixed,
     "serve_preempt": bench_serve_preempt,
+    "serve_qos": bench_serve_qos,
     "serve_refill": bench_serve_refill,
     "serve_fused": bench_serve_fused,
     "mmc": bench_mmc,

@@ -288,6 +288,22 @@ GATES: Tuple[Gate, ...] = (
         off_env={"CIMBA_DEVICE_SCHED": "0"},
     ),
     Gate(
+        name="qos",
+        env=("CIMBA_QOS",),
+        program="chunk",
+        # the multi-tenant QoS plane (docs/27_qos.md) is, like refill,
+        # a HOST-side admission policy: the knob selects weighted-fair
+        # lane apportionment / EDF ordering / quota throttling in the
+        # serve dispatcher, and the tenant id must never bind into a
+        # traced chunk program — a request admitted under QoS runs the
+        # SAME chunk program as one admitted in raw priority order
+        # (tenant is carried beside trace_context, outside the
+        # compatibility class key).  No ON arm: no chunk-program
+        # state to flip.
+        ambient_env={"CIMBA_QOS": "1"},
+        off_env={"CIMBA_QOS": "0"},
+    ),
+    Gate(
         name="wave_fuse",
         env=("CIMBA_WAVE_FUSE",),
         program="chunk",

@@ -214,6 +214,20 @@ ENV_KNOBS = {
             "lane's trajectory is bitwise its solo per-spec wave's "
             "(core/fuse.py has the argument)",
     ),
+    "CIMBA_QOS": dict(
+        default="", trace_gate=True,
+        doc="multi-tenant QoS plane (docs/27_qos.md): =1 makes "
+            "Service(qos=None) apportion freed refill lanes across "
+            "tenants by deficit-weighted round robin, order equal-"
+            "priority requests within a class by earliest deadline "
+            "(EDF), and enforce per-tenant quotas/rate limits at "
+            "submit with structured RetryAfter backpressure.  Purely "
+            "a HOST-side admission policy: the tenant id never joins "
+            "the program/compatibility class key and the chunk "
+            "program is untouched (the 'qos' gate in check/gates.py "
+            "pins ambient inertness); delivered results stay bitwise "
+            "their direct solo calls regardless of admission order",
+    ),
     "CIMBA_DEVICE_SCHED": dict(
         default="", trace_gate=True,
         doc="preemptive device scheduler "

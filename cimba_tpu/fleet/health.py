@@ -115,6 +115,26 @@ def scrape_slice(health_url: str, timeout: float) -> dict:
             for fname, series in samples.items()
             if not fname.endswith("_bucket")
         }
+        # the per-tenant QoS view (docs/27_qos.md): the flattened
+        # families above sum the tenant label away, so the tenant
+        # detail rides its own field — {tenant: {family: value}} over
+        # the cimba_serve_qos_* families (tenant-labeled by
+        # construction), summed across services within the slice.
+        # ``metrics_dump --fleet`` and the router's tenant federation
+        # read this.
+        tenants: dict = {}
+        for fname, series in samples.items():
+            if not fname.startswith("cimba_serve_qos_") \
+                    or fname.endswith("_bucket"):
+                continue
+            for labels, val in series.items():
+                tname = dict(labels).get("tenant")
+                if tname is None:
+                    continue
+                row = tenants.setdefault(tname, {})
+                row[fname] = row.get(fname, 0.0) + float(val)
+        if tenants:
+            out["tenants"] = tenants
     except (OSError, ValueError) as e:
         # connection refused/reset, timeout, or unparseable body —
         # all of them mean "treat this slice as gone"

@@ -71,9 +71,13 @@ __all__ = [
 #: ``QueueFull``/``ServiceClosed`` are deliberately NOT here: they
 #: judge the SLICE's state at one instant (saturated admission queue,
 #: shutting down), so the request requeues toward another slice
-#: instead of failing while idle slices sit by.
+#: instead of failing while idle slices sit by.  ``RetryAfter`` IS
+#: here (docs/27_qos.md): a QoS throttle judges the TENANT's policy,
+#: and requeueing a throttled flood onto another slice would hand the
+#: flooder slice-count times its rate — the structured backpressure
+#: surfaces to the client, which sleeps ``delay_s`` and retries.
 _PERMANENT_REMOTE = (
-    "DeadlineExceeded", "Cancelled", "RetriesExhausted",
+    "DeadlineExceeded", "Cancelled", "RetriesExhausted", "RetryAfter",
     "ValueError", "TypeError",
 )
 
@@ -419,6 +423,8 @@ class FleetRouter:
             h.last_scrape_t = time.monotonic()
             if self._tel is not None and scraped.get("families"):
                 self._mirror_locked(name, scraped["families"])
+            if self._tel is not None and scraped.get("tenants"):
+                self._mirror_tenants_locked(name, scraped["tenants"])
 
     # cimba-check: assume-held
     def _mirror_locked(self, name: str, fams: Dict[str, float]) -> None:
@@ -454,6 +460,51 @@ class FleetRouter:
             reg.gauge(fname, labels=("slice",)).labels(
                 slice="all"
             ).set(total)
+
+    # cimba-check: assume-held
+    def _mirror_tenants_locked(
+        self, name: str, tenants: Dict[str, Dict[str, float]],
+    ) -> None:
+        """Federate one slice's per-tenant QoS view (docs/27_qos.md):
+        the flattened family mirror above sums the tenant label away,
+        so each scraped ``cimba_serve_qos_*`` family lands again as
+        ``cimba_fleet_tenant_*{slice=<name>, tenant=<t>}`` gauges —
+        its own fleet namespace, so it can never collide with a
+        router-local serve family of a different kind — plus the
+        reserved ``slice="all"`` rollup summing live slices per
+        tenant.  One fleet ``/metrics`` then answers "is tenant X
+        being throttled anywhere, and how much is it completing
+        fleet-wide?"."""
+        reg = self._tel.registry
+        prefix = "cimba_serve_qos_"
+        seen = set()
+        for tname, row in tenants.items():
+            for fname, val in row.items():
+                if not fname.startswith(prefix):
+                    continue
+                reg.gauge(
+                    "cimba_fleet_tenant_" + fname[len(prefix):],
+                    labels=("slice", "tenant"),
+                ).labels(slice=name, tenant=tname).set(float(val))
+                seen.add(fname)
+        for fname in seen:
+            totals: Dict[str, float] = {}
+            for h2 in self._slices.values():
+                if not h2.up:
+                    continue
+                for tname, row in (
+                    h2.scraped.get("tenants") or {}
+                ).items():
+                    totals[tname] = (
+                        totals.get(tname, 0.0)
+                        + float(row.get(fname, 0.0))
+                    )
+            fam = reg.gauge(
+                "cimba_fleet_tenant_" + fname[len(prefix):],
+                labels=("slice", "tenant"),
+            )
+            for tname, total in totals.items():
+                fam.labels(slice="all", tenant=tname).set(total)
 
     # -- client surface ------------------------------------------------------
 
@@ -1076,6 +1127,11 @@ class FleetRouter:
             "priority": int(req.priority),
             "deadline": deadline,
             "label": req.label,
+            # the tenant id rides the run header (docs/27_qos.md) so a
+            # QoS-enabled slice applies the same per-tenant policy to
+            # routed traffic; a plain JSON key — additive, older
+            # slices ignore it (the wire.trace_context pattern)
+            "tenant": req.tenant,
         }
         rec = self._rec
         if rec is not None and entry.trace is not None:
@@ -1159,6 +1215,16 @@ class FleetRouter:
                 args.get("deadline_s", entry.request.deadline or 0.0),
                 args.get("waited_s", 0.0),
                 entry.label,
+            )
+        if type_name == "RetryAfter":
+            from cimba_tpu.serve.sched import RetryAfter
+
+            args = resp.get("args") or {}
+            return RetryAfter(
+                float(args.get("delay_s", 0.05)),
+                str(args.get("tenant", "default")),
+                reason=str(args.get("reason", "rate")),
+                label=entry.label,
             )
         return FleetRemoteError(type_name, message, entry.label)
 

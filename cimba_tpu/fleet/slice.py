@@ -85,7 +85,11 @@ def _error_header(e: Exception) -> dict:
         "message": str(e),
     }
     args = {}
-    for k in ("deadline_s", "waited_s", "attempts", "capacity"):
+    # delay_s/tenant/reason: RetryAfter's QoS backpressure fields
+    # (docs/27_qos.md) — the router reconstructs the throttle so the
+    # client's sleep-and-retry works across the wire unchanged
+    for k in ("deadline_s", "waited_s", "attempts", "capacity",
+              "delay_s", "tenant", "reason"):
         v = getattr(e, k, None)
         if v is not None:
             args[k] = v
@@ -269,6 +273,7 @@ class _SliceServer:
                 priority=int(header.get("priority", 0)),
                 deadline=header.get("deadline"),
                 label=header.get("label"),
+                tenant=header.get("tenant"),
                 # the router's trace id + wire-span parent: the
                 # service adopts them so this slice's span tree
                 # grafts under the router's (docs/23); absent or

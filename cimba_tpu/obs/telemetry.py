@@ -1055,15 +1055,16 @@ def _service_collector(registry: Registry, name: str, service):
     P = METRIC_PREFIX
     lab = {"service": name}
     req_counters = (
-        "submitted", "admitted", "rejected", "completed", "failed",
-        "cancelled", "deadline_exceeded",
+        "submitted", "admitted", "rejected", "throttled", "completed",
+        "failed", "cancelled", "deadline_exceeded",
     )
     raw_counters = (
         "retries", "batches", "waves", "lanes_dispatched", "lanes_padded",
         "digest_mismatches",
     )
-    rate_keys = ("completed", "cancelled", "deadline_exceeded", "retries")
-    prev = {"t": None, "vals": {}}
+    rate_keys = ("completed", "cancelled", "deadline_exceeded",
+                 "retries", "throttled")
+    prev = {"t": None, "vals": {}, "qos": {}}
 
     def collect():
         st = service.stats()
@@ -1176,6 +1177,54 @@ def _service_collector(registry: Registry, name: str, service):
                         P + f"serve_{k}_total",
                         k.replace("_", " "), labels=("service",),
                     ).labels(**lab).set_total(ds[k])
+        qs = st.get("qos")
+        if qs:
+            registry.gauge(
+                P + "serve_qos_enabled",
+                "multi-tenant QoS plane active (docs/27_qos.md)",
+                labels=("service",),
+            ).labels(**lab).set(1.0 if qs.get("enabled") else 0.0)
+            tenants = qs.get("tenants", {})
+            held = qs.get("lanes_held", {})
+            held_g = registry.gauge(
+                P + "serve_qos_lanes_held",
+                "lanes a tenant holds in flight against its quota",
+                labels=("service", "tenant"),
+            )
+            goodput_g = registry.gauge(
+                P + "serve_qos_goodput_ratio",
+                "completed / submitted per tenant",
+                labels=("service", "tenant"),
+            )
+            p99_g = registry.gauge(
+                P + "serve_qos_latency_p99_seconds",
+                "p99 completed-request latency per tenant over the "
+                "recent window",
+                labels=("service", "tenant"),
+            )
+            for tname, tc in tenants.items():
+                tlab = {"service": name, "tenant": tname}
+                for k in ("submitted", "admitted", "throttled",
+                          "throttled_rate", "throttled_quota",
+                          "completed", "deadline_exceeded",
+                          "claims", "lanes_claimed"):
+                    if k in tc:
+                        registry.counter(
+                            P + f"serve_qos_{k}_total",
+                            f"per-tenant requests {k.replace('_', ' ')}"
+                            " (docs/27_qos.md)",
+                            labels=("service", "tenant"),
+                        ).labels(**tlab).set_total(tc[k])
+                # every tenant ever seen reports, zeros included — the
+                # held gauge must drop to 0 when a tenant drains, and
+                # goodput is completed/submitted (the fairness signal
+                # a flooded victim's dashboard watches)
+                held_g.labels(**tlab).set(held.get(tname, 0))
+                sub = tc.get("submitted", 0)
+                goodput_g.labels(**tlab).set(
+                    tc.get("completed", 0) / sub if sub else 0.0
+                )
+                p99_g.labels(**tlab).set(tc.get("latency_p99_s", 0.0))
         registry.gauge(
             P + "serve_classes_seen", "distinct compatibility classes",
             labels=("service",),
@@ -1205,6 +1254,14 @@ def _service_collector(registry: Registry, name: str, service):
         # per-second outcome rates from the sampler's own cadence
         t_prev, vals_prev = prev["t"], prev["vals"]
         vals_now = {k: st[k] for k in rate_keys}
+        # per-tenant outcome rates (docs/27_qos.md): throttle and
+        # completion velocity per tenant — the live view of a flood
+        # being absorbed (cumulative counters only show it in slope)
+        qos_now = {}
+        if qs:
+            for tname, tc in qs.get("tenants", {}).items():
+                for k in ("completed", "throttled"):
+                    qos_now[(tname, k)] = tc.get(k, 0)
         if t_prev is not None and now > t_prev:
             dt = now - t_prev
             for k in rate_keys:
@@ -1216,6 +1273,15 @@ def _service_collector(registry: Registry, name: str, service):
                 ).labels(**lab).set(
                     max(vals_now[k] - vals_prev.get(k, 0), 0) / dt
                 )
-        prev["t"], prev["vals"] = now, vals_now
+            for (tname, k), v in qos_now.items():
+                registry.gauge(
+                    P + f"serve_qos_{k}_per_second",
+                    f"per-tenant {k} rate over the last sample "
+                    "interval (docs/27_qos.md)",
+                    labels=("service", "tenant"),
+                ).labels(service=name, tenant=tname).set(
+                    max(v - prev["qos"].get((tname, k), 0), 0) / dt
+                )
+        prev["t"], prev["vals"], prev["qos"] = now, vals_now, qos_now
 
     return collect

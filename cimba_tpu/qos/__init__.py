@@ -1,0 +1,33 @@
+"""Multi-tenant QoS plane for the serving layer (docs/27_qos.md).
+
+Users arrive as *tenants*, not requests: this package carries the
+per-tenant policy (:mod:`~cimba_tpu.qos.tenant`), the weighted-fair
+lane-share scheduler that apportions freed refill lanes across tenants
+(:mod:`~cimba_tpu.qos.fair`), and the admission-time quota/rate
+limiter whose rejections are structured
+:class:`~cimba_tpu.serve.sched.RetryAfter` backpressure
+(:mod:`~cimba_tpu.qos.limits`).
+
+Everything here is HOST-side admission policy: the tenant id never
+joins the program/compatibility class key, the chunk program is
+untouched (the ``qos`` gate in check/gates.py pins ambient inertness),
+and delivered results stay bitwise their direct solo calls regardless
+of the admission order QoS chooses.
+"""
+
+from cimba_tpu.qos.fair import FairScheduler
+from cimba_tpu.qos.limits import AdmissionLimiter, TokenBucket
+from cimba_tpu.qos.tenant import (
+    DEFAULT_TENANT,
+    TenantPolicy,
+    TenantRegistry,
+)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TenantPolicy",
+    "TenantRegistry",
+    "TokenBucket",
+    "AdmissionLimiter",
+    "FairScheduler",
+]

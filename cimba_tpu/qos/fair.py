@@ -1,0 +1,190 @@
+"""Weighted-fair lane shares: deficit round robin across tenants, EDF
+within a class.
+
+This is the policy that replaces the PR 15 priority-order prefix at
+the refill admission point.  The problem with the prefix: freed lanes
+go to the globally highest-priority queued requests, so one flooding
+tenant's backlog occupies every freed lane and every other tenant's
+p99 degrades without bound.  The fix is classic packet scheduling
+transplanted to lanes:
+
+* **Across tenants** — deficit round robin (DRR): each tenant carries
+  a persistent *deficit* counter; each pass over the tenants credits
+  ``weight x quantum`` and a tenant admits its head request only when
+  its deficit covers the request's lane demand.  Lanes are the packet
+  size, ``weight`` the link share: over time tenant lane shares
+  converge to weights regardless of how unbalanced the backlogs are.
+  A tenant whose backlog empties forfeits its residual deficit (the
+  standard DRR anti-hoarding rule), so idleness is not bankable.
+* **Within a tenant** — priority first (the existing user-visible
+  contract is untouched), then **EDF**: among equal-priority requests
+  the earliest ``deadline_at`` admits first (None = no deadline =
+  last), then the ``fmix64(seq)`` mix as the final tie-break — the
+  obs/audit.py host mixer, arbitrary but stable, so equal keys order
+  reproducibly and owe nothing to arrival interleaving.
+
+Everything is pure host arithmetic over the candidate list the
+admission queue offers under its lock (``take_selected``): no clocks,
+no randomness — two fresh services replaying one recorded stream make
+identical selections, which is the admission-determinism contract
+tests/test_qos.py pins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional
+
+from cimba_tpu.qos.tenant import TenantRegistry
+
+__all__ = ["FairScheduler", "entry_order_key", "tenant_mix"]
+
+#: hard cap on DRR credit passes per selection — deficits grow by
+#: ``weight x quantum > 0`` every pass, so any admissible head admits
+#: long before this; the cap only bounds a pathological weight spread
+_MAX_PASSES = 1024
+
+
+def tenant_mix(name: str) -> int:
+    """A stable 64-bit mix of a tenant name: blake2b (stable across
+    processes, unlike ``hash``) through the audit fmix64 — the DRR
+    visit order is arbitrary-but-reproducible, never alphabetic
+    favoritism, never list position."""
+    from cimba_tpu.obs.audit import _fmix64_host
+
+    h = hashlib.blake2b(name.encode("utf-8"), digest_size=8).digest()
+    return _fmix64_host(int.from_bytes(h, "big"))
+
+
+def entry_order_key(entry: Any):
+    """The within-tenant admission order: priority desc (the existing
+    contract), then EDF (earliest ``deadline_at``; no deadline last),
+    then ``fmix64(seq)`` — deterministic to the last tie."""
+    from cimba_tpu.obs.audit import _fmix64_host
+
+    dl = getattr(entry, "deadline_at", None)
+    return (
+        -entry.priority,
+        float("inf") if dl is None else float(dl),
+        _fmix64_host(int(entry.seq)),
+    )
+
+
+class FairScheduler:
+    """The per-service DRR state + selection policy.
+
+    One instance lives on the ``Service`` and is only touched from the
+    dispatcher thread (inside the queue's ``take_selected`` lock), so
+    it needs no lock of its own.  Deficits persist across claims —
+    that is what makes shares hold over time when per-boundary lane
+    budgets are lumpy."""
+
+    def __init__(self, registry: TenantRegistry):
+        self.registry = registry
+        self._deficit: Dict[str, float] = {}
+
+    def deficits(self) -> Dict[str, float]:
+        """Snapshot for ``stats()`` (dispatcher-thread consistent)."""
+        return dict(self._deficit)
+
+    def select(
+        self,
+        candidates: List[Any],
+        budget: int,
+        *,
+        lanes_of: Callable[[Any], int],
+        tenant_of: Callable[[Any], str],
+        room_of: Optional[Callable[[str], float]] = None,
+    ) -> List[Any]:
+        """Choose which candidates get the ``budget`` freed lanes.
+
+        ``candidates`` is the queue's whole ready set (already
+        class-filtered by the caller's closure); ``lanes_of`` the lane
+        demand per entry; ``tenant_of`` the resolved tenant id;
+        ``room_of`` the tenant's remaining lane-quota headroom
+        (``inf`` when unlimited).  Returns the selected entries in
+        admission order.  Within a tenant the order is strict
+        (priority / EDF / fmix64): a blocked head blocks its tenant —
+        admitting a later request over a blocked earlier one would
+        reintroduce the starvation this scheduler exists to end."""
+        if budget <= 0 or not candidates:
+            return []
+        groups: Dict[str, List[Any]] = {}
+        for e in candidates:
+            groups.setdefault(tenant_of(e), []).append(e)
+        for q in groups.values():
+            q.sort(key=entry_order_key)
+        order = sorted(groups, key=lambda t: (tenant_mix(t), t))
+        room = {
+            t: (float("inf") if room_of is None else float(room_of(t)))
+            for t in groups
+        }
+        heads = {t: 0 for t in groups}
+        # anti-hoarding: a tenant with no backlog right now forfeits
+        # its residual deficit
+        for t in list(self._deficit):
+            if t not in groups:
+                del self._deficit[t]
+        if len(groups) == 1:
+            # no contention, no deficit arithmetic: the sole backlogged
+            # tenant takes every lane its quota and the budget allow —
+            # weights are SHARES, and a share of an uncontended link is
+            # the whole link (a microscopic weight must not trickle)
+            (t,) = groups
+            out: List[Any] = []
+            left = int(budget)
+            for e in groups[t]:
+                n = lanes_of(e)
+                if n > left or n > room[t]:
+                    break
+                out.append(e)
+                left -= n
+                room[t] -= n
+            if len(out) == len(groups[t]):
+                # backlog emptied: forfeit residue, the standard rule
+                self._deficit.pop(t, None)
+            return out
+        quantum = max(
+            lanes_of(groups[t][0]) for t in groups
+        )
+        selected: List[Any] = []
+        budget_left = int(budget)
+        for _ in range(_MAX_PASSES):
+            if budget_left <= 0:
+                break
+            progressed = False
+            admissible = False
+            for t in order:
+                q = groups[t]
+                i = heads[t]
+                if i >= len(q):
+                    continue
+                w = self.registry.policy(t).weight
+                self._deficit[t] = (
+                    self._deficit.get(t, 0.0) + w * quantum
+                )
+                while i < len(q):
+                    e = q[i]
+                    n = lanes_of(e)
+                    if n > budget_left or n > room[t]:
+                        break
+                    admissible = True
+                    if n > self._deficit[t]:
+                        break
+                    selected.append(e)
+                    self._deficit[t] -= n
+                    budget_left -= n
+                    room[t] -= n
+                    i += 1
+                    progressed = True
+                heads[t] = i
+                if i >= len(q):
+                    # backlog emptied: forfeit the residue now, not at
+                    # the next claim — within this selection too,
+                    # idleness must not bank credit
+                    self._deficit.pop(t, None)
+                if budget_left <= 0:
+                    break
+            if not progressed and not admissible:
+                break
+        return selected

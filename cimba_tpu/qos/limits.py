@@ -1,0 +1,130 @@
+"""Admission-time quotas and rate limits with structured retry-after.
+
+The limiter runs on the SUBMIT path, before the request touches the
+admission queue: a request the tenant's policy cannot admit right now
+raises :class:`~cimba_tpu.serve.sched.RetryAfter` — never bare
+``QueueFull`` — naming the tenant, the reason (``"rate"`` |
+``"quota"``), and a concrete ``delay_s``.  Nothing was admitted, no
+lanes are held, other tenants are untouched; the client sleeps exactly
+``delay_s`` and retries (``serve/client.py`` honors it in the
+open-loop driver).
+
+Determinism: the token bucket takes an injectable ``clock`` so the
+replay contract — two fresh services fed one recorded stream produce
+identical admission/throttle logs — holds under a logical clock in
+tests, while production uses ``time.monotonic``.  The lane-quota check
+is pure arithmetic over the service's own accounting and needs no
+clock at all.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from cimba_tpu.qos.tenant import TenantRegistry
+from cimba_tpu.serve.sched import RetryAfter
+
+__all__ = ["TokenBucket", "AdmissionLimiter", "QUOTA_RETRY_S"]
+
+#: the retry hint for a lane-quota rejection: quota frees when one of
+#: the tenant's own requests retires, which the limiter cannot
+#: schedule — a short fixed poll interval beats a fake derivation
+QUOTA_RETRY_S = 0.05
+
+
+class TokenBucket:
+    """A deterministic token bucket: ``rate`` tokens/second refill,
+    ``burst`` depth, one token per submission.  NOT thread-safe on its
+    own — the owner (:class:`AdmissionLimiter`) serializes access.
+
+    The clock is sampled lazily at the first take, so a bucket built
+    at service construction does not grant a spurious head start to a
+    tenant that first submits much later (the bucket starts FULL; the
+    first ``burst`` submissions pass regardless)."""
+
+    def __init__(
+        self, rate: float, burst: int,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not (rate > 0):
+            raise ValueError(f"rate must be positive, got {rate}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._t: Optional[float] = None
+
+    def try_take(self, n: float = 1.0) -> float:
+        """Take ``n`` tokens: returns 0.0 on success, else the delay
+        in seconds until ``n`` tokens will have refilled (the bucket
+        is left untouched on failure — a throttled submission must not
+        drain what the retry needs)."""
+        now = self._clock()
+        if self._t is not None:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate
+            )
+        self._t = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return 0.0
+        return (n - self._tokens) / self.rate
+
+    def tokens(self) -> float:
+        return self._tokens
+
+
+class AdmissionLimiter:
+    """Per-tenant rate + lane-quota enforcement for one service.
+
+    Owns one :class:`TokenBucket` per rate-limited tenant (created on
+    first submission).  The caller (``Service.submit``) passes the
+    tenant's currently held lanes; the limiter is otherwise stateless
+    about lanes — the service's own accounting is the single source of
+    truth, so limiter and scheduler can never disagree about quota."""
+
+    def __init__(
+        self,
+        registry: TenantRegistry,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.registry = registry
+        self._clock = clock
+        self._buckets: Dict[str, TokenBucket] = {}
+
+    def check(
+        self, tenant: Optional[str], lanes: int, lanes_held: int,
+        label: Optional[str] = None,
+    ) -> None:
+        """Admit-or-raise for one submission: ``lanes`` is the
+        request's lane demand, ``lanes_held`` the tenant's lanes
+        currently in flight.  Raises :class:`RetryAfter`; returns
+        None on admit (the rate token is then spent)."""
+        policy = self.registry.policy(tenant)
+        name = self.registry.resolve(tenant)
+        if policy.lane_quota is not None \
+                and lanes_held + lanes > policy.lane_quota:
+            raise RetryAfter(
+                QUOTA_RETRY_S, name, reason="quota", label=label,
+            )
+        if policy.rate is not None:
+            bucket = self._buckets.get(name)
+            if bucket is None:
+                bucket = TokenBucket(
+                    policy.rate, policy.burst, clock=self._clock
+                )
+                self._buckets[name] = bucket
+            delay = bucket.try_take(1.0)
+            if delay > 0.0:
+                raise RetryAfter(
+                    delay, name, reason="rate", label=label,
+                )
+
+    def deadline_for(self, tenant: Optional[str]) -> Optional[float]:
+        """The tenant's ``deadline_class`` default (seconds), for
+        requests that carry no explicit deadline."""
+        return self.registry.policy(tenant).deadline_class

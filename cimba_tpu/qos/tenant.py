@@ -1,0 +1,148 @@
+"""The tenant model: who a request belongs to, and what that buys it.
+
+A :class:`TenantPolicy` is the whole per-tenant contract in one frozen
+record:
+
+* ``weight`` — the tenant's share of freed lanes under the
+  deficit-weighted round robin of :mod:`~cimba_tpu.qos.fair` (shares
+  are relative: weight 3.0 next to weight 1.0 gets ~3/4 of contended
+  lanes, and an uncontended tenant still gets everything);
+* ``lane_quota`` — a hard cap on the tenant's *concurrently held*
+  lanes (in flight + claimed), enforced both at submit (structured
+  :class:`~cimba_tpu.serve.sched.RetryAfter` with ``reason="quota"``)
+  and inside the fair claim (a tenant at quota is skipped, never
+  starves others);
+* ``rate``/``burst`` — a token bucket over *submissions*
+  (requests/second with ``burst`` depth), the flood valve: a tenant
+  past its rate gets ``RetryAfter(delay_s=...)`` naming exactly when a
+  retry can succeed;
+* ``deadline_class`` — a default deadline (seconds) stamped on the
+  tenant's requests that carry none, which is what the EDF ordering
+  within a compatibility class keys on.
+
+The :class:`TenantRegistry` maps tenant names to policies.  A request
+with ``tenant=None`` — or naming a tenant nobody registered — gets the
+registry's **default** policy: weight 1, no quota, no rate limit, no
+deadline class.  That default IS today's behavior, which is how the
+whole plane stays zero-cost off: with no registry (or ``CIMBA_QOS``
+unset) every request is the default tenant and admission reduces to
+the PR 15 priority-order prefix byte for byte.
+
+The tenant id is carried on ``Request(tenant=)`` beside
+``trace_context`` and is **never** part of the program/compatibility
+class key — two tenants' identical requests share one compiled
+program, one wave, one digest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["DEFAULT_TENANT", "TenantPolicy", "TenantRegistry"]
+
+#: the tenant every request without one belongs to
+DEFAULT_TENANT = "default"
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's QoS contract.  Frozen: policies are values, shared
+    freely across threads and snapshots."""
+
+    name: str
+    weight: float = 1.0
+    lane_quota: Optional[int] = None
+    rate: Optional[float] = None
+    burst: int = 8
+    deadline_class: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if not (self.weight > 0):
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be positive, "
+                f"got {self.weight}"
+            )
+        if self.lane_quota is not None and self.lane_quota <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: lane_quota must be positive, "
+                f"got {self.lane_quota}"
+            )
+        if self.rate is not None and not (self.rate > 0):
+            raise ValueError(
+                f"tenant {self.name!r}: rate must be positive, "
+                f"got {self.rate}"
+            )
+        if self.burst < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: burst must be >= 1, "
+                f"got {self.burst}"
+            )
+        if self.deadline_class is not None \
+                and not (self.deadline_class > 0):
+            raise ValueError(
+                f"tenant {self.name!r}: deadline_class must be "
+                f"positive, got {self.deadline_class}"
+            )
+
+
+class TenantRegistry:
+    """Name -> :class:`TenantPolicy`, with a default for everyone else.
+
+    Read-mostly and internally immutable after construction plus
+    explicit :meth:`register` calls; lookups take no lock (dict reads
+    are atomic, policies are frozen), which keeps :meth:`policy` safe
+    on the submit path and inside the dispatcher's claim."""
+
+    def __init__(
+        self,
+        policies: Iterable[TenantPolicy] = (),
+        *,
+        default: Optional[TenantPolicy] = None,
+    ):
+        self.default = (
+            default if default is not None
+            else TenantPolicy(DEFAULT_TENANT)
+        )
+        self._policies: Dict[str, TenantPolicy] = {
+            self.default.name: self.default
+        }
+        for p in policies:
+            self.register(p)
+
+    def register(self, policy: TenantPolicy) -> None:
+        if not isinstance(policy, TenantPolicy):
+            raise TypeError(
+                f"expected TenantPolicy, got {type(policy).__name__}"
+            )
+        self._policies[policy.name] = policy
+        if policy.name == self.default.name:
+            self.default = policy
+
+    def policy(self, name: Optional[str]) -> TenantPolicy:
+        """The effective policy for ``name``: ``None`` is the default
+        tenant; an unregistered name gets the default policy's limits
+        under its own name (so unknown tenants are fairly weighted
+        peers, not errors — registration is opt-in shaping)."""
+        if name is None:
+            return self.default
+        p = self._policies.get(name)
+        if p is not None:
+            return p
+        return replace(self.default, name=name)
+
+    def resolve(self, name: Optional[str]) -> str:
+        """The canonical tenant id a request with ``tenant=name``
+        belongs to (``None`` -> the default tenant's name)."""
+        return self.default.name if name is None else str(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._policies)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._policies
+
+    def __len__(self) -> int:
+        return len(self._policies)

@@ -24,7 +24,11 @@ warm-serving without recompiling, docs/15_program_store.md),
 :mod:`~cimba_tpu.serve.device` (the preemptive device scheduler —
 concurrent waves per device, memory-aware admission,
 checkpoint-evict-restore preemption, docs/24_device_scheduler.md),
-:mod:`~cimba_tpu.serve.client` (synthetic load drivers).
+:mod:`~cimba_tpu.serve.client` (synthetic load drivers).  The
+multi-tenant QoS plane — weighted-fair lane shares, quotas/rate limits
+with structured :class:`RetryAfter`, EDF deadlines at the refill
+admission point — lives in :mod:`cimba_tpu.qos` (docs/27_qos.md) and
+activates via ``Service(qos=True)`` / the ``CIMBA_QOS`` env knob.
 """
 
 from cimba_tpu.serve.cache import ProgramCache, warm
@@ -51,6 +55,7 @@ from cimba_tpu.serve.sched import (
     MemoryBudgetExceeded,
     QueueFull,
     RetriesExhausted,
+    RetryAfter,
     ServeError,
     ServiceClosed,
 )
@@ -65,5 +70,6 @@ __all__ = [
     "AdmissionQueue", "Backoff",
     "ServeError", "QueueFull", "ServiceClosed", "Cancelled",
     "DeadlineExceeded", "RetriesExhausted", "MemoryBudgetExceeded",
+    "RetryAfter",
     "Request", "ResultHandle", "Service",
 ]

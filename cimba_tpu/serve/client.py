@@ -16,6 +16,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
+from cimba_tpu.serve.sched import RetryAfter
+
 
 def percentile(xs: Sequence[float], q: float) -> float:
     """Nearest-rank percentile (q in [0, 100]) — dependency-free and
@@ -45,6 +47,13 @@ class LoadReport:
     latency_by_index: dict = field(default_factory=dict)
     #: request index -> template name, set by :func:`run_mixed_load`
     template_names: Optional[List[str]] = None
+    #: request index -> tenant id (None = default), set by
+    #: :func:`run_load` from the requests' own ``tenant`` fields
+    tenant_names: Optional[List[str]] = None
+    #: structured RetryAfter throttles observed at submit, by tenant
+    #: (docs/27_qos.md) — every sleep-and-retry counts, so the flood
+    #: pressure a QoS policy absorbed is visible, not hidden by retries
+    throttles_by_tenant: dict = field(default_factory=dict)
 
     @property
     def replications_per_sec(self) -> float:
@@ -67,6 +76,8 @@ class LoadReport:
             "replications_per_sec": self.replications_per_sec,
             "errors": dict(self.errors),
         }
+        if self.throttles_by_tenant:
+            out["throttles"] = sum(self.throttles_by_tenant.values())
         out.update(self.latency_percentiles())
         return out
 
@@ -104,6 +115,44 @@ class LoadReport:
             }
         return out
 
+    def per_tenant(self) -> dict:
+        """Latency percentiles, goodput, and throttle counts grouped
+        by tenant (docs/27_qos.md): ``{tenant: {count, completed,
+        goodput, throttled, p50_s, p95_s, p99_s, max_s}}``.  The
+        per-tenant tail is the QoS claim itself — under a flooding
+        tenant, the victims' p99/goodput here is what the fair-share
+        scheduler protects (the aggregate hides it)."""
+        if self.tenant_names is None:
+            raise ValueError(
+                "per_tenant() needs tenant_names — drive the load "
+                "with run_load()/run_mixed_load()"
+            )
+        groups: dict = {}
+        for i, name in enumerate(self.tenant_names):
+            g = groups.setdefault(
+                name or "default", {"count": 0, "completed": 0, "lat": []}
+            )
+            g["count"] += 1
+            if i in self.latency_by_index:
+                g["completed"] += 1
+                g["lat"].append(self.latency_by_index[i])
+        out = {}
+        for name, g in groups.items():
+            lat = g["lat"]
+            out[name] = {
+                "count": g["count"],
+                "completed": g["completed"],
+                "goodput": (
+                    g["completed"] / g["count"] if g["count"] else 0.0
+                ),
+                "throttled": self.throttles_by_tenant.get(name, 0),
+                "p50_s": percentile(lat, 50),
+                "p95_s": percentile(lat, 95),
+                "p99_s": percentile(lat, 99),
+                "max_s": max(lat) if lat else float("nan"),
+            }
+        return out
+
 
 def run_load(
     service,
@@ -115,6 +164,7 @@ def run_load(
     submit_timeout: Optional[float] = None,
     result_timeout: Optional[float] = None,
     on_result: Optional[Callable] = None,
+    max_retry_after: int = 8,
 ) -> LoadReport:
     """Drive ``service`` with ``requests`` from ``n_clients`` threads.
 
@@ -124,7 +174,12 @@ def run_load(
     until its time, submit, and immediately move on — a second pass
     collects every future, so slow results never throttle arrivals.
     Admission rejects (``QueueFull``) and structured failures are
-    counted per error class in the report.  ``results`` keeps completed
+    counted per error class in the report.  A structured
+    :class:`~cimba_tpu.serve.sched.RetryAfter` throttle is HONORED
+    (docs/27_qos.md): the client sleeps exactly the server's
+    ``delay_s`` and resubmits, up to ``max_retry_after`` times per
+    request before counting it as an error — every throttle is tallied
+    per tenant in ``throttles_by_tenant``.  ``results`` keeps completed
     ``(index, StreamResult)`` pairs in arrival order for correctness
     checks (``on_result(i, res)`` streams them instead when holding all
     results would be too much)."""
@@ -133,6 +188,7 @@ def run_load(
     lock = threading.Lock()
     handles: List[Optional[tuple]] = [None] * len(requests)
     errors: dict = {}
+    throttles: dict = {}
 
     def client():
         while True:
@@ -146,18 +202,36 @@ def run_load(
             if delay > 0:
                 time.sleep(delay)
             sub_t = time.perf_counter()
-            try:
-                h = service.submit(
-                    requests[i], block=submit_block,
-                    timeout=submit_timeout,
-                )
-            except Exception as e:
-                with lock:
-                    errors[type(e).__name__] = (
-                        errors.get(type(e).__name__, 0) + 1
+            sub_mono = time.monotonic()
+            attempts = 0
+            while True:
+                try:
+                    h = service.submit(
+                        requests[i], block=submit_block,
+                        timeout=submit_timeout,
                     )
-                continue
-            handles[i] = (sub_t, h)
+                except RetryAfter as e:
+                    with lock:
+                        throttles[e.tenant] = (
+                            throttles.get(e.tenant, 0) + 1
+                        )
+                    attempts += 1
+                    if attempts > max_retry_after:
+                        with lock:
+                            errors["RetryAfter"] = (
+                                errors.get("RetryAfter", 0) + 1
+                            )
+                        break
+                    time.sleep(e.delay_s)
+                    continue
+                except Exception as e:
+                    with lock:
+                        errors[type(e).__name__] = (
+                            errors.get(type(e).__name__, 0) + 1
+                        )
+                    break
+                handles[i] = (sub_t, sub_mono, h)
+                break
 
     threads = [
         threading.Thread(target=client, daemon=True)
@@ -176,13 +250,23 @@ def run_load(
     for i, rec in enumerate(handles):
         if rec is None:
             continue
-        sub_t, h = rec
+        sub_t, sub_mono, h = rec
         try:
             res = h.result(timeout=result_timeout)
         except Exception as e:
             errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
             continue
-        lat = time.perf_counter() - sub_t
+        # DELIVERY latency, not collection latency: the dispatcher's
+        # monotonic finish stamp against this request's monotonic
+        # submit stamp.  The sequential collection pass here can reach
+        # a long-resolved future arbitrarily late (e.g. while other
+        # client threads sit in RetryAfter sleeps) — the wall-clock
+        # fallback only covers handles without the stamp.
+        ft = getattr(h, "finish_t", None)
+        lat = (
+            ft - sub_mono if ft is not None
+            else time.perf_counter() - sub_t
+        )
         latencies.append(lat)
         latency_by_index[i] = lat
         n_completed += 1
@@ -200,6 +284,10 @@ def run_load(
         errors=errors,
         results=results,
         latency_by_index=latency_by_index,
+        tenant_names=[
+            getattr(r, "tenant", None) for r in requests
+        ],
+        throttles_by_tenant=throttles,
     )
 
 
@@ -213,11 +301,14 @@ class RequestTemplate:
     workload's shape is) plus its relative ``weight`` in the arrival
     stream.  :func:`mixed_requests` interleaves templates
     proportionally; each instance is a ``dataclasses.replace`` clone
-    labelled ``{name}#{i}``."""
+    labelled ``{name}#{i}``.  ``tenant`` (docs/27_qos.md) stamps every
+    instance with a tenant id — how an adversarial mix puts a flooding
+    tenant and its victims through one service."""
 
     name: str
     request: Any
     weight: float = 1.0
+    tenant: Optional[str] = None
 
 
 def mixed_requests(
@@ -248,9 +339,10 @@ def mixed_requests(
         j = max(range(len(templates)), key=lambda k: credit[k])
         credit[j] -= sum(t.weight for t in templates)
         t = templates[j]
-        requests.append(dataclasses.replace(
-            t.request, label=f"{t.name}#{counts[j]}"
-        ))
+        kw = {"label": f"{t.name}#{counts[j]}"}
+        if t.tenant is not None:
+            kw["tenant"] = t.tenant
+        requests.append(dataclasses.replace(t.request, **kw))
         names.append(t.name)
         counts[j] += 1
     return requests, names
@@ -266,7 +358,9 @@ def run_mixed_load(
     heterogeneous-traffic load shape of docs/14_wave_packing.md) and
     report per-template latency percentiles on top of the aggregate:
     the returned report's :meth:`LoadReport.per_template` groups
-    completions by template name.  Occupancy/padding live in
+    completions by template name (and :meth:`LoadReport.per_tenant` by
+    tenant id when templates carry tenants — the QoS fairness view).
+    Occupancy/padding live in
     ``service.stats()`` (``batch_occupancy``, ``lane_occupancy``) —
     the bench ``serve_mixed`` arm reads both."""
     requests, names = mixed_requests(templates, n_requests)

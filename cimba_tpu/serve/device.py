@@ -140,6 +140,22 @@ class WaveTask:
                 best = p
         return 0 if best is None else best
 
+    def earliest_deadline(self) -> float:
+        """The earliest ``deadline_at`` over the wave's live members
+        (``+inf`` when none carries one) — the EDF refinement of the
+        restore order (docs/27_qos.md): among equal-priority preempted
+        waves, the one whose tightest live deadline expires first
+        restores first, so a deadline-carrying wave does not burn its
+        remaining budget parked behind a deadline-free peer."""
+        best = float("inf")
+        for s in self.wave.slots:
+            if s.folded or s.entry.done.is_set():
+                continue
+            dl = s.entry.deadline_at
+            if dl is not None and dl < best:
+                best = dl
+        return best
+
 
 class DeviceScheduler:
     """The device-owner scheduling loop ``Service._loop`` delegates to
@@ -548,7 +564,10 @@ class DeviceScheduler:
         budget free up — priority order (max live-member priority),
         NOT eviction order: an urgent wave preempted under earlier
         pressure must come back before a background wave that merely
-        got evicted first.  Ties break deterministically by
+        got evicted first.  Equal priority breaks by EDF — the wave
+        whose earliest live-member ``deadline_at`` expires first
+        restores first (deadline-aware restore, docs/27_qos.md; waves
+        with no deadlines sort last) — then deterministically by
         ``fmix64(batch_no)`` (the obs/audit.py host mixer — arbitrary
         but stable, so equal-priority restore order is reproducible
         and owes nothing to list position).  With NO running wave the
@@ -567,7 +586,8 @@ class DeviceScheduler:
         task = max(
             preempted,
             key=lambda t: (
-                t.priority(), _fmix64_host(t.wave.batch_no),
+                t.priority(), -t.earliest_deadline(),
+                _fmix64_host(t.wave.batch_no),
             ),
         )
         if running:

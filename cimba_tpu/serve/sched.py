@@ -16,9 +16,9 @@ jax — so the scheduling policy is testable without a device.
   reproducible schedules beat decorrelation at a single dispatcher).
 * The structured error taxonomy: :class:`DeadlineExceeded`,
   :class:`Cancelled`, :class:`QueueFull`, :class:`ServiceClosed`,
-  :class:`RetriesExhausted`, :class:`MemoryBudgetExceeded` — all
-  subclasses of :class:`ServeError`, all carrying enough state to be
-  actionable without parsing strings.
+  :class:`RetriesExhausted`, :class:`MemoryBudgetExceeded`,
+  :class:`RetryAfter` — all subclasses of :class:`ServeError`, all
+  carrying enough state to be actionable without parsing strings.
 
 Ordering: higher ``priority`` pops first; ties break FIFO by admission
 sequence number (a total order — the pack scan is deterministic).
@@ -123,6 +123,31 @@ class MemoryBudgetExceeded(ServeError):
         super().__init__(
             f"estimated wave footprint {self.needed_bytes} B exceeds "
             f"the device memory budget {self.budget_bytes} B"
+            + (f" (request {label!r})" if label else "")
+        )
+
+
+class RetryAfter(ServeError):
+    """Admission throttled by the tenant's QoS policy (docs/27_qos.md):
+    the tenant's token bucket is empty or its lane quota is saturated.
+    Unlike bare :class:`QueueFull` this is *structured* backpressure —
+    it names the tenant, the reason (``"rate"`` | ``"quota"``), and a
+    concrete ``delay_s`` after which a retry can succeed, so a client
+    can sleep exactly that long instead of guessing.  Other tenants'
+    admission is untouched; the request was never admitted (nothing to
+    cancel, no lanes held)."""
+
+    def __init__(
+        self, delay_s: float, tenant: str, reason: str = "rate",
+        label: Optional[str] = None,
+    ):
+        self.delay_s = float(delay_s)
+        self.tenant = str(tenant)
+        self.reason = str(reason)
+        self.label = label
+        super().__init__(
+            f"tenant {tenant!r} throttled ({reason}): retry after "
+            f"{self.delay_s:.3f}s"
             + (f" (request {label!r})" if label else "")
         )
 
@@ -376,6 +401,33 @@ class AdmissionQueue:
                     kept.append((key, entry))
             if taken:
                 self._heap = kept
+                heapq.heapify(self._heap)
+                self._not_full.notify_all()
+            return taken
+
+    def take_selected(
+        self, selector: Callable[[List[Any]], List[Any]],
+    ) -> List[Any]:
+        """Offer the WHOLE ready set (priority order) to ``selector``
+        and remove exactly the entries it returns — the QoS wave-fill
+        hook (docs/27_qos.md).  Where :meth:`take` commits to each
+        entry with a single-pass predicate, a weighted-fair policy
+        needs to see every candidate before choosing any (a flooding
+        tenant's older requests must not pre-empt the scan); the
+        selector runs under the queue lock, so it must be cheap, pure
+        over its argument, and must not touch the queue.  Returns the
+        selected entries in the selector's order.  Backoff-delayed
+        entries are not offered: they are serving their delay."""
+        with self._lock:
+            self._mature(time.monotonic())
+            offered = [entry for _, entry in sorted(self._heap)]
+            taken = selector(offered)
+            if taken:
+                chosen = {id(e) for e in taken}
+                self._heap = [
+                    (key, entry) for key, entry in self._heap
+                    if id(entry) not in chosen
+                ]
                 heapq.heapify(self._heap)
                 self._not_full.notify_all()
             return taken

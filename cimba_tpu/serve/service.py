@@ -58,6 +58,7 @@ from cimba_tpu.serve.sched import (
     DeadlineExceeded,
     QueueFull,
     RetriesExhausted,
+    RetryAfter,
     ServeError,
     ServiceClosed,
 )
@@ -220,6 +221,13 @@ class Request:
     # None (the default) means a locally-rooted trace; ignored when the
     # service has no telemetry plane.  Never part of the class key.
     trace_context: Optional[dict] = None
+    # multi-tenant QoS (docs/27_qos.md): who this request belongs to.
+    # None = the registry's default tenant — exactly today's behavior.
+    # Admission POLICY only (fair lane shares, quotas, rate limits,
+    # deadline-class defaults): the tenant id is NEVER part of the
+    # program/compatibility class key — two tenants' identical requests
+    # share one compiled program, one wave, one bitwise digest.
+    tenant: Optional[str] = None
 
     def __post_init__(self):
         if self.summary_path is None:
@@ -234,8 +242,9 @@ class _Entry:
         "with_metrics", "next_lo", "acc", "n_waves", "retries", "solo",
         "cancelled", "in_flight", "submit_t", "first_dispatch_t",
         "deadline_at", "done", "result", "exc", "result_digest",
+        "finish_t",
         "trace", "span_root", "span_queue", "span_wave",
-        "fuse_cls", "spec_fp",
+        "fuse_cls", "spec_fp", "tenant",
     )
 
     def __init__(self, request, seq, cls, eff_wave, with_metrics):
@@ -263,6 +272,7 @@ class _Entry:
         self.result = None
         self.exc = None
         self.result_digest = None
+        self.finish_t = None       # monotonic stamp set by _finish
         # telemetry span state — all None when the service has no
         # telemetry plane (the zero-allocation hot-submit contract)
         self.trace = None
@@ -275,6 +285,9 @@ class _Entry:
         # the class's member roster at submit
         self.fuse_cls = None
         self.spec_fp = None
+        # resolved tenant id (docs/27_qos.md) — stamped at submit from
+        # the service's registry (None request.tenant -> "default")
+        self.tenant = None
 
 
 class ResultHandle:
@@ -290,6 +303,16 @@ class ResultHandle:
 
     def done(self) -> bool:
         return self._entry.done.is_set()
+
+    @property
+    def finish_t(self) -> Optional[float]:
+        """``time.monotonic()`` stamp of the moment the dispatcher
+        retired this request (None while still in flight).  Load
+        drivers pair it with their own monotonic submit stamp to get
+        DELIVERY latency — a future collected long after it resolved
+        must not read as slow (docs/27_qos.md measures per-tenant
+        tails this way)."""
+        return self._entry.finish_t
 
     def cancel(self) -> bool:
         """Cancel if still undispatched; returns False once any slot is
@@ -351,6 +374,18 @@ _DEVSCHED_COUNTERS = (
 #: could not join a fusion class (unfusable structure or a full roster)
 _FUSION_COUNTERS = (
     "fused_batches", "fused_waves", "fused_lanes", "fusion_rejects",
+)
+
+#: per-tenant QoS counters (docs/27_qos.md) — grouped per tenant in
+#: ``stats()["qos"]["tenants"]`` and mirrored as tenant-labeled
+#: ``cimba_serve_qos_*`` telemetry families.  ``throttled`` splits by
+#: reason (``throttled_rate`` + ``throttled_quota``); outcome counters
+#: mirror the service-level ``_OUTCOMES`` names so per-tenant goodput
+#: is ``completed / submitted`` with no new vocabulary.
+_QOS_TENANT_COUNTERS = (
+    "submitted", "admitted", "throttled", "throttled_rate",
+    "throttled_quota", "completed", "failed", "cancelled",
+    "deadline_exceeded", "claims", "lanes_claimed",
 )
 
 
@@ -470,6 +505,21 @@ class Service:
       ``tune.space`` defaults.  Off, dispatch is byte-identical to the
       refill/plain paths (the 'device_sched' trace gate pins this).
 
+    * ``qos`` (default None → the ``CIMBA_QOS`` env knob, unset =
+      off): the multi-tenant QoS plane (docs/27_qos.md) — freed refill
+      lanes apportion across tenants by deficit-weighted round robin
+      (``tenants``: a :class:`cimba_tpu.qos.TenantRegistry` of per-
+      tenant weight / lane quota / rate limit / deadline class),
+      equal-priority requests within a class order by earliest
+      deadline (EDF), and a tenant past its quota or rate gets
+      structured :class:`~cimba_tpu.serve.sched.RetryAfter` at submit
+      instead of queueing.  Host-side admission POLICY only: the
+      tenant never joins the class key, compiled programs are
+      byte-identical either way (the 'qos' trace gate pins this), and
+      every delivered result stays bitwise its direct solo call
+      regardless of the admission order QoS chooses.  Off, admission
+      is the historical priority-order prefix, byte for byte.
+
     ``telemetry`` (default None) attaches a
     :class:`cimba_tpu.obs.telemetry.Telemetry` plane: the background
     sampler scrapes :meth:`stats` into the time-series registry, the
@@ -480,7 +530,7 @@ class Service:
     span log (docs/17_telemetry.md).  None is strictly zero-cost: no
     threads, no span allocations, compiled programs untouched."""
 
-    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples, _waves_live, _est_free_mem, _waves_per_device, _preempt_quantum, _mem_fraction, _mem_budget_bytes, _fuse_roster, _fuse_max_specs
+    # cimba-check: must-hold(_lock) _counters, _outstanding, _seq, _closed, _stop, _occupancy, _class_ids, _spans, _depth_samples, _ttfw_sum, _ttfw_max, _ttfw_n, _sched_sources, _schedules, _occ_samples, _waves_live, _est_free_mem, _waves_per_device, _preempt_quantum, _mem_fraction, _mem_budget_bytes, _fuse_roster, _fuse_max_specs, _qos_lanes_held, _qos_tenant_counters, _qos_log, _qos_lat
 
     def __init__(
         self,
@@ -506,6 +556,9 @@ class Service:
         preempt_quantum: Optional[int] = None,
         mem_fraction: Optional[float] = None,
         mem_budget_bytes: Optional[int] = None,
+        qos: Optional[bool] = None,
+        tenants=None,
+        qos_clock: Optional[Callable[[], float]] = None,
         name: str = "cimba-serve",
     ):
         from cimba_tpu import config as _config
@@ -608,6 +661,52 @@ class Service:
             raise ValueError(
                 f"mem_fraction must be in (0, 1]: {mem_fraction}"
             )
+        # the multi-tenant QoS plane (docs/27_qos.md): None defers to
+        # the CIMBA_QOS env knob (unset = off — admission is the PR 15
+        # priority-order prefix, byte for byte; the 'qos' trace gate
+        # pins ambient inertness).  On, freed refill lanes apportion
+        # across tenants by deficit-weighted round robin, equal-
+        # priority requests order by earliest deadline, and per-tenant
+        # quotas/rate limits throttle at submit with structured
+        # RetryAfter.  HOST-side admission policy only: the tenant id
+        # never joins the class key, and delivered results stay bitwise
+        # their direct solo calls regardless of admission order.
+        # ``tenants`` is a qos.TenantRegistry (one is created if not
+        # given — every tenant then runs the unlimited default policy,
+        # fairly weighted); ``qos_clock`` injects the rate-limiter
+        # clock (replay-determinism tests pin throttle logs under a
+        # logical clock; production uses time.monotonic).
+        from cimba_tpu.qos import (
+            AdmissionLimiter as _QosLimiter,
+            FairScheduler as _QosSched,
+            TenantRegistry as _TenantRegistry,
+        )
+
+        self.qos = (
+            _config.env_raw("CIMBA_QOS") == "1" if qos is None
+            else bool(qos)
+        )
+        self._tenants = (
+            tenants if tenants is not None else _TenantRegistry()
+        )
+        # DRR deficits: dispatcher-thread only (inside the queue's
+        # take_selected lock) — needs no service lock
+        self._qos_sched = _QosSched(self._tenants)
+        self._qos_limiter = _QosLimiter(
+            self._tenants,
+            clock=time.monotonic if qos_clock is None else qos_clock,
+        )
+        self._qos_lanes_held: dict = {}      # tenant -> lanes in flight
+        self._qos_tenant_counters: dict = {}  # tenant -> counter dict
+        # the admission log the replay-determinism contract pins
+        # (docs/27_qos.md): ("claim", tenant, seq, lanes) per fair-claim
+        # admission and ("throttle", tenant, seq, lanes, reason) per
+        # submit-time RetryAfter, in decision order
+        self._qos_log = deque(maxlen=4096)
+        # per-tenant completed-request latency window: what feeds the
+        # stats()/telemetry p99 gauge — the victim-tail signal a QoS
+        # dashboard watches under a flooding tenant
+        self._qos_lat: dict = {}             # tenant -> deque[float]
         self.max_retries = int(max_retries)
         self.backoff = backoff
         self.cache = cache if cache is not None else _pcache.ProgramCache()
@@ -631,6 +730,7 @@ class Service:
         self._depth_samples = deque(maxlen=trace_cap)
         self._counters = {
             "submitted": 0, "admitted": 0, "rejected": 0,
+            "throttled": 0,
             "retries": 0, "batches": 0, "waves": 0,
             "lanes_dispatched": 0, "lanes_padded": 0,
             "digest_mismatches": 0,
@@ -796,6 +896,16 @@ class Service:
             self._schedules[label] = rs.block()
             entry = _Entry(request, self._seq, cls, eff_wave,
                            with_metrics)
+            entry.tenant = self._tenants.resolve(request.tenant)
+            if self.qos:
+                self._qos_tenant(entry.tenant)["submitted"] += 1
+                if entry.deadline_at is None:
+                    # the tenant's deadline_class stamps a default
+                    # deadline on requests that carry none — what the
+                    # EDF ordering within a class keys on (docs/27)
+                    dc = self._qos_limiter.deadline_for(request.tenant)
+                    if dc is not None:
+                        entry.deadline_at = entry.submit_t + dc
             if self.fuse:
                 self._bind_fusion(entry, fuse_cls)
             self._outstanding += 1
@@ -832,6 +942,42 @@ class Service:
             entry.span_queue = rec.start(
                 entry.trace, "queue", parent=entry.span_root
             )
+        if self.qos:
+            # quota/rate admission control (docs/27_qos.md): a tenant
+            # past its policy gets structured RetryAfter — never bare
+            # QueueFull — naming the tenant, the reason, and a concrete
+            # delay; nothing was admitted and the span tree closes
+            # exactly once with the 'throttled' outcome, mirroring the
+            # reject path below.  Checked under the service lock: the
+            # lanes-held read and the token-bucket take must be atomic
+            # against concurrent submits.
+            try:
+                with self._lock:
+                    self._qos_limiter.check(
+                        request.tenant, R,
+                        self._qos_lanes_held.get(entry.tenant, 0),
+                        label=entry.label,
+                    )
+            except RetryAfter as e:
+                with self._lock:
+                    self._outstanding -= 1
+                    self._counters["throttled"] += 1
+                    tc = self._qos_tenant(entry.tenant)
+                    tc["throttled"] += 1
+                    tc["throttled_" + e.reason] += 1
+                    self._qos_log.append((
+                        "throttle", entry.tenant, int(entry.seq),
+                        int(R), e.reason,
+                    ))
+                    self._drained.notify_all()
+                if self._tel is not None:
+                    self._tel.observe_request(
+                        self._tel_name, "throttled",
+                        time.monotonic() - entry.submit_t, None,
+                    )
+                if rec is not None:
+                    rec.end_trace(entry.trace, "throttled")
+                raise
         try:
             self._queue.put(entry, block=block, timeout=timeout)
         except (QueueFull, ServiceClosed):
@@ -844,6 +990,11 @@ class Service:
             raise
         with self._lock:
             self._counters["admitted"] += 1
+            if self.qos:
+                self._qos_tenant(entry.tenant)["admitted"] += 1
+                self._qos_lanes_held[entry.tenant] = (
+                    self._qos_lanes_held.get(entry.tenant, 0) + R
+                )
         if rec is not None:
             # instant marker only — safe after put even if the request
             # already completed (events never re-open a trace)
@@ -977,6 +1128,26 @@ class Service:
             }
             for k in _FUSION_COUNTERS:
                 out["fusion"][k] = self._counters[k]
+            # the QoS plane (docs/27_qos.md): per-tenant counters
+            # (goodput = completed/submitted), lanes currently held
+            # against quota, the live DRR deficits, and the admission
+            # log the replay-determinism contract compares
+            qos_tenants = {}
+            for t, c in sorted(self._qos_tenant_counters.items()):
+                xs = sorted(self._qos_lat.get(t, ()))
+                p99 = (
+                    xs[min(len(xs) - 1,
+                           int(round(0.99 * (len(xs) - 1))))]
+                    if xs else 0.0
+                )
+                qos_tenants[t] = dict(c, latency_p99_s=p99)
+            out["qos"] = {
+                "enabled": self.qos,
+                "tenants": qos_tenants,
+                "lanes_held": dict(self._qos_lanes_held),
+                "deficits": self._qos_sched.deficits(),
+                "admission_log": [list(ev) for ev in self._qos_log],
+            }
             occ_samples = list(self._occ_samples)
             out["time_to_first_wave"] = {
                 "count": self._ttfw_n,
@@ -1213,6 +1384,7 @@ class Service:
             entry.result = result
             entry.exc = exc
             now = time.monotonic()
+            entry.finish_t = now
             self._counters[outcome] += 1
             ttfw = (
                 None if entry.first_dispatch_t is None
@@ -1233,6 +1405,25 @@ class Service:
                 self._ttfw_sum += ttfw
                 self._ttfw_max = max(self._ttfw_max, ttfw)
                 self._ttfw_n += 1
+            if self.qos and entry.tenant is not None:
+                # quota release: the tenant's lanes free the moment the
+                # request retires, whatever the outcome — and the
+                # per-tenant outcome counter feeds the goodput gauges
+                held = self._qos_lanes_held.get(entry.tenant, 0) \
+                    - entry.request.n_replications
+                if held > 0:
+                    self._qos_lanes_held[entry.tenant] = held
+                else:
+                    self._qos_lanes_held.pop(entry.tenant, None)
+                tc = self._qos_tenant(entry.tenant)
+                if outcome in tc:
+                    tc[outcome] += 1
+                if outcome == "completed":
+                    lat = self._qos_lat.get(entry.tenant)
+                    if lat is None:
+                        lat = deque(maxlen=512)
+                        self._qos_lat[entry.tenant] = lat
+                    lat.append(now - entry.submit_t)
             self._outstanding -= 1
             entry.done.set()
             self._drained.notify_all()
@@ -1250,6 +1441,17 @@ class Service:
                 # request still yields one COMPLETE span tree
                 rec.end_trace(entry.trace, outcome,
                               retries=entry.retries)
+
+    # cimba-check: assume-held
+    def _qos_tenant(self, name: str) -> dict:
+        """The per-tenant QoS counter dict (created zeroed on first
+        touch, so stats always reports full rows).  Caller holds the
+        service lock."""
+        tc = self._qos_tenant_counters.get(name)
+        if tc is None:
+            tc = {k: 0 for k in _QOS_TENANT_COUNTERS}
+            self._qos_tenant_counters[name] = tc
+        return tc
 
     # cimba-check: assume-held
     def _adopt_sched_knobs(self, sched) -> None:
@@ -1926,6 +2128,14 @@ class Service:
         strict_priority it trips the same fairness valve any other
         class does, so a stale fused wave drains instead of starving a
         grown roster."""
+        if self.qos:
+            # the QoS plane (docs/27_qos.md) swaps the priority-order
+            # prefix for the deficit-weighted fair claim — same
+            # compatibility and valve semantics, tenant-fair lanes
+            return self._claim_fair(
+                cls, budget, now, strict_priority=strict_priority,
+                fuse_cls=fuse_cls, fuse_members=fuse_members,
+            )
         planned: list = []
         dropped: list = []
         state = {"budget": int(budget), "blocked": False}
@@ -1959,6 +2169,91 @@ class Service:
             return True
 
         self._queue.take(want)
+        for e in dropped:
+            self._finish(
+                e,
+                exc=DeadlineExceeded(
+                    e.request.deadline, now - e.submit_t, e.label,
+                ),
+                outcome="deadline_exceeded",
+            )
+        return planned
+
+    def _claim_fair(self, cls, budget: int, now: float, *,
+                    strict_priority: bool, fuse_cls=None,
+                    fuse_members=None) -> list:
+        """The QoS twin of :meth:`_claim_compatible` (docs/27_qos.md):
+        identical compatibility, tombstone, and deadline semantics, and
+        the SAME cross-class fairness valve under ``strict_priority`` —
+        but the freed lanes apportion across TENANTS by the
+        deficit-weighted round robin of
+        :class:`cimba_tpu.qos.FairScheduler` (priority, then EDF, then
+        fmix64 within a tenant) instead of going to the global
+        priority-order prefix, so one flooding tenant's backlog can no
+        longer occupy every freed lane.  The whole ready set is offered
+        under the queue lock (``take_selected``) and the selection is
+        pure host arithmetic: two fresh services replaying one stream
+        produce identical admission logs (the determinism contract
+        tests/test_qos.py pins)."""
+        planned: list = []
+        dropped: list = []
+
+        def compatible(e: _Entry) -> bool:
+            if e.cls == cls:
+                return True
+            if fuse_cls is None or e.fuse_cls != fuse_cls:
+                return False
+            if fuse_members is None:
+                return True
+            return e.spec_fp is not None and e.spec_fp in fuse_members
+
+        def selector(offered):
+            take: list = []
+            cands: list = []
+            blocked = False
+            for e in offered:
+                if e.done.is_set():
+                    take.append(e)   # cancelled tombstone: just remove
+                    continue
+                if e.deadline_at is not None and now > e.deadline_at:
+                    dropped.append(e)
+                    take.append(e)
+                    continue
+                if blocked:
+                    continue
+                if e.solo or not compatible(e) or e.cancelled:
+                    # the cross-class fairness valve is UNCHANGED by
+                    # tenant fairness (docs/22_refill.md): foreign work
+                    # still stops a boundary admission scan cold, so a
+                    # long-lived wave drains instead of starving other
+                    # classes — QoS reorders WITHIN the claimable set
+                    if strict_priority:
+                        blocked = True
+                    continue
+                cands.append(e)
+            chosen = self._qos_sched.select(
+                cands, int(budget),
+                lanes_of=self._refill_slot_size,
+                tenant_of=lambda e: (
+                    e.tenant if e.tenant is not None
+                    else self._tenants.resolve(None)
+                ),
+            )
+            for e in chosen:
+                planned.append((e, self._refill_slot_size(e)))
+            take.extend(chosen)
+            return take
+
+        self._queue.take_selected(selector)
+        if planned:
+            with self._lock:
+                for e, m in planned:
+                    self._qos_log.append(
+                        ("claim", e.tenant, int(e.seq), int(m))
+                    )
+                    tc = self._qos_tenant(e.tenant)
+                    tc["claims"] += 1
+                    tc["lanes_claimed"] += m
         for e in dropped:
             self._finish(
                 e,

@@ -127,7 +127,16 @@ class _Gated(serve.Service):
 # --------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("profile", ["f64", "f32"])
+@pytest.mark.parametrize(
+    "profile",
+    [
+        "f64",
+        # displaced for the qos suite: the f64 twin stays tier-1 and
+        # ci.sh "fusion smoke" runs the 3-distinct-spec fused wave
+        # bitwise vs direct every pass
+        pytest.param("f32", marks=pytest.mark.slow),
+    ],
+)
 def test_fused_wave_bitwise_vs_solo(profile):
     """The headline contract: three distinct-spec requests share ONE
     fused superprogram wave (batch occupancy 3, full roster), and each

@@ -1,0 +1,510 @@
+"""Multi-tenant QoS plane (docs/27_qos.md).
+
+Contracts pinned here:
+
+* **admission replay determinism**: two fresh qos services fed one
+  recorded submission stream under a logical clock produce IDENTICAL
+  admission/throttle logs (``stats()["qos"]["admission_log"]``) — the
+  DRR + EDF + fmix64 policy is pure host arithmetic, no wall clock,
+  no randomness;
+* **qos-off is the baseline**: the ``qos`` trace gate pins the chunk
+  program byte-identical with the plane off (check/gates.py sweep),
+  the ``CIMBA_QOS`` knob is registered in ``config.ENV_KNOBS`` and
+  resolved by ``Service(qos=None)``, and a qos-off service's results
+  stay bitwise the direct calls;
+* **structured throttling**: a tenant past its token-bucket rate or
+  lane quota gets :class:`~cimba_tpu.serve.sched.RetryAfter` with
+  tenant/reason/delay_s — never bare ``QueueFull`` — nothing is
+  admitted, no lanes held, and the telemetry span tree still closes
+  exactly once with outcome ``"throttled"``;
+* **weighted shares**: the DRR scheduler converges tenant lane shares
+  to policy weights under saturated backlogs, orders within a tenant
+  by priority / EDF / fmix64, and never admits past a lane-quota
+  ``room_of``;
+* **the client honors retry-after**: ``run_load`` sleeps the server's
+  ``delay_s``, resubmits, tallies ``throttles_by_tenant``, and
+  ``per_tenant()`` reports the per-tenant tail.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cimba_tpu import config, serve
+from cimba_tpu.core import api, cmd
+from cimba_tpu.core.model import Model
+from cimba_tpu.qos import (
+    DEFAULT_TENANT,
+    AdmissionLimiter,
+    FairScheduler,
+    TenantPolicy,
+    TenantRegistry,
+    TokenBucket,
+)
+from cimba_tpu.qos.fair import entry_order_key
+from cimba_tpu.qos.limits import QUOTA_RETRY_S
+from cimba_tpu.runner import experiment as ex
+from cimba_tpu.serve import cache as pc
+from cimba_tpu.stats import summary as sm
+
+
+def _tiny_spec(t_stop=12.0):
+    """Smallest chunkable model (hold/exit only) — the test_serve
+    tier-1 budget model."""
+    m = Model("tiny", event_cap=1, guard_cap=2)
+
+    @m.block
+    def work(sim, p, sig):
+        done = api.clock(sim) > t_stop
+        return sim, cmd.select(
+            done, cmd.exit_(), cmd.hold(1.0, next_pc=work.pc)
+        )
+
+    m.process("w", entry=work)
+    return m.build()
+
+
+def _clock_path(sims):
+    """tiny records no user summary; pool each lane's final clock (one
+    MODULE-LEVEL function: programs key on summary_path identity)."""
+    return jax.vmap(lambda c: sm.add(sm.empty(), c))(sims.clock)
+
+
+def _assert_results_equal(a, b):
+    al = jax.tree.leaves((a.summary, a.n_failed, a.total_events))
+    bl = jax.tree.leaves((b.summary, b.n_failed, b.total_events))
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_spec()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return pc.ProgramCache(capacity=256)
+
+
+def _req(spec, R, *, seed=1, t_end=None, tenant=None, **kw):
+    return serve.Request(
+        spec, (), R, seed=seed, t_end=t_end, chunk_steps=4,
+        wave_size=R, summary_path=_clock_path, tenant=tenant, **kw,
+    )
+
+
+def _direct(spec, R, cache, *, seed, t_end=None):
+    return ex.run_experiment_stream(
+        spec, (), R, wave_size=R, chunk_steps=4, seed=seed,
+        t_end=t_end, summary_path=_clock_path, program_cache=cache,
+    )
+
+
+class _Gated(serve.Service):
+    """The test_refill gating idiom: ``pack_gate`` holds the wave's
+    initial pack until the queue state under test is constructed."""
+
+    def __init__(self, **kw):
+        self.pack_gate = threading.Event()
+        kw.setdefault("refill", True)
+        kw.setdefault("horizon_bucket", None)
+        kw.setdefault("refill_every", 1)
+        super().__init__(**kw)
+
+    def _serve_refill_wave(self, lead):
+        assert self.pack_gate.wait(120), "pack gate never opened"
+        return super()._serve_refill_wave(lead)
+
+
+# -- tenant model ----------------------------------------------------------
+
+
+def test_tenant_policy_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy("")
+    with pytest.raises(ValueError):
+        TenantPolicy("t", weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", lane_quota=0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", rate=-1.0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", rate=1.0, burst=0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", deadline_class=0.0)
+
+
+def test_tenant_registry_default_and_unknown():
+    reg = TenantRegistry([TenantPolicy("a", weight=3.0)])
+    # None -> the default tenant; unknown names inherit the default
+    # policy under their own name (peers, not errors)
+    assert reg.resolve(None) == DEFAULT_TENANT
+    assert reg.policy(None).weight == 1.0
+    assert reg.policy("a").weight == 3.0
+    ghost = reg.policy("ghost")
+    assert ghost.name == "ghost" and ghost.weight == 1.0
+    assert "a" in reg and "ghost" not in reg
+    # a registered default REPLACES the built-in one
+    reg.register(TenantPolicy(DEFAULT_TENANT, weight=2.0))
+    assert reg.policy(None).weight == 2.0
+
+
+# -- token bucket / limiter (logical clock) --------------------------------
+
+
+def test_token_bucket_logical_clock():
+    clk = [0.0]
+    b = TokenBucket(rate=2.0, burst=3, clock=lambda: clk[0])
+    assert [b.try_take() for _ in range(3)] == [0.0, 0.0, 0.0]
+    # empty: delay is exactly tokens-missing / rate, bucket untouched
+    d = b.try_take()
+    assert d == pytest.approx(0.5)
+    assert b.tokens() == 0.0
+    clk[0] = 0.5                       # 1 token refilled
+    assert b.try_take() == 0.0
+    clk[0] = 100.0                     # refill clamps at burst
+    b.try_take(0.0)
+    assert b.tokens() == pytest.approx(3.0)
+
+
+def test_admission_limiter_quota_then_rate():
+    clk = [0.0]
+    reg = TenantRegistry([
+        TenantPolicy("q", lane_quota=8),
+        TenantPolicy("r", rate=1.0, burst=1),
+        TenantPolicy("d", deadline_class=5.0),
+    ])
+    lim = AdmissionLimiter(reg, clock=lambda: clk[0])
+    lim.check("q", 8, 0)               # exactly at quota admits
+    with pytest.raises(serve.RetryAfter) as ei:
+        lim.check("q", 4, 8, label="big")
+    e = ei.value
+    assert (e.tenant, e.reason, e.label) == ("q", "quota", "big")
+    assert e.delay_s == QUOTA_RETRY_S
+    lim.check("r", 1, 0)               # burst token
+    with pytest.raises(serve.RetryAfter) as ei:
+        lim.check("r", 1, 0)
+    assert ei.value.reason == "rate"
+    assert ei.value.delay_s == pytest.approx(1.0)
+    # default tenant: unlimited
+    lim.check(None, 10_000, 10_000)
+    assert lim.deadline_for("d") == 5.0
+    assert lim.deadline_for(None) is None
+
+
+# -- DRR fairness + EDF ----------------------------------------------------
+
+
+class _FakeEntry:
+    _n = 0
+
+    def __init__(self, tenant, lanes=2, priority=0, deadline_at=None):
+        _FakeEntry._n += 1
+        self.seq = _FakeEntry._n
+        self.tenant = tenant
+        self.lanes = lanes
+        self.priority = priority
+        self.deadline_at = deadline_at
+
+
+def _drr_select(sched, cands, budget, room=None):
+    return sched.select(
+        cands, budget,
+        lanes_of=lambda e: e.lanes,
+        tenant_of=lambda e: e.tenant,
+        room_of=None if room is None else lambda t: room.get(
+            t, float("inf")
+        ),
+    )
+
+
+def test_drr_shares_converge_to_weights():
+    reg = TenantRegistry([
+        TenantPolicy("heavy", weight=3.0), TenantPolicy("light"),
+    ])
+    sched = FairScheduler(reg)
+    claimed = {"heavy": 0, "light": 0}
+    backlog = (
+        [_FakeEntry("heavy") for _ in range(60)]
+        + [_FakeEntry("light") for _ in range(60)]
+    )
+    while sum(claimed.values()) < 160:
+        take = _drr_select(
+            sched, [e for e in backlog if not hasattr(e, "gone")], 8,
+        )
+        assert take, "saturated backlog stopped admitting"
+        for e in take:
+            claimed[e.tenant] += e.lanes
+            e.gone = True
+    # 3:1 weights -> ~3/4 of contended lanes to heavy
+    frac = claimed["heavy"] / sum(claimed.values())
+    assert 0.70 <= frac <= 0.80, claimed
+
+
+def test_drr_uncontended_tenant_gets_everything():
+    reg = TenantRegistry([TenantPolicy("only", weight=0.001)])
+    sched = FairScheduler(reg)
+    cands = [_FakeEntry("only") for _ in range(4)]
+    # all four admit (a microscopic weight of an uncontended link is
+    # still the whole link), in the fmix64 within-tenant order
+    assert _drr_select(sched, cands, 8) == sorted(
+        cands, key=entry_order_key
+    )
+
+
+def test_drr_respects_quota_room_without_starving_others():
+    reg = TenantRegistry()
+    sched = FairScheduler(reg)
+    a = [_FakeEntry("a") for _ in range(4)]
+    b = [_FakeEntry("b") for _ in range(4)]
+    take = _drr_select(sched, a + b, 16, room={"a": 2})
+    # a admits one 2-lane request (room), b fills the rest
+    assert sum(e.lanes for e in take if e.tenant == "a") == 2
+    assert sum(e.lanes for e in take if e.tenant == "b") == 8
+
+
+def test_drr_within_tenant_priority_then_edf():
+    lo_late = _FakeEntry("t", priority=0, deadline_at=9.0)
+    lo_soon = _FakeEntry("t", priority=0, deadline_at=1.0)
+    lo_none = _FakeEntry("t", priority=0)
+    hi = _FakeEntry("t", priority=5)
+    order = sorted(
+        [lo_late, lo_soon, lo_none, hi], key=entry_order_key
+    )
+    assert order == [hi, lo_soon, lo_late, lo_none]
+    reg = TenantRegistry()
+    sched = FairScheduler(reg)
+    take = _drr_select(sched, [lo_late, lo_soon, lo_none, hi], 4)
+    assert take == [hi, lo_soon]
+
+
+def test_drr_selection_is_replayable():
+    reg = TenantRegistry([TenantPolicy("a", weight=2.0)])
+    mk = lambda: (
+        [_FakeEntry("a") for _ in range(5)]
+        + [_FakeEntry("b", lanes=3) for _ in range(5)]
+    )
+    picks = []
+    for _ in range(2):
+        _FakeEntry._n = 0
+        sched = FairScheduler(reg)
+        cands = mk()
+        sel = _drr_select(sched, cands, 11)
+        picks.append([(e.tenant, e.seq) for e in sel])
+    assert picks[0] == picks[1]
+
+
+def test_wave_task_earliest_deadline():
+    from cimba_tpu.serve.device import WaveTask
+
+    class _Slot:
+        def __init__(self, deadline_at, folded=False, done=False):
+            class _E:
+                pass
+
+            self.folded = folded
+            self.entry = _E()
+            self.entry.deadline_at = deadline_at
+            self.entry.priority = 0
+            self.entry.done = threading.Event()
+            if done:
+                self.entry.done.set()
+
+    class _Wave:
+        pass
+
+    t = WaveTask.__new__(WaveTask)
+    w = _Wave()
+    w.slots = [
+        _Slot(3.0), _Slot(1.0, folded=True), _Slot(2.0, done=True),
+        _Slot(None),
+    ]
+    t.wave = w
+    # folded / delivered members don't count; None deadlines don't pull
+    assert WaveTask.earliest_deadline(t) == 3.0
+    w.slots = [_Slot(None)]
+    assert WaveTask.earliest_deadline(t) == float("inf")
+
+
+# -- knob / gate registration ---------------------------------------------
+
+
+def test_qos_knob_and_gate_registered():
+    from cimba_tpu.check import gates as _gates
+
+    assert "CIMBA_QOS" in config.ENV_KNOBS
+    reg = {g.name: g for g in _gates.GATES}
+    assert "qos" in reg
+    assert reg["qos"].env == ("CIMBA_QOS",)
+
+
+def test_service_resolves_qos_from_env(tiny, shared_cache,
+                                       monkeypatch):
+    monkeypatch.delenv("CIMBA_QOS", raising=False)
+    with serve.Service(max_wave=4, cache=shared_cache) as svc:
+        assert svc.qos is False
+    monkeypatch.setenv("CIMBA_QOS", "1")
+    with serve.Service(max_wave=4, cache=shared_cache) as svc:
+        assert svc.qos is True
+    # explicit constructor wins over env
+    with serve.Service(max_wave=4, cache=shared_cache,
+                       qos=False) as svc:
+        assert svc.qos is False
+
+
+# -- service integration ---------------------------------------------------
+
+
+def _qos_registry():
+    return TenantRegistry([
+        TenantPolicy("a", weight=2.0, deadline_class=300.0),
+        TenantPolicy("b", weight=1.0),
+        TenantPolicy("flood", weight=1.0, rate=1.0, burst=2,
+                     lane_quota=4),
+    ])
+
+
+def _adversarial_round(tiny, cache):
+    """One recorded stream: a flooding tenant's burst beside two
+    victims, all queued behind the pack gate, then released.  Returns
+    (admission_log, results, throttles)."""
+    clk = [0.0]
+    svc = _Gated(
+        max_wave=4, cache=cache, qos=True, tenants=_qos_registry(),
+        qos_clock=lambda: clk[0],
+    )
+    throttles = []
+    handles = {}
+    try:
+        for k in range(5):
+            try:
+                handles[f"flood#{k}"] = svc.submit(
+                    _req(tiny, 2, seed=100 + k, tenant="flood"),
+                    block=False,
+                )
+            except serve.RetryAfter as e:
+                throttles.append((e.tenant, e.reason, e.delay_s))
+        for k in range(3):
+            handles[f"a#{k}"] = svc.submit(
+                _req(tiny, 2, seed=10 + k, tenant="a"), block=False,
+            )
+            handles[f"b#{k}"] = svc.submit(
+                _req(tiny, 2, seed=20 + k, tenant="b"), block=False,
+            )
+        svc.pack_gate.set()
+        results = {k: h.result(120) for k, h in handles.items()}
+        st = svc.stats()["qos"]
+        return st["admission_log"], results, throttles
+    finally:
+        svc.pack_gate.set()
+        svc.shutdown()
+
+
+def test_admission_replay_determinism(tiny, shared_cache):
+    """The replay contract: two fresh services, one stream, one
+    logical clock -> identical admission/throttle logs."""
+    log1, res1, thr1 = _adversarial_round(tiny, shared_cache)
+    log2, res2, thr2 = _adversarial_round(tiny, shared_cache)
+    assert thr1 == thr2
+    # 2 of 5 flood requests fit the 4-lane quota; the other 3 throttle
+    assert thr1 == [("flood", "quota", QUOTA_RETRY_S)] * 3
+    assert log1 == log2
+    assert [ev for ev in log1 if ev[0] == "throttle"]
+    assert [ev for ev in log1 if ev[0] == "claim"]
+    # every delivered result bitwise its direct call, both rounds
+    for k, res in res1.items():
+        _assert_results_equal(res, res2[k])
+    for k in ("a#0", "b#2", "flood#0"):
+        tenant_seed = {"a#0": 10, "b#2": 22, "flood#0": 100}[k]
+        _assert_results_equal(
+            res1[k], _direct(tiny, 2, shared_cache, seed=tenant_seed)
+        )
+
+
+def test_qos_off_service_is_baseline(tiny, shared_cache):
+    """qos=False: no tenant accounting, results bitwise direct — and
+    the gates sweep (test_check) pins the traced program itself."""
+    with serve.Service(max_wave=4, cache=shared_cache,
+                       qos=False) as svc:
+        res = svc.submit(
+            _req(tiny, 2, seed=7, tenant="someone")
+        ).result(120)
+        st = svc.stats()["qos"]
+    assert st["enabled"] is False
+    assert st["tenants"] == {} and st["admission_log"] == []
+    _assert_results_equal(res, _direct(tiny, 2, shared_cache, seed=7))
+
+
+def test_throttled_span_tree_closes_once(tiny, shared_cache,
+                                         tmp_path):
+    import json
+
+    from cimba_tpu.obs import telemetry as tm
+
+    span_path = str(tmp_path / "spans.jsonl")
+    tel = tm.Telemetry(interval=3600.0, spans=True,
+                       span_path=span_path)
+    try:
+        reg = TenantRegistry([
+            TenantPolicy("f", rate=1.0, burst=1),
+        ])
+        clk = [0.0]
+        with serve.Service(
+            max_wave=4, cache=shared_cache, qos=True, tenants=reg,
+            qos_clock=lambda: clk[0], telemetry=tel,
+        ) as svc:
+            svc.submit(_req(tiny, 2, seed=1, tenant="f")).result(120)
+            with pytest.raises(serve.RetryAfter):
+                svc.submit(_req(tiny, 2, seed=2, tenant="f"))
+            st = svc.stats()
+        assert st["throttled"] == 1
+        assert st["qos"]["tenants"]["f"]["throttled_rate"] == 1
+        # the span tree closed exactly once, outcome "throttled"
+        assert tel.spans.open_count() == 0
+    finally:
+        tel.close()
+    lines = [json.loads(ln) for ln in open(span_path)]
+    roots = [
+        s for s in lines
+        if s.get("parent") is None and s.get("name") == "request"
+        and s.get("outcome") == "throttled"
+    ]
+    assert len(roots) == 1, lines
+
+
+def test_client_honors_retry_after(tiny, shared_cache):
+    reg = TenantRegistry([
+        TenantPolicy("f", rate=50.0, burst=1),
+    ])
+    with serve.Service(max_wave=4, cache=shared_cache, qos=True,
+                       tenants=reg) as svc:
+        reqs = [
+            _req(tiny, 2, seed=30 + i,
+                 tenant=("f" if i % 2 else "v"))
+            for i in range(6)
+        ]
+        rep = serve.run_load(svc, reqs, n_clients=2)
+    # the flooder was throttled at least once yet every request
+    # completed: the client slept delay_s and resubmitted
+    assert rep.n_completed == 6, rep.errors
+    assert rep.throttles_by_tenant.get("f", 0) >= 1
+    pt = rep.per_tenant()
+    assert set(pt) == {"f", "v"}
+    assert pt["v"]["throttled"] == 0 and pt["v"]["goodput"] == 1.0
+    assert rep.summary()["throttles"] == sum(
+        rep.throttles_by_tenant.values()
+    )
+
+
+def test_retry_after_fields_and_export():
+    # the structured contract clients and the fleet wire depend on
+    e = serve.RetryAfter(0.25, "t", reason="quota", label="x")
+    assert isinstance(e, serve.ServeError)
+    assert (e.delay_s, e.tenant, e.reason, e.label) == (
+        0.25, "t", "quota", "x"
+    )
+    assert "retry after" in str(e)

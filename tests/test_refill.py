@@ -216,6 +216,7 @@ def test_pad_lane_reclamation_parity(tiny, shared_cache):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # displaced for the qos suite: ci.sh "refill smoke" runs the lead/short/late staggered-retirement scenario with direct equality every pass
 def test_mixed_horizon_staggered_retirement_exact(tiny, shared_cache):
     """Three horizons in one wave retire at three different boundaries;
     each is delivered at ITS boundary (mid_wave_deliveries counts the
@@ -510,6 +511,7 @@ def test_refill_env_knob_resolves_service_default(
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # displaced for the qos suite: ci.sh "refill smoke" asserts zero cache misses after the warm round every pass
 def test_refill_zero_program_cache_misses_after_warm(tiny):
     """Two identical refill-wave rounds against one cache: the second
     adds NO program-cache misses — boundary splices dispatch cached

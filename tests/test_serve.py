@@ -154,6 +154,7 @@ def test_serve_single_and_multiwave_match_direct_bitwise(
     _assert_results_equal(r2, d2)
 
 
+@pytest.mark.slow  # displaced for the qos suite: ci.sh "serve smoke" drives 3 concurrent clients against their direct calls every pass
 def test_serve_concurrent_clients_match_direct_bitwise(
     tiny, shared_cache,
 ):

@@ -443,6 +443,89 @@ print("refill smoke OK:", ref, "| occupancy_mean",
       round(occ["occupancy_mean"], 3), "| cache misses 0 after warm")
 EOF
 
+# qos smoke (docs/27_qos.md): one service, a flooding tenant beside
+# two victim tenants through one refill wave — the flooder is
+# throttled with a STRUCTURED RetryAfter (delay_s/tenant/reason), both
+# victims' results stay bitwise their direct calls, and the per-tenant
+# cimba_serve_qos_* families parse back out of /metrics with tenant
+# labels intact
+run_cell "qos smoke" python - <<'EOF'
+import urllib.request
+from cimba_tpu import serve
+from cimba_tpu.models import mm1
+from cimba_tpu.obs import audit, expose as xp, telemetry as tm
+from cimba_tpu.qos import TenantPolicy, TenantRegistry
+from cimba_tpu.runner import experiment as ex
+
+spec, _ = mm1.build(record=False)
+cache = serve.ProgramCache()
+reg = TenantRegistry([
+    TenantPolicy("alice", weight=3.0),
+    TenantPolicy("bob", weight=1.0),
+    TenantPolicy("flood", weight=1.0, rate=1.0, burst=2, lane_quota=8),
+])
+tel = tm.Telemetry(interval=0.05)
+
+
+def req(n, seed, tenant, label):
+    return serve.Request(spec, mm1.params(n), 4, seed=seed, wave_size=4,
+                         chunk_steps=16, tenant=tenant, label=label)
+
+
+throttles = []
+victims = {}
+cases = [("alice", 60, 1), ("alice", 90, 5), ("bob", 75, 9)]
+with xp.start(tel) as srv:
+    with serve.Service(max_wave=16, cache=cache, refill=True,
+                       refill_every=1, horizon_bucket=None,
+                       qos=True, tenants=reg, telemetry=tel) as svc:
+        flood_handles = []
+        for k in range(8):
+            try:
+                flood_handles.append(svc.submit(
+                    req(400, 100 + k, "flood", f"flood#{k}"),
+                    block=False,
+                ))
+            except serve.RetryAfter as e:
+                throttles.append((e.tenant, e.reason, e.delay_s))
+        hs = [svc.submit(req(n, seed, t, f"{t}#{i}"))
+              for i, (t, n, seed) in enumerate(cases)]
+        for i, h in enumerate(hs):
+            victims[i] = h.result(600)
+        for h in flood_handles:
+            h.result(600)
+        tel.sample()
+        met = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+        st = svc.stats()["qos"]
+tel.close()
+# the flooder was throttled, structured each time
+assert throttles, "flood was never throttled"
+assert all(t == "flood" and d > 0 for t, _, d in throttles), throttles
+assert {r for _, r, _ in throttles} <= {"rate", "quota"}, throttles
+assert st["tenants"]["flood"]["throttled"] == len(throttles), st
+# victims bitwise vs their direct calls — fair shares shape ORDER,
+# never results
+for i, (t, n, seed) in enumerate(cases):
+    direct = ex.run_experiment_stream(
+        spec, mm1.params(n), 4, wave_size=4, chunk_steps=16,
+        seed=seed, program_cache=cache,
+    )
+    assert (audit.stream_result_digest(victims[i])
+            == audit.stream_result_digest(direct)), (t, i)
+# per-tenant families parse from /metrics with tenant labels intact
+parsed = xp.parse_prometheus_text(met)["samples"]
+sub = parsed["cimba_serve_qos_submitted_total"]
+tenants = {dict(k).get("tenant") for k in sub}
+assert {"alice", "bob", "flood"} <= tenants, tenants
+thr = parsed["cimba_serve_qos_throttled_total"]
+assert sum(v for k, v in thr.items()
+           if dict(k).get("tenant") == "flood") == len(throttles), thr
+print("qos smoke OK:", len(throttles), "structured throttles",
+      sorted({r for _, r, _ in throttles}), "| victims bitwise |",
+      len(tenants), "tenants on /metrics")
+EOF
+
 # preempt smoke (docs/24_device_scheduler.md): one wave slot, a
 # running low-priority background wave, an urgent foreign-class client
 # — the background is checkpoint-evicted at a quantum boundary, the

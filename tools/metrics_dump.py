@@ -9,6 +9,11 @@ Prometheus metric families plus the ``/healthz`` verdict:
     python tools/metrics_dump.py --url http://host:9321 --varz
     python tools/metrics_dump.py --demo
 
+With QoS traffic (docs/27_qos.md) the single-url dump adds a
+per-tenant table (goodput, throttles, p99 gauge) and fleet mode adds a
+per-tenant rollup line summed across slices; both are additive and
+never change the exit code.
+
 Fleet mode (docs/20_fleet.md): several ``--url``s, or ``--fleet`` with
 a fleet manifest file (``{"slices": [{"name", "url"}, ...]}`` — what
 ``FleetManager.fleet_manifest()`` emits), prints one PER-SLICE row
@@ -102,6 +107,56 @@ def print_families(text: str) -> None:
     print()
 
 
+def print_tenants(text: str) -> None:
+    """The per-tenant QoS table (docs/27_qos.md): one row per
+    (service, tenant) with goodput, throttle counts, and the p99
+    latency gauge — pulled from the ``cimba_serve_qos_*`` families.
+    Prints nothing when the endpoint has no QoS traffic (the table is
+    additive; exit codes never depend on it)."""
+    from cimba_tpu.obs.expose import parse_prometheus_text
+
+    samples = parse_prometheus_text(text)["samples"]
+    rows: dict = {}
+
+    def scan(fname, key):
+        for labels, value in samples.get(fname, {}).items():
+            lab = dict(labels)
+            tenant = lab.get("tenant")
+            if tenant is None:
+                continue
+            rows.setdefault(
+                (lab.get("service", ""), tenant), {}
+            )[key] = value
+
+    scan("cimba_serve_qos_submitted_total", "submitted")
+    scan("cimba_serve_qos_completed_total", "completed")
+    scan("cimba_serve_qos_throttled_total", "throttled")
+    scan("cimba_serve_qos_goodput_ratio", "goodput")
+    scan("cimba_serve_qos_latency_p99_seconds", "p99")
+    if not rows:
+        return
+    cols = (
+        ("service", 16), ("tenant", 14), ("submitted", 9),
+        ("completed", 9), ("goodput", 7), ("throttl", 7), ("p99_s", 8),
+    )
+    print("== tenants ==")
+    print("  ".join(f"{name:<{w}}" for name, w in cols))
+    print("  ".join("-" * w for _, w in cols))
+    for (svc, tenant), r in sorted(rows.items()):
+        gp = r.get("goodput")
+        row = (
+            svc[:16], tenant[:14],
+            f"{r.get('submitted', 0):g}", f"{r.get('completed', 0):g}",
+            "-" if gp is None else f"{gp:.1%}",
+            f"{r.get('throttled', 0):g}",
+            f"{r.get('p99', 0.0):.3f}",
+        )
+        print("  ".join(
+            f"{v:<{w}}" for v, (_, w) in zip(row, cols)
+        ))
+    print()
+
+
 def print_health(body: str, status: int) -> str:
     try:
         h = json.loads(body)
@@ -132,6 +187,7 @@ def dump_url(url: str, timeout: float, varz: bool) -> int:
         return 1
     print(f"== {url}/metrics ==")
     print_families(metrics_text)
+    print_tenants(metrics_text)
     if varz:
         _, vz = _fetch(url + "/varz", timeout)
         print(f"== {url}/varz ==")
@@ -168,9 +224,25 @@ def dump_fleet(slices, timeout: float) -> int:
     refill_on = 0
     waves_total = 0
     preempt_total = 0
+    tenant_rollup: dict = {}
     bad = 0
     for name, url in slices:
         rep = scrape_slice(url, timeout)
+        # the per-tenant QoS rollup (docs/27_qos.md): counters sum
+        # across slices — the fleet-wide goodput/throttle view
+        for tenant, row in (rep.get("tenants") or {}).items():
+            agg = tenant_rollup.setdefault(
+                tenant, {"submitted": 0.0, "completed": 0.0,
+                         "throttled": 0.0, "p99": 0.0},
+            )
+            agg["submitted"] += row.get(
+                "cimba_serve_qos_submitted_total", 0.0)
+            agg["completed"] += row.get(
+                "cimba_serve_qos_completed_total", 0.0)
+            agg["throttled"] += row.get(
+                "cimba_serve_qos_throttled_total", 0.0)
+            agg["p99"] = max(agg["p99"], row.get(
+                "cimba_serve_qos_latency_p99_seconds", 0.0))
         verdict = rep["verdict"]
         rollup[verdict] = rollup.get(verdict, 0) + 1
         if verdict in ("unhealthy", "unreachable"):
@@ -217,6 +289,15 @@ def dump_fleet(slices, timeout: float) -> int:
         + f"; refill on {refill_on}, free lanes {free_total}"
         + f"; waves live {waves_total}, preemptions {preempt_total}"
     )
+    for tenant, agg in sorted(tenant_rollup.items()):
+        sub = agg["submitted"]
+        gp = agg["completed"] / sub if sub else 0.0
+        print(
+            f"  tenant {tenant}: completed {agg['completed']:g}"
+            f"/{sub:g} (goodput {gp:.1%}), "
+            f"throttled {agg['throttled']:g}, "
+            f"worst p99 {agg['p99']:.3f}s"
+        )
     if bad:
         print(f"UNHEALTHY: {bad} slice(s) down or unreachable")
     return 1 if bad else 0
